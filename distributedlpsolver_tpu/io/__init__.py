@@ -1,0 +1,3 @@
+from distributedlpsolver_tpu.io.mps import read_mps, read_mps_string, write_mps
+
+__all__ = ["read_mps", "read_mps_string", "write_mps"]

@@ -1,0 +1,13 @@
+from distributedlpsolver_tpu.models.problem import InteriorForm, LPProblem, to_interior_form
+from distributedlpsolver_tpu.models.generators import (
+    BatchedLP,
+    block_angular_lp,
+    random_batched_lp,
+    random_dense_lp,
+    random_general_lp,
+)
+
+__all__ = [
+    "LPProblem", "InteriorForm", "to_interior_form", "BatchedLP",
+    "random_dense_lp", "random_general_lp", "random_batched_lp", "block_angular_lp",
+]

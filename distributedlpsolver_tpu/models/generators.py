@@ -1,0 +1,244 @@
+"""Synthetic LP generators for the benchmark suite and tests.
+
+Covers the shapes named in BASELINE.json:7-11: random dense LPs
+(m=10k, n=50k full-Cholesky config), batched small LPs (1024 × (128, 512)),
+and pds-like block-angular problems for the distributed Schur-complement
+path. All generators construct problems that are feasible and bounded *by
+construction* (primal point and dual certificate built first, data derived
+from them), so tests can assert convergence unconditionally.
+
+NOTE: the true Netlib/Mittelmann files (afiro, pds-*, neos3, stormG2_1000)
+cannot be downloaded in this zero-egress environment; `bench.py` uses these
+generators at the published shapes and the MPS reader accepts the real files
+whenever they are dropped into ``data/`` (see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from distributedlpsolver_tpu.models.problem import LPProblem
+
+_INF = np.inf
+
+
+def random_dense_lp(m: int, n: int, seed: int = 0, sigma: float = 1.0) -> LPProblem:
+    """Random dense standard-form LP ``min cᵀx, Ax=b, x≥0`` (feasible+bounded).
+
+    Construction: draw A; draw an interior primal point ``x0>0`` and set
+    ``b = A·x0``; draw dual ``y0`` and slack ``s0>0`` and set
+    ``c = Aᵀy0 + s0``. Then x0 is strictly feasible and (y0, s0) is a
+    strictly feasible dual point, so an optimum exists (strong duality).
+    """
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((m, n)) * sigma
+    x0 = rng.uniform(0.5, 2.0, size=n)
+    b = A @ x0
+    y0 = rng.standard_normal(m)
+    s0 = rng.uniform(0.5, 2.0, size=n)
+    c = A.T @ y0 + s0
+    return LPProblem(
+        c=c, A=A, rlb=b, rub=b, lb=np.zeros(n), ub=np.full(n, _INF),
+        name=f"random_dense_{m}x{n}_s{seed}",
+    )
+
+
+def random_general_lp(
+    m: int, n: int, seed: int = 0, frac_eq: float = 0.3, frac_box: float = 0.5
+) -> LPProblem:
+    """Random *general-form* LP with mixed row senses, ranges, and bounds.
+
+    Exercises the full ``to_interior_form`` conversion (slacks, shifts,
+    negations, free splits). Feasible by construction; boundedness is forced
+    by boxing a fraction of the variables and keeping c ≥ dual-feasible on
+    the rest.
+    """
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((m, n))
+    x0 = rng.uniform(-1.0, 2.0, size=n)
+
+    lb = np.full(n, -_INF)
+    ub = np.full(n, _INF)
+    kinds = rng.uniform(size=n)
+    for j in range(n):
+        if kinds[j] < frac_box:  # boxed
+            lb[j] = x0[j] - rng.uniform(0.1, 2.0)
+            ub[j] = x0[j] + rng.uniform(0.1, 2.0)
+        elif kinds[j] < frac_box + 0.2:  # lower-bounded
+            lb[j] = x0[j] - rng.uniform(0.1, 2.0)
+        elif kinds[j] < frac_box + 0.4:  # upper-bounded
+            ub[j] = x0[j] + rng.uniform(0.1, 2.0)
+        # else free
+
+    ax0 = A @ x0
+    rlb = np.full(m, -_INF)
+    rub = np.full(m, _INF)
+    senses = rng.uniform(size=m)
+    for i in range(m):
+        if senses[i] < frac_eq:  # E
+            rlb[i] = rub[i] = ax0[i]
+        elif senses[i] < frac_eq + 0.3:  # L
+            rub[i] = ax0[i] + rng.uniform(0.1, 1.0)
+        elif senses[i] < frac_eq + 0.6:  # G
+            rlb[i] = ax0[i] - rng.uniform(0.1, 1.0)
+        else:  # ranged
+            rlb[i] = ax0[i] - rng.uniform(0.1, 1.0)
+            rub[i] = ax0[i] + rng.uniform(0.1, 1.0)
+
+    # Bounded objective: make c a nonnegative combination that cannot dive to
+    # -inf along any ray of the (partially unbounded) feasible set. Simplest
+    # robust choice: c = Aᵀy + s with s>0 only guaranteed to bound the
+    # standard-form recession cone, which here may include negative
+    # directions for non-lb variables; so penalize those toward their finite
+    # side instead.
+    c = rng.standard_normal(n)
+    for j in range(n):
+        if not np.isfinite(lb[j]) and not np.isfinite(ub[j]):
+            c[j] = 0.0  # free var: keep objective flat to guarantee bounded
+        elif not np.isfinite(lb[j]):
+            c[j] = -abs(c[j])  # only ub finite: push up toward ub
+        elif not np.isfinite(ub[j]):
+            c[j] = abs(c[j])  # only lb finite: push down toward lb
+    return LPProblem(
+        c=c, A=A, rlb=rlb, rub=rub, lb=lb, ub=ub,
+        name=f"random_general_{m}x{n}_s{seed}",
+    )
+
+
+@dataclasses.dataclass
+class BatchedLP:
+    """A batch of independent standard-form LPs with identical shapes.
+
+    ``A``: (B, m, n); ``b``: (B, m); ``c``: (B, n). Lower bounds are 0 and
+    there are no upper bounds — the vmap'd batched backend consumes this
+    directly (BASELINE.json:11: 1024 × (m=128, n=512)).
+    """
+
+    c: np.ndarray
+    A: np.ndarray
+    b: np.ndarray
+    name: str = "batched"
+
+    @property
+    def batch(self) -> int:
+        return self.A.shape[0]
+
+    @property
+    def m(self) -> int:
+        return self.A.shape[1]
+
+    @property
+    def n(self) -> int:
+        return self.A.shape[2]
+
+    def problem(self, k: int) -> LPProblem:
+        m, n = self.m, self.n
+        return LPProblem(
+            c=self.c[k], A=self.A[k], rlb=self.b[k], rub=self.b[k],
+            lb=np.zeros(n), ub=np.full(n, _INF), name=f"{self.name}[{k}]",
+        )
+
+
+def random_batched_lp(batch: int, m: int, n: int, seed: int = 0) -> BatchedLP:
+    """Batch of feasible+bounded standard-form LPs (same construction as
+    :func:`random_dense_lp`, vectorized over a leading batch axis)."""
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((batch, m, n))
+    x0 = rng.uniform(0.5, 2.0, size=(batch, n))
+    b = np.einsum("bmn,bn->bm", A, x0)
+    y0 = rng.standard_normal((batch, m))
+    s0 = rng.uniform(0.5, 2.0, size=(batch, n))
+    c = np.einsum("bmn,bm->bn", A, y0) + s0
+    return BatchedLP(c=c, A=A, b=b, name=f"batched_{batch}x{m}x{n}_s{seed}")
+
+
+def block_angular_lp(
+    num_blocks: int,
+    block_m: int,
+    block_n: int,
+    link_m: int,
+    seed: int = 0,
+    density: float = 0.3,
+    sparse: Optional[bool] = None,
+) -> LPProblem:
+    """pds-like block-angular LP (BASELINE.json:8 structure).
+
+    Structure (primal block-angular, as in multicommodity flow / stochastic
+    programs like stormG2):
+
+    .. code-block:: text
+
+        min Σ_k c_kᵀ x_k
+        s.t. B_k x_k = b_k           (local block rows, k = 1..K)
+             Σ_k L_k x_k ≤ d        (dense-ish linking rows)
+             x ≥ 0
+
+    Feasible+bounded by the same primal/dual construction as
+    :func:`random_dense_lp`. Returns a single assembled LPProblem whose rows
+    are ordered [block 1 rows, ..., block K rows, linking rows]; the
+    block-structured backend re-detects the structure from metadata stored in
+    ``prob.block_structure``.
+    """
+    rng = np.random.default_rng(seed)
+    K, mb, nb = num_blocks, block_m, block_n
+    n = K * nb
+    m = K * mb + link_m
+
+    x0 = rng.uniform(0.5, 2.0, size=n)
+    blocks = []
+    links = []
+    b_loc = []
+    for k in range(K):
+        Bk = rng.standard_normal((mb, nb)) * (rng.uniform(size=(mb, nb)) < density)
+        # Guard against empty rows (would make the row trivially infeasible
+        # unless rhs is 0; keep the matrix numerically well-posed instead).
+        zero_rows = ~Bk.any(axis=1)
+        if zero_rows.any():
+            Bk[zero_rows, rng.integers(0, nb, size=zero_rows.sum())] = 1.0
+        Lk = rng.standard_normal((link_m, nb)) * (rng.uniform(size=(link_m, nb)) < density)
+        blocks.append(Bk)
+        links.append(Lk)
+        b_loc.append(Bk @ x0[k * nb : (k + 1) * nb])
+
+    L_full = np.hstack(links)
+    d = L_full @ x0 + rng.uniform(0.1, 1.0, size=link_m)  # strict slack
+
+    use_sparse = sparse if sparse is not None else (m * n > 200_000)
+    if use_sparse:
+        A = sp.bmat(
+            [
+                [sp.csr_matrix(blocks[k]) if kk == k else None for kk in range(K)]
+                for k in range(K)
+            ]
+            + [[sp.csr_matrix(links[k]) for k in range(K)]],
+            format="csr",
+        )
+    else:
+        A = np.zeros((m, n))
+        for k in range(K):
+            A[k * mb : (k + 1) * mb, k * nb : (k + 1) * nb] = blocks[k]
+        A[K * mb :, :] = L_full
+
+    # Dual certificate for boundedness: c = Aᵀy + s, s > 0.
+    y0 = rng.standard_normal(m)
+    y0[K * mb :] = -np.abs(y0[K * mb :])  # linking rows are ≤ → dual y ≤ 0
+    s0 = rng.uniform(0.5, 2.0, size=n)
+    c = np.asarray(A.T @ y0).ravel() + s0
+
+    rlb = np.concatenate([np.concatenate(b_loc), np.full(link_m, -_INF)])
+    rub = np.concatenate([np.concatenate(b_loc), d])
+    prob = LPProblem(
+        c=c, A=A, rlb=rlb, rub=rub, lb=np.zeros(n), ub=np.full(n, _INF),
+        name=f"block_angular_K{K}_{mb}x{nb}_link{link_m}_s{seed}",
+    )
+    prob.block_structure = {
+        "num_blocks": K,
+        "block_m": mb,
+        "block_n": nb,
+        "link_m": link_m,
+    }
+    return prob

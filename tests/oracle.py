@@ -1,0 +1,53 @@
+"""Shared scipy-HiGHS oracle helpers for tests (SURVEY.md §4).
+
+One implementation of "solve this with HiGHS" for both the interior form and
+the original general form, so every test module validates against the same
+oracle.
+"""
+
+import numpy as np
+import scipy.optimize as sopt
+import scipy.sparse as sp
+
+
+def highs_on_interior(inf):
+    """Solve an InteriorForm LP with scipy HiGHS (min cᵀx, Ax=b, 0≤x≤u)."""
+    A = inf.A.toarray() if sp.issparse(inf.A) else np.asarray(inf.A)
+    return sopt.linprog(
+        inf.c,
+        A_eq=A,
+        b_eq=inf.b,
+        bounds=[(0.0, u if np.isfinite(u) else None) for u in inf.u],
+        method="highs",
+    )
+
+
+def highs_on_general(p):
+    """Solve a general-form LPProblem with scipy HiGHS (row bounds as ub pairs)."""
+    A = p.A.toarray() if sp.issparse(p.A) else np.asarray(p.A)
+    eq = (p.rlb == p.rub) & np.isfinite(p.rlb)
+    A_ub, b_ub = [], []
+    for i in range(p.m):
+        if eq[i]:
+            continue
+        if np.isfinite(p.rub[i]):
+            A_ub.append(A[i])
+            b_ub.append(p.rub[i])
+        if np.isfinite(p.rlb[i]):
+            A_ub.append(-A[i])
+            b_ub.append(-p.rlb[i])
+    return sopt.linprog(
+        p.c,
+        A_ub=np.array(A_ub) if A_ub else None,
+        b_ub=np.array(b_ub) if b_ub else None,
+        A_eq=A[eq] if eq.any() else None,
+        b_eq=p.rlb[eq] if eq.any() else None,
+        bounds=[
+            (
+                p.lb[j] if np.isfinite(p.lb[j]) else None,
+                p.ub[j] if np.isfinite(p.ub[j]) else None,
+            )
+            for j in range(p.n)
+        ],
+        method="highs",
+    )

@@ -1,0 +1,290 @@
+"""MPS reader/writer tests: hand-written fixtures with known semantics plus
+write→read round-trips on random general LPs (SURVEY.md §4 unit plan)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from distributedlpsolver_tpu.io import read_mps, read_mps_string, write_mps
+from distributedlpsolver_tpu.models import random_general_lp
+
+TINY = """\
+* tiny test problem
+NAME          TINY
+ROWS
+ N  COST
+ L  LIM1
+ G  LIM2
+ E  MYEQN
+COLUMNS
+    X1        COST         1.0   LIM1         1.0
+    X1        LIM2         1.0
+    X2        COST         2.0   LIM1         1.0
+    X2        MYEQN       -1.0
+    X3        COST        -1.0   MYEQN        1.0
+RHS
+    RHS1      LIM1         4.0   LIM2         1.0
+    RHS1      MYEQN        7.0
+BOUNDS
+ UP BND1      X1           4.0
+ LO BND1      X2          -1.0
+ENDATA
+"""
+
+
+class TestReader:
+    def test_tiny(self):
+        p = read_mps_string(TINY)
+        assert p.name == "TINY"
+        assert p.shape == (3, 3)
+        np.testing.assert_allclose(p.c, [1.0, 2.0, -1.0])
+        A = np.asarray(p.A)
+        np.testing.assert_allclose(
+            A, [[1.0, 1.0, 0.0], [1.0, 0.0, 0.0], [0.0, -1.0, 1.0]]
+        )
+        np.testing.assert_allclose(p.rlb, [-np.inf, 1.0, 7.0])
+        np.testing.assert_allclose(p.rub, [4.0, np.inf, 7.0])
+        np.testing.assert_allclose(p.lb, [0.0, -1.0, 0.0])
+        np.testing.assert_allclose(p.ub, [4.0, np.inf, np.inf])
+
+    def test_objective_constant_and_maximize(self):
+        text = """\
+NAME X
+OBJSENSE
+    MAX
+ROWS
+ N obj
+ L r1
+COLUMNS
+    x obj 3.0 r1 1.0
+RHS
+    RHS obj 5.0 r1 10.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        # RHS 5.0 on the obj row ⇒ constant −5, so this is MAX 3x − 5,
+        # stored as MIN −3x + 5.
+        np.testing.assert_allclose(p.c, [-3.0])
+        assert p.c0 == 5.0
+        assert p.maximize
+
+    def test_ranges(self):
+        text = """\
+NAME R
+ROWS
+ N obj
+ L l1
+ G g1
+ E e1
+ E e2
+COLUMNS
+    x obj 1.0 l1 1.0
+    x g1 1.0 e1 1.0
+    x e2 1.0
+RHS
+    R l1 10.0 g1 2.0
+    R e1 5.0 e2 5.0
+RANGES
+    RNG l1 4.0 g1 3.0
+    RNG e1 2.0 e2 -2.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        np.testing.assert_allclose(p.rlb, [6.0, 2.0, 5.0, 3.0])
+        np.testing.assert_allclose(p.rub, [10.0, 5.0, 7.0, 5.0])
+
+    def test_negative_up_bound_quirk(self):
+        text = """\
+NAME Q
+ROWS
+ N obj
+ E e1
+COLUMNS
+    x obj 1.0 e1 1.0
+RHS
+    R e1 1.0
+BOUNDS
+ UP B x -2.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        assert p.ub[0] == -2.0
+        assert p.lb[0] == -np.inf  # classic quirk fired
+
+    def test_integer_markers_relaxed(self):
+        text = """\
+NAME I
+ROWS
+ N obj
+ G r
+COLUMNS
+    MARKER                 'MARKER'                 'INTORG'
+    xi obj 1.0 r 1.0
+    MARKER                 'MARKER'                 'INTEND'
+    xc obj 1.0 r 1.0
+RHS
+    R r 2.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        assert p.integer_cols == [0]
+        assert p.shape == (1, 2)
+
+    def test_free_extra_n_rows_dropped(self):
+        text = """\
+NAME F
+ROWS
+ N obj
+ N freerow
+ E e1
+COLUMNS
+    x obj 1.0 freerow 9.0
+    x e1 1.0
+RHS
+    R e1 1.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        assert p.shape == (1, 1)
+
+    def test_duplicate_entries_summed(self):
+        text = """\
+NAME D
+ROWS
+ N obj
+ E e1
+COLUMNS
+    x obj 1.0 e1 1.0
+    x e1 2.0
+RHS
+    R e1 3.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        assert np.asarray(p.A)[0, 0] == 3.0
+
+    def test_sparse_output(self):
+        p = read_mps_string(TINY, dense=False)
+        assert sp.issparse(p.A)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_write_read_roundtrip(self, tmp_path, seed):
+        p = random_general_lp(8, 13, seed=seed)
+        path = tmp_path / "rt.mps"
+        write_mps(p, path)
+        q = read_mps(path)
+        np.testing.assert_allclose(q.c, p.c, rtol=1e-15)
+        np.testing.assert_allclose(np.asarray(q.A), np.asarray(p.A), rtol=1e-15)
+        np.testing.assert_allclose(q.rlb, p.rlb, rtol=1e-12)
+        np.testing.assert_allclose(q.rub, p.rub, rtol=1e-12)
+        np.testing.assert_allclose(q.lb, p.lb, rtol=1e-15)
+        np.testing.assert_allclose(q.ub, p.ub, rtol=1e-15)
+
+    def test_gzip_roundtrip(self, tmp_path):
+        import gzip
+
+        p = random_general_lp(5, 7, seed=3)
+        path = tmp_path / "rt.mps"
+        write_mps(p, path)
+        gz = tmp_path / "rt.mps.gz"
+        with open(path, "rb") as f, gzip.open(gz, "wb") as g:
+            g.write(f.read())
+        q = read_mps(gz)
+        np.testing.assert_allclose(np.asarray(q.A), np.asarray(p.A))
+
+
+class TestReviewRegressions:
+    """Regressions for the round-trip/parsing bugs found in code review."""
+
+    def test_zero_column_survives_roundtrip(self, tmp_path):
+        import numpy as np
+        from distributedlpsolver_tpu.models import LPProblem
+
+        p = LPProblem(
+            c=np.array([0.0, 1.0]),
+            A=np.array([[0.0, 1.0]]),  # col 0 appears nowhere
+            rlb=np.array([1.0]),
+            rub=np.array([1.0]),
+            lb=np.zeros(2),
+            ub=np.array([5.0, np.inf]),
+        )
+        path = tmp_path / "zero.mps"
+        write_mps(p, path)
+        q = read_mps(path)
+        assert q.n == 2
+        np.testing.assert_allclose(q.ub, p.ub)
+
+    def test_obj_name_collision(self, tmp_path):
+        import numpy as np
+        from distributedlpsolver_tpu.models import LPProblem
+
+        p = LPProblem(
+            c=np.array([2.0]), A=np.array([[1.0]]),
+            rlb=np.array([-np.inf]), rub=np.array([3.0]),
+            lb=np.zeros(1), ub=np.array([np.inf]),
+            row_names=["OBJ"], col_names=["x"],
+        )
+        path = tmp_path / "obj.mps"
+        write_mps(p, path)
+        q = read_mps(path)
+        assert q.m == 1
+        np.testing.assert_allclose(q.c, [2.0])
+        np.testing.assert_allclose(np.asarray(q.A), [[1.0]])
+        np.testing.assert_allclose(q.rub, [3.0])
+
+    def test_coefficient_on_row_named_marker(self):
+        import numpy as np
+
+        text = """\
+NAME M
+ROWS
+ N obj
+ E MARKER
+COLUMNS
+    X1 MARKER 2.0
+    X1 obj 1.0
+RHS
+    R MARKER 4.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        assert np.asarray(p.A)[0, 0] == 2.0
+        assert p.rlb[0] == 4.0
+
+    def test_rhs_setname_collides_with_row(self):
+        import numpy as np
+
+        # RHS set named like a row: parity rule must still parse correctly.
+        text = """\
+NAME C
+ROWS
+ N obj
+ E r1
+ E r2
+COLUMNS
+    x obj 1.0 r1 1.0
+    x r2 1.0
+RHS
+    r1 r1 5.0 r2 6.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        np.testing.assert_allclose(p.rlb, [5.0, 6.0])
+
+    def test_free_row_emitted_as_n_and_dropped(self, tmp_path):
+        import numpy as np
+        from distributedlpsolver_tpu.models import LPProblem
+
+        p = LPProblem(
+            c=np.array([1.0]), A=np.array([[1.0], [2.0]]),
+            rlb=np.array([-np.inf, 1.0]), rub=np.array([np.inf, 1.0]),
+            lb=np.zeros(1), ub=np.array([np.inf]),
+        )
+        path = tmp_path / "free.mps"
+        write_mps(p, path)
+        q = read_mps(path)
+        # free row dropped, feasible set preserved
+        assert q.m == 1
+        np.testing.assert_allclose(q.rlb, [1.0])
