@@ -1,0 +1,11 @@
+"""Execution backends. Importing this package registers the built-ins."""
+
+from distributedlpsolver_tpu.backends.base import (
+    SolverBackend,
+    available_backends,
+    get_backend,
+    register_backend,
+)
+import distributedlpsolver_tpu.backends.dense  # noqa: F401  (registers tpu/dense/jax)
+
+__all__ = ["SolverBackend", "available_backends", "get_backend", "register_backend"]

@@ -1,0 +1,92 @@
+"""`SolverBackend` plugin interface and registry.
+
+The reference exposes pluggable execution backends selected by name via
+``--backend=<name>`` (BASELINE.json:5 — the north star registers its TPU
+path "behind the existing `SolverBackend` plugin interface"). This module
+is our version of that seam: backends subclass :class:`SolverBackend`,
+register under one or more names with :func:`register_backend`, and the
+driver/CLI resolve them with :func:`get_backend`.
+
+The interface is deliberately coarse — ``iterate`` performs one *full*
+Mehrotra iteration — because on TPU the profitable unit of work is one
+compiled device step per IPM iteration with only convergence scalars
+crossing back to the host (SURVEY.md §3.4), not per-factorize/per-solve
+host round-trips.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, List, Tuple, Type
+
+import numpy as np
+
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
+from distributedlpsolver_tpu.models.problem import InteriorForm
+
+
+class SolverBackend(abc.ABC):
+    """Executes the per-iteration linear algebra of the IPM.
+
+    Lifecycle: ``setup(interior_form, config)`` once, then
+    ``starting_point()`` and repeated ``iterate(state)`` calls from the
+    host driver (ipm/driver.py), finally ``to_host(state)``.
+    """
+
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
+        """Move problem data to the execution target; build/compile kernels."""
+
+    @abc.abstractmethod
+    def starting_point(self) -> IPMState:
+        """Initial strictly interior iterate (Mehrotra heuristic)."""
+
+    @abc.abstractmethod
+    def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
+        """One predictor-corrector iteration. Must not raise on numerical
+        failure — set ``stats.bad`` and return the incoming state instead,
+        so the host can escalate regularization deterministically."""
+
+    def bump_regularization(self) -> bool:
+        """Increase regularization after a bad step. Returns False when out
+        of headroom (driver then reports NUMERICAL_ERROR)."""
+        return False
+
+    def to_host(self, state: IPMState) -> IPMState:
+        """Materialize a state as host numpy arrays."""
+        return IPMState(*(np.asarray(v) for v in state))
+
+    def block_until_ready(self, obj) -> None:
+        """Synchronization barrier for timing (no-op for eager backends)."""
+
+
+_REGISTRY: Dict[str, Type[SolverBackend]] = {}
+
+
+def register_backend(*names: str) -> Callable[[Type[SolverBackend]], Type[SolverBackend]]:
+    def deco(cls: Type[SolverBackend]) -> Type[SolverBackend]:
+        for n in names:
+            key = n.lower()
+            if key in _REGISTRY and _REGISTRY[key] is not cls:
+                raise ValueError(f"backend name {n!r} already registered")
+            _REGISTRY[key] = cls
+        cls.name = names[0]
+        return cls
+
+    return deco
+
+
+def get_backend(name: str, **kwargs) -> SolverBackend:
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise KeyError(
+            f"unknown backend {name!r}; available: {', '.join(available_backends())}"
+        )
+    return _REGISTRY[key](**kwargs)
+
+
+def available_backends() -> List[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,168 @@
+"""Dense single-device JAX backend — the default TPU execution path.
+
+Implements the north-star architecture (BASELINE.json:5): the constraint
+matrix lives in device HBM; normal-equations assembly ``A·diag(d)·Aᵀ``,
+Cholesky, and the triangular solves run under one jitted step per IPM
+iteration; the Mehrotra driver stays on the host. The whole iteration is a
+single compiled XLA program so elementwise work fuses into the GEMMs and
+only :class:`StepStats` scalars cross the host↔device boundary
+(SURVEY.md §3.4).
+
+Mixed precision: with ``config.factor_dtype="float32"`` the Cholesky runs
+on the MXU in f32 and each triangular solve is polished by
+``config.refine_steps`` rounds of iterative refinement against the f64
+normal matrix — the SURVEY.md §7 mitigation for TPUs' emulated f64.
+
+Regularization is a *traced* scalar argument of the jitted step, so the
+driver's NaN-recovery escalation (reg ×= reg_grow) never recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from distributedlpsolver_tpu.backends.base import SolverBackend, register_backend
+from distributedlpsolver_tpu.ipm import core
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
+from distributedlpsolver_tpu.models.problem import InteriorForm
+
+
+def _cholesky_ops(A, factor_dtype, refine_steps):
+    """Build factorize/solve closures over a (traced) matrix ``A``.
+
+    ``factorize(d, reg)`` returns ``(L, M)`` with ``M = A·diag(d)·Aᵀ``
+    plus a per-row relative diagonal perturbation, ``M`` kept at full
+    precision for refinement and ``L`` its (possibly lower-precision)
+    Cholesky factor.
+    """
+
+    def factorize(d, reg):
+        M = (A * d[None, :]) @ A.T
+        # Per-row *relative* diagonal perturbation: with heterogeneous d the
+        # diagonal spans many orders of magnitude, and a uniform (trace- or
+        # norm-scaled) shift would swamp the small rows and wreck the
+        # Newton direction's primal-residual reduction.
+        M = M + jnp.diag(reg * jnp.diagonal(M))
+        L = jnp.linalg.cholesky(M.astype(factor_dtype))
+        return L, M
+
+    def solve(factors, rhs):
+        L, M = factors
+        lo = jax.scipy.linalg.cho_solve((L, True), rhs.astype(factor_dtype))
+        y = lo.astype(rhs.dtype)
+        for _ in range(refine_steps):
+            r = rhs - M @ y
+            y = y + jax.scipy.linalg.cho_solve((L, True), r.astype(factor_dtype)).astype(
+                rhs.dtype
+            )
+        return y
+
+    return factorize, solve
+
+
+def _make_ops(A, reg, factor_dtype, refine_steps):
+    factorize, solve = _cholesky_ops(A, factor_dtype, refine_steps)
+    return core.LinOps(
+        xp=jnp,
+        matvec=lambda v: A @ v,
+        rmatvec=lambda v: A.T @ v,
+        factorize=functools.partial(factorize, reg=reg),
+        solve=solve,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("params", "factor_dtype", "refine_steps"))
+def _dense_step(A, data, state, reg, params, factor_dtype, refine_steps):
+    ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps)
+    return core.mehrotra_step(ops, data, params, state)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "factor_dtype", "refine_steps"))
+def _dense_start(A, data, reg, params, factor_dtype, refine_steps):
+    ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps)
+    return core.starting_point(ops, data, params)
+
+
+@register_backend("tpu", "dense", "jax")
+class DenseJaxBackend(SolverBackend):
+    """Single-device dense path (afiro / random-dense configs,
+    BASELINE.json:7,9). Subclasses override :meth:`shardings` to distribute
+    the same compiled step over a mesh."""
+
+    def __init__(self):
+        self._reg: float = 0.0
+        self._cfg: Optional[SolverConfig] = None
+        self._step = None
+        self._start = None
+
+    # -- placement hooks (overridden by the sharded backend) ---------------
+    def shardings(self, m: int, n: int):
+        """Returns (matrix_sharding, col_vec_sharding, row_vec_sharding) or
+        Nones for default single-device placement."""
+        return None, None, None
+
+    def _put(self, arr, sharding):
+        return jax.device_put(arr, sharding) if sharding is not None else jnp.asarray(arr)
+
+    # -- SolverBackend ------------------------------------------------------
+    def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
+        self._cfg = config
+        self._reg = config.reg_dual
+        dtype = jnp.dtype(config.dtype)
+        factor_dtype = jnp.dtype(config.factor_dtype or config.dtype)
+        refine = config.refine_steps
+
+        A_host = inf.A.toarray() if sp.issparse(inf.A) else np.asarray(inf.A)
+        m, n = A_host.shape
+        mat_s, col_s, row_s = self.shardings(m, n)
+        A = self._put(A_host.astype(dtype), mat_s)
+        c = self._put(np.asarray(inf.c, dtype=dtype), col_s)
+        b = self._put(np.asarray(inf.b, dtype=dtype), row_s)
+        u = self._put(np.asarray(inf.u, dtype=dtype), col_s)
+        self._col_sharding = col_s
+
+        self._A = A
+        self._data = core.make_problem_data(jnp, c, b, u, dtype)
+        self._params = config.step_params()
+        self._factor_dtype_name = jnp.dtype(factor_dtype).name
+        self._refine = refine
+        self._dtype = dtype
+
+    def starting_point(self) -> IPMState:
+        state = _dense_start(
+            self._A,
+            self._data,
+            jnp.asarray(self._reg, self._dtype),
+            self._params,
+            self._factor_dtype_name,
+            self._refine,
+        )
+        jax.block_until_ready(state)
+        return state
+
+    def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
+        return _dense_step(
+            self._A,
+            self._data,
+            state,
+            jnp.asarray(self._reg, self._dtype),
+            self._params,
+            self._factor_dtype_name,
+            self._refine,
+        )
+
+    def bump_regularization(self) -> bool:
+        if self._reg * self._cfg.reg_grow > 1e-2:
+            return False
+        self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
+        return True
+
+    def block_until_ready(self, obj) -> None:
+        jax.block_until_ready(obj)

@@ -1,0 +1,5 @@
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import IPMResult, IPMState, IterRecord, Status, StepStats
+from distributedlpsolver_tpu.ipm.driver import solve
+
+__all__ = ["SolverConfig", "IPMResult", "IPMState", "IterRecord", "Status", "StepStats", "solve"]

@@ -1,0 +1,78 @@
+"""Solver configuration (SURVEY.md §5.6: flag system → frozen dataclass).
+
+One frozen dataclass carries every tunable the CLI exposes; backends receive
+it at ``setup`` time. Defaults reproduce the reference's published behavior
+(convergence at a 1e-8 duality gap, BASELINE.json:2) with TPU-appropriate
+numerics (f64 accumulation; optionally f32 factorization with iterative
+refinement on hardware where f64 is emulated).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    tol: float = 1e-8  # relative gap + infeasibility tolerance [BASELINE.json:2]
+    max_iter: int = 200
+    eta: float = 0.99995  # fraction-to-boundary damping (Mehrotra)
+    sigma_power: float = 3.0  # σ = (μ_aff/μ)^power
+    sigma_min: float = 1e-8
+    sigma_max: float = 0.99
+    gamma_cent: float = 1e-3  # N₋∞ centrality neighborhood (0 disables)
+    # Static primal regularization added to 1/d. 1e-8 caps the scaling
+    # spread d_max at ~1e8, keeping the noise floor of the normal-equations
+    # back-substitution below the 1e-8 gap tolerance; the resulting
+    # direction perturbation is corrected by kkt_refine (the regularized
+    # factorization acts as a preconditioner for true-KKT refinement).
+    reg_primal: float = 1e-8
+    reg_dual: float = 1e-10  # static dual regularization added to M's diagonal
+    reg_grow: float = 100.0  # factor applied on factorization failure
+    max_refactor: int = 5  # NaN-recovery attempts per iteration
+    dtype: str = "float64"  # iterate/residual dtype
+    factor_dtype: Optional[str] = None  # Cholesky dtype; None = same as dtype
+    refine_steps: int = 0  # normal-equations-level refinement sweeps per solve
+    kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
+    # distribution (sharded backends)
+    mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices
+    mesh_axis: str = "cols"  # axis name for the variable-sharded mesh dim
+    # diagnostics
+    verbose: bool = False
+    log_jsonl: Optional[str] = None  # per-iteration JSONL path (SURVEY.md §5.5)
+    checkpoint_path: Optional[str] = None  # iterate checkpoint (SURVEY.md §5.4)
+    checkpoint_every: int = 0  # 0 = disabled
+    profile_dir: Optional[str] = None  # jax.profiler trace dir (SURVEY.md §5.1)
+
+    def replace(self, **kw) -> "SolverConfig":
+        return dataclasses.replace(self, **kw)
+
+    def step_params(self) -> "StepParams":
+        return StepParams(
+            tol=self.tol,
+            eta=self.eta,
+            sigma_power=self.sigma_power,
+            sigma_min=self.sigma_min,
+            sigma_max=self.sigma_max,
+            gamma_cent=self.gamma_cent,
+            reg_primal=self.reg_primal,
+            kkt_refine=self.kkt_refine,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class StepParams:
+    """The numeric subset of :class:`SolverConfig` the traced step actually
+    reads. This — not the full config — is the static jit key, so changing
+    diagnostic fields (log paths, checkpoint paths, verbosity, max_iter)
+    never forces an XLA recompile."""
+
+    tol: float
+    eta: float
+    sigma_power: float
+    sigma_min: float
+    sigma_max: float
+    gamma_cent: float
+    reg_primal: float
+    kkt_refine: int

@@ -1,0 +1,326 @@
+"""Mehrotra predictor-corrector step — the algorithm core.
+
+This module is the single implementation of the IPM math, written
+array-library-generically: every function takes a :class:`LinOps` bundle
+whose ``xp`` is either ``numpy`` (eager CPU backends) or ``jax.numpy``
+(jitted TPU/device backends).  Backends differ only in how they implement
+the four linear-algebra callables — ``matvec``/``rmatvec`` with the
+constraint matrix and ``factorize``/``solve`` for the normal equations
+``M = A·diag(d)·Aᵀ`` (BASELINE.json:5 names exactly this path: normal
+equations, dense Cholesky, triangular solves).  The distributed backends
+swap in sharded arrays so XLA turns the same expressions into
+psum-combined per-shard Schur contributions (SURVEY.md §3.4).
+
+Problem form handled (ipm/state.py): ``min cᵀx  s.t. Ax=b, 0≤x, x+w=u`` on
+the columns with finite upper bound.  Columns without a finite upper bound
+carry ``w=1, z=0`` and every ``w``/``z`` term is masked by ``hub`` so the
+arithmetic stays finite under jit (no data-dependent shapes — SURVEY.md §7
+"keep shapes static").
+
+Newton system and its elimination to normal equations::
+
+    A dx               = r_p  := b - Ax
+    dx + dw            = r_u  := u - x - w          (masked)
+    Aᵀdy + ds - dz     = r_d  := c - Aᵀy - s + z
+    S dx + X ds        = r_xs := target - x∘s
+    Z dw + W dz        = r_wz := target - w∘z       (masked)
+
+    ⇒  dinv = s/x + z/w,  h = r_d - r_xs/x + (r_wz - z∘r_u)/w
+       (A·diag(1/dinv)·Aᵀ) dy = r_p + A(h/dinv)
+       dx = (Aᵀdy - h)/dinv ;  ds = (r_xs - s∘dx)/x
+       dw = r_u - dx ;  dz = (r_wz - z∘dw)/w
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+from distributedlpsolver_tpu.ipm.config import StepParams
+from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
+
+
+class LinOps(NamedTuple):
+    """Backend linear-algebra seam (SURVEY.md §1 L3 — the `SolverBackend`
+    interface's execution half)."""
+
+    xp: Any  # numpy or jax.numpy
+    matvec: Callable[[Any], Any]  # v ↦ A @ v           (n,) → (m,)
+    rmatvec: Callable[[Any], Any]  # v ↦ Aᵀ @ v          (m,) → (n,)
+    factorize: Callable[[Any], Any]  # d ↦ factors of A·diag(d)·Aᵀ (+ reg)
+    solve: Callable[[Any, Any], Any]  # (factors, rhs) ↦ M⁻¹ rhs
+
+
+class ProblemData(NamedTuple):
+    """Problem vectors as backend arrays. ``u_f`` is the upper-bound vector
+    with +inf replaced by 1.0; ``hub`` the finite-ub mask as 0/1 floats."""
+
+    c: Any  # (n,)
+    b: Any  # (m,)
+    u_f: Any  # (n,)
+    hub: Any  # (n,)
+    ncomp: Any  # scalar: n + #finite-ub (complementarity pair count)
+    norm_b: Any  # scalar: 1 + ||b||₂
+    norm_c: Any  # scalar: 1 + ||c||₂
+
+
+def make_problem_data(xp, c, b, u, dtype) -> ProblemData:
+    c = xp.asarray(c, dtype=dtype)
+    b = xp.asarray(b, dtype=dtype)
+    u = xp.asarray(u, dtype=dtype)
+    hub = xp.isfinite(u).astype(dtype)
+    u_f = xp.where(hub > 0, u, xp.asarray(1.0, dtype=dtype))
+    return ProblemData(
+        c=c,
+        b=b,
+        u_f=u_f,
+        hub=hub,
+        ncomp=c.shape[0] + xp.sum(hub),
+        norm_b=1.0 + xp.linalg.norm(b),
+        norm_c=1.0 + xp.linalg.norm(c),
+    )
+
+
+def _solve_kkt_once(ops: LinOps, state: IPMState, hub, d, factors, r_p, r_u, r_d, r_xs, r_wz):
+    """Back-substitute one Newton solve through the normal equations."""
+    x, y, s, w, z = state
+    h = r_d - r_xs / x + (r_wz - z * r_u) / w
+    dy = ops.solve(factors, r_p + ops.matvec(d * h))
+    dx = d * (ops.rmatvec(dy) - h)
+    ds = (r_xs - s * dx) / x
+    dw = r_u - dx
+    dz = hub * (r_wz - z * dw) / w
+    return dx, dy, ds, dw, dz
+
+
+def _solve_kkt(
+    ops: LinOps, state: IPMState, hub, d, factors, r_p, r_u, r_d, r_xs, r_wz, refine: int
+):
+    """Newton solve + ``refine`` rounds of KKT-level iterative refinement.
+
+    Near convergence the scaling ``d`` spans ~1/μ orders of magnitude and
+    the back-substitution ``dx = d·(Aᵀdy - h)`` loses ~μ⁻¹·ε of absolute
+    accuracy to cancellation, which stalls primal feasibility around 1e-6
+    (observed; refinement of the *normal-equations* solve alone cannot fix
+    it). Re-evaluating the full 5-block KKT residual and solving for a
+    correction restores the lost digits at the cost of one extra
+    factorization-reuse solve per round.
+    """
+    x, y, s, w, z = state
+    dx, dy, ds, dw, dz = _solve_kkt_once(
+        ops, state, hub, d, factors, r_p, r_u, r_d, r_xs, r_wz
+    )
+    for _ in range(refine):
+        e_p = r_p - ops.matvec(dx)
+        e_u = hub * (r_u - (dx + dw))
+        e_d = r_d - (ops.rmatvec(dy) + ds - dz)
+        e_xs = r_xs - (s * dx + x * ds)
+        e_wz = hub * (r_wz - (z * dw + w * dz))
+        cx, cy, cs, cw, cz = _solve_kkt_once(
+            ops, state, hub, d, factors, e_p, e_u, e_d, e_xs, e_wz
+        )
+        dx, dy, ds, dw, dz = dx + cx, dy + cy, ds + cs, dw + cw, dz + cz
+    return dx, dy, ds, dw, dz
+
+
+def _max_step(xp, v, dv, v2, dv2, mask):
+    """Largest α ≤ 1 with v+αdv ≥ 0 and (masked) v2+αdv2 ≥ 0 (ratio test,
+    kept on device — SURVEY.md §7 'step-length reductions ... return only
+    scalars')."""
+    inf = xp.asarray(xp.inf, dtype=v.dtype)
+    r1 = xp.where(dv < 0, -v / xp.where(dv < 0, dv, -1.0), inf)
+    neg2 = (dv2 < 0) & (mask > 0)
+    r2 = xp.where(neg2, -v2 / xp.where(neg2, dv2, -1.0), inf)
+    return xp.minimum(1.0, xp.minimum(xp.min(r1), xp.min(r2)))
+
+
+def _centrality_backoff(xp, state, hub, dirs, ap_max, ad_max, ncomp, gamma):
+    """N₋∞(γ) neighborhood guard: damp the steps until no complementarity
+    product falls below γ·μ(α).
+
+    Iterates that stray orders of magnitude *below* the average
+    complementarity create the extreme scaling spreads (d_max/d_min ≳ 1e18)
+    that make the f64 normal equations unable to repair primal
+    infeasibility — once injured, pinf freezes around 1e-6 (observed).
+    Keeping products within γ of μ bounds the spread and prevents the
+    injury. Implemented jit-style: evaluate a geometric grid of 24 damped
+    (α_p, α_d) candidates at once and pick the least-damped admissible one
+    — no data-dependent control flow (SURVEY.md §7).
+    """
+    if gamma <= 0:
+        return ap_max, ad_max
+    x, y, s, w, z = state
+    dx, ds, dw, dz = dirs
+    fac = 0.8 ** xp.arange(24, dtype=x.dtype)
+    aps = ap_max * fac
+    ads = ad_max * fac
+    xs = (x[None, :] + aps[:, None] * dx[None, :]) * (
+        s[None, :] + ads[:, None] * ds[None, :]
+    )
+    wz = (w[None, :] + aps[:, None] * dw[None, :]) * (
+        z[None, :] + ads[:, None] * dz[None, :]
+    )
+    comp = xs.sum(axis=1) + (wz * hub[None, :]).sum(axis=1)
+    mu_a = comp / ncomp
+    inf_ = xp.asarray(xp.inf, dtype=x.dtype)
+    minprod = xp.minimum(
+        xs.min(axis=1), xp.where(hub[None, :] > 0, wz, inf_).min(axis=1)
+    )
+    ok = minprod >= gamma * mu_a
+    # Least-damped admissible candidate; fall back to the most damped one.
+    idx = xp.argmax(ok)
+    idx = xp.where(xp.any(ok), idx, len(fac) - 1)
+    return aps[idx], ads[idx]
+
+
+def residual_norms(ops: LinOps, data: ProblemData, state: IPMState):
+    """Relative primal/dual infeasibility, gap, and objectives of a state."""
+    xp = ops.xp
+    x, y, s, w, z = state
+    r_p = data.b - ops.matvec(x)
+    r_u = data.hub * (data.u_f - x - w)
+    r_d = data.c - ops.rmatvec(y) - s + z
+    pinf = xp.sqrt(xp.sum(r_p * r_p) + xp.sum(r_u * r_u)) / data.norm_b
+    dinf = xp.linalg.norm(r_d) / data.norm_c
+    pobj = data.c @ x
+    dobj = data.b @ y - (data.hub * data.u_f) @ z
+    gap = xp.abs(pobj - dobj)
+    rel_gap = gap / (1.0 + xp.abs(pobj))
+    mu = (x @ s + (data.hub * w) @ z) / data.ncomp
+    return pinf, dinf, gap, rel_gap, pobj, dobj, mu
+
+
+def mehrotra_step(
+    ops: LinOps, data: ProblemData, cfg: StepParams, state: IPMState
+):
+    """One full predictor-corrector iteration: state ↦ (state', stats).
+
+    Everything here runs on the backend's device(s) in one traced call; only
+    the :class:`StepStats` scalars cross back to the host loop
+    (BASELINE.json:5: driver on host, linear algebra on device).
+    """
+    xp = ops.xp
+    x, y, s, w, z = state
+    hub, u_f, c, b = data.hub, data.u_f, data.c, data.b
+
+    # Residuals of the current iterate.
+    r_p = b - ops.matvec(x)
+    r_u = hub * (u_f - x - w)
+    r_d = c - ops.rmatvec(y) - s + z
+    mu = (x @ s + (hub * w) @ z) / data.ncomp
+
+    # Diagonal scaling and one factorization, shared by both solves.
+    dinv = s / x + hub * z / w + cfg.reg_primal
+    d = 1.0 / dinv
+    factors = ops.factorize(d)
+
+    # Predictor (affine-scaling) direction.
+    rxs_aff = -x * s
+    rwz_aff = -(w * z) * hub
+    dxa, dya, dsa, dwa, dza = _solve_kkt(
+        ops, state, hub, d, factors, r_p, r_u, r_d, rxs_aff, rwz_aff, cfg.kkt_refine
+    )
+    ap_aff = _max_step(xp, x, dxa, w, dwa, hub)
+    ad_aff = _max_step(xp, s, dsa, z, dza, hub)
+    mu_aff = (
+        (x + ap_aff * dxa) @ (s + ad_aff * dsa)
+        + ((w + ap_aff * dwa) * (z + ad_aff * dza)) @ hub
+    ) / data.ncomp
+    sigma = xp.clip(
+        (xp.maximum(mu_aff, 0.0) / mu) ** cfg.sigma_power, cfg.sigma_min, cfg.sigma_max
+    )
+
+    # Aim the centering target at the convergence tolerance, not at zero:
+    # letting μ overshoot orders of magnitude below what a 1e-8 relative
+    # gap needs pushes the scaling spread d_max/d_min past what f64 can
+    # factor, and the *feasibility* components of subsequent directions
+    # collapse (observed: pinf jumps 1e-9 → 5e-6 and freezes). 0.03·tol
+    # keeps a safe 30× margin below the gap test.
+    pobj_now = c @ x
+    mu_floor = 0.03 * cfg.tol * (1.0 + xp.abs(pobj_now)) / data.ncomp
+    target = xp.maximum(sigma * mu, mu_floor)
+
+    # Corrector: recenter to the target and cancel the second-order term,
+    # reusing the factorization (the defining Mehrotra move, BASELINE.json:5).
+    rxs = target - x * s - dxa * dsa
+    rwz = hub * (target - w * z - dwa * dza)
+    dx, dy, ds, dw, dz = _solve_kkt(
+        ops, state, hub, d, factors, r_p, r_u, r_d, rxs, rwz, cfg.kkt_refine
+    )
+
+    alpha_p = xp.minimum(1.0, cfg.eta * _max_step(xp, x, dx, w, dw, hub))
+    alpha_d = xp.minimum(1.0, cfg.eta * _max_step(xp, s, ds, z, dz, hub))
+    alpha_p, alpha_d = _centrality_backoff(
+        xp, state, hub, (dx, ds, dw, dz), alpha_p, alpha_d, data.ncomp, cfg.gamma_cent
+    )
+
+    finite = (
+        xp.all(xp.isfinite(dx))
+        & xp.all(xp.isfinite(dy))
+        & xp.all(xp.isfinite(ds))
+        & xp.all(xp.isfinite(dw))
+        & xp.all(xp.isfinite(dz))
+    )
+    ok = finite & (alpha_p > 0) & (alpha_d > 0)
+
+    def upd(v, dv, a):
+        return xp.where(ok, v + a * dv, v)
+
+    x1 = upd(x, dx, alpha_p)
+    w1 = xp.where(hub > 0, upd(w, dw, alpha_p), 1.0)
+    y1 = upd(y, dy, alpha_d)
+    s1 = upd(s, ds, alpha_d)
+    z1 = xp.where(hub > 0, upd(z, dz, alpha_d), 0.0)
+    new_state = IPMState(x=x1, y=y1, s=s1, w=w1, z=z1)
+
+    pinf, dinf, gap, rel_gap, pobj, dobj, mu1 = residual_norms(ops, data, new_state)
+    stats = StepStats(
+        mu=mu1,
+        gap=gap,
+        rel_gap=rel_gap,
+        pinf=pinf,
+        dinf=dinf,
+        pobj=pobj,
+        dobj=dobj,
+        alpha_p=xp.where(ok, alpha_p, 0.0),
+        alpha_d=xp.where(ok, alpha_d, 0.0),
+        sigma=sigma,
+        bad=~ok,
+    )
+    return new_state, stats
+
+
+def starting_point(ops: LinOps, data: ProblemData, cfg: StepParams) -> IPMState:
+    """Mehrotra's least-squares starting point, extended to upper bounds.
+
+    ``x̂ = Aᵀ(AAᵀ)⁻¹b`` (min-norm primal), ``ŷ = (AAᵀ)⁻¹Ac``, ``ŝ = c-Aᵀŷ``,
+    then positive shifts sized so initial complementarity is balanced
+    (Mehrotra 1992 §7 heuristic — standard, SURVEY.md §2 [INFERRED]).
+    Bounded columns are clamped into (5%, 95%) of [0, u] and their dual is
+    split ``s-z = ŝ`` with both parts positive, so r_d starts at 0 there.
+    """
+    xp = ops.xp
+    c, b, u_f, hub = data.c, data.b, data.u_f, data.hub
+    ones = xp.ones_like(c)
+    factors = ops.factorize(ones)
+    x_hat = ops.rmatvec(ops.solve(factors, b))
+    y_hat = ops.solve(factors, ops.matvec(c))
+    s_hat = c - ops.rmatvec(y_hat)
+
+    dx = xp.maximum(-1.5 * xp.min(x_hat), 0.0)
+    ds = xp.maximum(-1.5 * xp.min(s_hat), 0.0)
+    x1 = x_hat + dx
+    s1 = s_hat + ds
+    xs = x1 @ s1
+    dx_hat = dx + 0.5 * xs / xp.maximum(xp.sum(s1), 1e-30)
+    ds_hat = ds + 0.5 * xs / xp.maximum(xp.sum(x1), 1e-30)
+    floor = xp.asarray(1.0, dtype=c.dtype)
+    x0 = xp.maximum(x_hat + dx_hat, floor * 1e-2)
+    s0_free = xp.maximum(s_hat + ds_hat, floor * 1e-2)
+
+    # Bounded columns: interior of [0, u] and positive dual split.
+    x0 = xp.where(hub > 0, xp.clip(x0, 0.05 * u_f, 0.95 * u_f), x0)
+    w0 = xp.where(hub > 0, u_f - x0, 1.0)
+    pad = 1.0 + xp.abs(s_hat)
+    s0 = xp.where(hub > 0, xp.maximum(s_hat, 0.0) + 0.1 * pad, s0_free)
+    z0 = xp.where(hub > 0, s0 - s_hat, 0.0)
+    return IPMState(x=x0, y=y_hat, s=s0, w=w0, z=z0)

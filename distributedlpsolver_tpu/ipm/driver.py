@@ -1,0 +1,173 @@
+"""Host-side Mehrotra driver loop (SURVEY.md §1 L4, §3.1).
+
+The outer predictor-corrector loop runs on the host (BASELINE.json:5: "the
+Mehrotra predictor-corrector driver and step-length line search stay on
+the host"); each ``backend.iterate`` call executes one full iteration on
+the execution target and returns only convergence scalars. The driver owns
+convergence testing at the 1e-8 duality gap (BASELINE.json:2), numerical-
+failure recovery (deterministic regularization escalation), per-iteration
+logging, checkpoint/resume, and recovery of the solution in the original
+variable space.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional, Union
+
+import numpy as np
+
+from typing import TYPE_CHECKING
+
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+
+if TYPE_CHECKING:  # real import is deferred to solve() — backends import ipm
+    from distributedlpsolver_tpu.backends.base import SolverBackend
+from distributedlpsolver_tpu.ipm.state import (
+    IPMResult,
+    IPMState,
+    IterRecord,
+    Status,
+)
+from distributedlpsolver_tpu.models.problem import (
+    InteriorForm,
+    LPProblem,
+    to_interior_form,
+)
+from distributedlpsolver_tpu.utils import checkpoint as ckpt
+from distributedlpsolver_tpu.utils.logging import IterLogger
+
+_DIVERGE = 1e30
+
+
+def solve(
+    problem: Union[LPProblem, InteriorForm],
+    backend: Union[str, "SolverBackend"] = "tpu",
+    config: Optional[SolverConfig] = None,
+    warm_start: Optional[IPMState] = None,
+    **config_overrides,
+) -> IPMResult:
+    """Solve an LP to the configured duality-gap tolerance.
+
+    ``problem`` may be a general-form :class:`LPProblem` (converted via
+    :func:`to_interior_form`; solution is recovered in the original space)
+    or an :class:`InteriorForm` directly. ``backend`` is a registry name
+    (``--backend=`` in the CLI, BASELINE.json:5) or an instance.
+    """
+    from distributedlpsolver_tpu.backends.base import get_backend
+
+    cfg = config or SolverConfig()
+    if config_overrides:
+        cfg = cfg.replace(**config_overrides)
+
+    original: Optional[LPProblem] = problem if isinstance(problem, LPProblem) else None
+    inf = to_interior_form(problem) if isinstance(problem, LPProblem) else problem
+
+    be = get_backend(backend) if isinstance(backend, str) else backend
+    logger = IterLogger(cfg.verbose, cfg.log_jsonl)
+
+    t_setup0 = time.perf_counter()
+    be.setup(inf, cfg)
+    resumed = ckpt.maybe_load(cfg.checkpoint_path) if warm_start is None else None
+    if warm_start is not None:
+        state, start_iter = warm_start, 0
+    elif (
+        resumed is not None
+        and resumed[2] == inf.name
+        and resumed[0].x.shape == (inf.n,)
+        and resumed[0].y.shape == (inf.m,)
+    ):
+        state, start_iter = resumed[0], resumed[1]
+    else:
+        state, start_iter = be.starting_point(), 0
+    setup_time = time.perf_counter() - t_setup0
+
+    status = Status.ITERATION_LIMIT
+    history = []
+    last = None
+    it = start_iter
+    t_solve0 = time.perf_counter()
+    profile_stack = contextlib.ExitStack()
+    try:
+        profile_stack.enter_context(_maybe_profiler(cfg.profile_dir))
+        while it < cfg.max_iter:
+            t_it0 = time.perf_counter()
+            refactor = 0
+            while True:
+                new_state, stats = be.iterate(state)
+                be.block_until_ready(stats.mu)
+                bad = bool(stats.bad)
+                if not bad:
+                    break
+                refactor += 1
+                if refactor > cfg.max_refactor or not be.bump_regularization():
+                    status = Status.NUMERICAL_ERROR
+                    break
+            if bad:
+                break
+            state = new_state
+            it += 1
+            t_it = time.perf_counter() - t_it0
+            last = _to_floats(stats)
+            rec = IterRecord(iter=it, t_iter=t_it, **last)
+            history.append(rec)
+            logger.log(rec)
+            if cfg.checkpoint_every and it % cfg.checkpoint_every == 0 and cfg.checkpoint_path:
+                ckpt.save_state(cfg.checkpoint_path, be.to_host(state), it, inf.name)
+            if (
+                last["rel_gap"] <= cfg.tol
+                and last["pinf"] <= cfg.tol
+                and last["dinf"] <= cfg.tol
+            ):
+                status = Status.OPTIMAL
+                break
+            if not np.isfinite(last["mu"]) or last["mu"] > _DIVERGE:
+                status = Status.NUMERICAL_ERROR
+                break
+    finally:
+        profile_stack.close()
+        solve_time = time.perf_counter() - t_solve0
+        logger.close()
+
+    host = be.to_host(state)
+    x_t = np.asarray(host.x, dtype=np.float64)
+    obj_min = inf.objective(x_t)
+    if original is not None:
+        x_orig = inf.recover(x_t)
+        objective = -obj_min if original.maximize else obj_min
+    else:
+        x_orig = x_t
+        objective = obj_min
+
+    return IPMResult(
+        status=status,
+        x=x_orig,
+        objective=objective,
+        iterations=it - start_iter,
+        rel_gap=last["rel_gap"] if last else np.inf,
+        pinf=last["pinf"] if last else np.inf,
+        dinf=last["dinf"] if last else np.inf,
+        solve_time=solve_time,
+        setup_time=setup_time,
+        history=history,
+        backend=getattr(be, "name", str(backend)),
+        name=inf.name,
+        y=np.asarray(host.y, dtype=np.float64),
+        s=np.asarray(host.s, dtype=np.float64),
+    )
+
+
+def _to_floats(stats):
+    d = {f: float(np.asarray(getattr(stats, f))) for f in stats._fields if f != "bad"}
+    return d
+
+
+def _maybe_profiler(profile_dir: Optional[str]):
+    if profile_dir:
+        import jax
+
+        return jax.profiler.trace(profile_dir)
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -1,0 +1,108 @@
+"""IPM iterate state, per-iteration stats, and solve results.
+
+SURVEY.md §1 notes every IPM solver has a "solution/status" layer shared
+between the algorithm driver and the CLI; this is ours. The fields mirror
+the reference's published metric surface — iteration count, duality-gap
+trajectory, primal/dual infeasibility, wall-clock (BASELINE.json:2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, List, NamedTuple, Optional
+
+import numpy as np
+
+
+class IPMState(NamedTuple):
+    """Primal-dual iterate for ``min cᵀx s.t. Ax=b, 0≤x, x+w=u (bounded set)``.
+
+    ``w``/``z`` are the upper-bound slack and its dual; on columns without a
+    finite upper bound they are pinned to (1, 0) so masked arithmetic stays
+    finite (see ipm/core.py).
+    """
+
+    x: Any  # (n,) primal
+    y: Any  # (m,) equality duals
+    s: Any  # (n,) reduced costs (duals of x ≥ 0)
+    w: Any  # (n,) upper-bound slack u - x (1 where no ub)
+    z: Any  # (n,) duals of x ≤ u (0 where no ub)
+
+
+class StepStats(NamedTuple):
+    """Scalars returned to the host after each device step."""
+
+    mu: Any  # complementarity measure
+    gap: Any  # absolute duality gap |pobj - dobj|
+    rel_gap: Any
+    pinf: Any  # relative primal infeasibility
+    dinf: Any  # relative dual infeasibility
+    pobj: Any
+    dobj: Any
+    alpha_p: Any
+    alpha_d: Any
+    sigma: Any
+    bad: Any  # bool: factorization/solve produced non-finite direction
+
+
+class Status(enum.Enum):
+    OPTIMAL = "optimal"
+    ITERATION_LIMIT = "iteration_limit"
+    NUMERICAL_ERROR = "numerical_error"
+    PRIMAL_INFEASIBLE = "primal_infeasible"
+    DUAL_INFEASIBLE = "dual_infeasible"  # == primal unbounded
+
+
+@dataclasses.dataclass
+class IterRecord:
+    """One row of the per-iteration log (SURVEY.md §5.5)."""
+
+    iter: int
+    mu: float
+    gap: float
+    rel_gap: float
+    pinf: float
+    dinf: float
+    alpha_p: float
+    alpha_d: float
+    sigma: float
+    pobj: float
+    dobj: float
+    t_iter: float  # seconds, device-synchronized
+
+    def asdict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class IPMResult:
+    """Solve outcome in the *original* problem space."""
+
+    status: Status
+    x: Optional[np.ndarray]  # original-variable primal solution
+    objective: float  # original objective (sense-corrected)
+    iterations: int
+    rel_gap: float
+    pinf: float
+    dinf: float
+    solve_time: float  # seconds, excludes setup/compile
+    setup_time: float  # seconds (includes jit compile)
+    history: List[IterRecord] = dataclasses.field(default_factory=list)
+    backend: str = ""
+    name: str = ""
+    # interior-form artifacts for diagnostics / warm restart
+    y: Optional[np.ndarray] = None
+    s: Optional[np.ndarray] = None
+
+    @property
+    def iters_per_sec(self) -> float:
+        return self.iterations / self.solve_time if self.solve_time > 0 else 0.0
+
+    def summary(self) -> str:
+        return (
+            f"{self.name or 'LP'}: {self.status.value} obj={self.objective:.10g} "
+            f"iters={self.iterations} gap={self.rel_gap:.2e} pinf={self.pinf:.2e} "
+            f"dinf={self.dinf:.2e} time={self.solve_time:.3f}s "
+            f"({self.iters_per_sec:.1f} it/s) backend={self.backend}"
+        )

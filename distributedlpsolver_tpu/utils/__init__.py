@@ -1,0 +1,4 @@
+from distributedlpsolver_tpu.utils.checkpoint import load_state, save_state
+from distributedlpsolver_tpu.utils.logging import IterLogger
+
+__all__ = ["IterLogger", "save_state", "load_state"]

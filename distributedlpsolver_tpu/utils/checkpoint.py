@@ -1,0 +1,45 @@
+"""Iterate checkpoint/resume (SURVEY.md §5.4).
+
+IPM state is tiny — (x, y, s, w, z) plus the iteration counter — so a
+plain ``.npz`` with atomic rename is the honest mechanism; no Orbax
+machinery is warranted for five vectors. The driver writes every
+``config.checkpoint_every`` iterations and :func:`load_state` lets a solve
+resume with ``warm_start=``.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+from distributedlpsolver_tpu.ipm.state import IPMState
+
+
+def save_state(path: str, state: IPMState, iteration: int, name: str = "") -> None:
+    arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez(fh, iteration=iteration, name=name, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_state(path: str) -> Tuple[IPMState, int, str]:
+    with np.load(path, allow_pickle=False) as data:
+        state = IPMState(*(data[f] for f in IPMState._fields))
+        return state, int(data["iteration"]), str(data["name"])
+
+
+def maybe_load(path: Optional[str]) -> Optional[Tuple[IPMState, int, str]]:
+    if path and os.path.exists(path):
+        return load_state(path)
+    return None

@@ -1,0 +1,46 @@
+"""Structured per-iteration metrics (SURVEY.md §5.5).
+
+The reference's published metric is "IPM iters/sec + wall-clock to 1e-8
+duality gap" (BASELINE.json:2), which implies per-iteration reporting of
+iteration count, gap trajectory, and timing. We emit both a human log line
+and an optional JSONL stream, one record per iteration.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, TextIO
+
+from distributedlpsolver_tpu.ipm.state import IterRecord
+
+_HEADER = (
+    f"{'it':>4} {'mu':>10} {'rel_gap':>10} {'pinf':>10} {'dinf':>10} "
+    f"{'a_p':>6} {'a_d':>6} {'sigma':>8} {'pobj':>14} {'t_iter':>8}"
+)
+
+
+class IterLogger:
+    def __init__(self, verbose: bool = False, jsonl_path: Optional[str] = None):
+        self.verbose = verbose
+        self._fh: Optional[TextIO] = open(jsonl_path, "w") if jsonl_path else None
+        self._printed_header = False
+
+    def log(self, rec: IterRecord) -> None:
+        if self.verbose:
+            if not self._printed_header:
+                print(_HEADER)
+                self._printed_header = True
+            print(
+                f"{rec.iter:>4} {rec.mu:>10.2e} {rec.rel_gap:>10.2e} "
+                f"{rec.pinf:>10.2e} {rec.dinf:>10.2e} {rec.alpha_p:>6.3f} "
+                f"{rec.alpha_d:>6.3f} {rec.sigma:>8.1e} {rec.pobj:>14.6e} "
+                f"{rec.t_iter:>8.4f}"
+            )
+        if self._fh:
+            self._fh.write(json.dumps(rec.asdict()) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
