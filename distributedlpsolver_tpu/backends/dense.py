@@ -108,6 +108,11 @@ class DenseJaxBackend(SolverBackend):
         Nones for default single-device placement."""
         return None, None, None
 
+    def pad_multiple(self) -> int:
+        """Column count is padded to a multiple of this (sharded backends
+        need the variable axis divisible by the mesh)."""
+        return 1
+
     def _put(self, arr, sharding):
         return jax.device_put(arr, sharding) if sharding is not None else jnp.asarray(arr)
 
@@ -121,11 +126,23 @@ class DenseJaxBackend(SolverBackend):
 
         A_host = inf.A.toarray() if sp.issparse(inf.A) else np.asarray(inf.A)
         m, n = A_host.shape
+        c_host = np.asarray(inf.c, dtype=np.float64)
+        u_host = np.asarray(inf.u, dtype=np.float64)
+        self._n_orig = n
+        # Pad the variable axis to the mesh multiple with zero columns
+        # (cost 1, unbounded): they stay centered at x≈target, never bind,
+        # and are sliced off in to_host.
+        n_extra = (-n) % self.pad_multiple()
+        if n_extra:
+            A_host = np.hstack([A_host, np.zeros((m, n_extra))])
+            c_host = np.concatenate([c_host, np.ones(n_extra)])
+            u_host = np.concatenate([u_host, np.full(n_extra, np.inf)])
+            n += n_extra
         mat_s, col_s, row_s = self.shardings(m, n)
         A = self._put(A_host.astype(dtype), mat_s)
-        c = self._put(np.asarray(inf.c, dtype=dtype), col_s)
+        c = self._put(c_host.astype(dtype), col_s)
         b = self._put(np.asarray(inf.b, dtype=dtype), row_s)
-        u = self._put(np.asarray(inf.u, dtype=dtype), col_s)
+        u = self._put(u_host.astype(dtype), col_s)
         self._col_sharding = col_s
 
         self._A = A
@@ -163,6 +180,29 @@ class DenseJaxBackend(SolverBackend):
             return False
         self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
         return True
+
+    def to_host(self, state: IPMState) -> IPMState:
+        n = self._n_orig
+        return IPMState(
+            x=np.asarray(state.x)[:n],
+            y=np.asarray(state.y),
+            s=np.asarray(state.s)[:n],
+            w=np.asarray(state.w)[:n],
+            z=np.asarray(state.z)[:n],
+        )
+
+    def from_host(self, state: IPMState) -> IPMState:
+        n_extra = self._data.c.shape[0] - self._n_orig
+        x, y, s, w, z = (np.asarray(v, dtype=self._dtype) for v in state)
+        if n_extra:
+            # Padded columns (cost 1, zero A column): re-enter centered.
+            x = np.concatenate([x, np.full(n_extra, 1e-8)])
+            s = np.concatenate([s, np.ones(n_extra)])
+            w = np.concatenate([w, np.ones(n_extra)])
+            z = np.concatenate([z, np.zeros(n_extra)])
+        col_s = self._col_sharding
+        put = lambda v: jax.device_put(v, col_s) if col_s is not None else jnp.asarray(v)
+        return IPMState(x=put(x), y=jnp.asarray(y), s=put(s), w=put(w), z=put(z))
 
     def block_until_ready(self, obj) -> None:
         jax.block_until_ready(obj)

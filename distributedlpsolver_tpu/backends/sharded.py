@@ -1,0 +1,74 @@
+"""Distributed backend: constraint matrix sharded over a TPU mesh.
+
+This is the north-star distributed path (BASELINE.json:5): the reference
+row-partitions the constraint matrix across MPI ranks and Allreduces the
+per-rank Schur/normal-equation contributions every iteration; here the
+same dataflow is expressed by *sharding* — ``A`` is partitioned along its
+variable axis over the mesh, each device holds the column block ``A_k``
+and the diagonal block ``d_k``, and XLA compiles ``(A*d) @ A.T`` into
+per-device ``A_k·diag(d_k)·A_kᵀ`` GEMMs plus one all-reduce over ICI —
+exactly the reference's ``MPI_Allreduce`` of Schur blocks, inserted by
+the compiler instead of called by hand (SURVEY.md §3.4, §5.8).
+
+Why the *variable* axis: the normal equations ``M = Σ_k A_k D_k A_kᵀ``
+decompose into a sum over column blocks, which is the Allreduce-combined
+decomposition; vectors x/s/w/z/c/u shard with the columns, y/b stay
+replicated, and the m×m Cholesky is computed replicated on every device
+(the reference replicates its factorization across ranks the same way,
+SURVEY.md §3.2). The reference's "rows" are this backend's columns purely
+because the reference partitions Aᵀ's rows — the dataflow is identical.
+
+The entire Mehrotra step — including both ratio tests and the centrality
+guard, which become all-reduce-min reductions — is ONE jitted SPMD
+program per iteration; only StepStats scalars return to the host.
+
+Runs unchanged on a v5e ICI mesh or on N virtual CPU host devices
+(``xla_force_host_platform_device_count``, SURVEY.md §4), which is how
+the tests and the multi-chip dry-run exercise it without a pod.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from distributedlpsolver_tpu.backends.base import register_backend
+from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+
+@register_backend("sharded", "tpu-sharded", "mesh")
+class ShardedJaxBackend(DenseJaxBackend):
+    """Same compiled step as the dense backend, distributed placement.
+
+    The step math lives in ipm/core.py; distribution is purely a matter of
+    the shardings chosen here — the idiomatic-TPU restatement of the
+    reference's backend split (same algorithm, different execution).
+    """
+
+    def __init__(self, mesh: Optional[jax.sharding.Mesh] = None):
+        super().__init__()
+        self._mesh = mesh
+
+    def setup(self, inf, config):
+        if self._mesh is None:
+            self._mesh = mesh_lib.make_mesh(
+                config.mesh_shape, axis_names=(config.mesh_axis,)
+            )
+        self._axis = self._mesh.axis_names[0]
+        super().setup(inf, config)
+
+    def pad_multiple(self) -> int:
+        return self._mesh.shape[self._axis]
+
+    def shardings(self, m: int, n: int) -> Tuple:
+        return (
+            mesh_lib.col_sharding(self._mesh, self._axis),
+            mesh_lib.vec_sharding(self._mesh, self._axis),
+            mesh_lib.replicated(self._mesh),
+        )
+
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        return self._mesh

@@ -71,14 +71,14 @@ def solve(
     be.setup(inf, cfg)
     resumed = ckpt.maybe_load(cfg.checkpoint_path) if warm_start is None else None
     if warm_start is not None:
-        state, start_iter = warm_start, 0
+        state, start_iter = be.from_host(warm_start), 0
     elif (
         resumed is not None
         and resumed[2] == inf.name
         and resumed[0].x.shape == (inf.n,)
         and resumed[0].y.shape == (inf.m,)
     ):
-        state, start_iter = resumed[0], resumed[1]
+        state, start_iter = be.from_host(resumed[0]), resumed[1]
     else:
         state, start_iter = be.starting_point(), 0
     setup_time = time.perf_counter() - t_setup0

@@ -1,0 +1,8 @@
+from distributedlpsolver_tpu.parallel.mesh import (
+    col_sharding,
+    make_mesh,
+    replicated,
+    vec_sharding,
+)
+
+__all__ = ["make_mesh", "col_sharding", "vec_sharding", "replicated"]

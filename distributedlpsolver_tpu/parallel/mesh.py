@@ -1,0 +1,63 @@
+"""Device-mesh helpers — the communication layer of the rebuild.
+
+The reference's distribution stack is MPI: the constraint matrix is
+partitioned across ranks and Schur-complement / normal-equation
+contributions are combined with a per-iteration ``MPI_Allreduce``
+(BASELINE.json:5,8). The TPU-native equivalent is *declarative*: build a
+``jax.sharding.Mesh`` over the ICI domain, annotate array placements, and
+let XLA insert the all-reduce where the sharded contraction demands it
+(SURVEY.md §5.8 — "the XLA compiler + ICI *is* the backend"). There is no
+explicit collective call anywhere in the solver: ``(A_sharded * d) @
+A_sharded.T`` *is* the Allreduce of per-shard ``A_k·diag(d_k)·A_kᵀ``
+blocks.
+
+These helpers exist so every backend builds meshes the same way and so
+tests can force a specific device count (8 virtual CPU devices,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = ("cols",),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all local devices).
+
+    ``shape=None`` uses a 1-D mesh over every device — the row/column
+    partition analogue of the reference's ``mpirun -np N`` world. Multi-axis
+    shapes (e.g. ``(4, 2)`` with ``axis_names=("cols", "rows")``) support 2-D
+    sharding of the normal matrix (SURVEY.md §2.2 "tensor parallel
+    analogue").
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devs),)
+    if int(np.prod(shape)) != len(devs):
+        raise ValueError(f"mesh shape {shape} != device count {len(devs)}")
+    if len(shape) != len(axis_names):
+        raise ValueError(f"shape {shape} vs axis names {tuple(axis_names)}")
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def col_sharding(mesh: Mesh, axis: str = "cols") -> NamedSharding:
+    """(m, n) matrix sharded along its variable (column) dimension."""
+    return NamedSharding(mesh, PartitionSpec(None, axis))
+
+
+def vec_sharding(mesh: Mesh, axis: str = "cols") -> NamedSharding:
+    """(n,) vector sharded along the same variable axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
