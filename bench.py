@@ -37,7 +37,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (smoke)")
     ap.add_argument("--backend", default="tpu")
-    ap.add_argument("--baseline-backend", default="cpu")
+    ap.add_argument("--baseline-backend", default="cpu-native")
     ap.add_argument("--mps", default=None, help="bench this MPS file instead")
     args = ap.parse_args()
 

@@ -10,3 +10,5 @@ import distributedlpsolver_tpu.backends.dense  # noqa: F401  (registers tpu/dens
 
 __all__ = ["SolverBackend", "available_backends", "get_backend", "register_backend"]
 import distributedlpsolver_tpu.backends.sharded  # noqa: F401  (registers sharded/mesh)
+import distributedlpsolver_tpu.backends.cpu  # noqa: F401  (registers cpu/numpy/scipy)
+import distributedlpsolver_tpu.backends.cpu_native  # noqa: F401  (registers cpu-native)

@@ -1,0 +1,216 @@
+"""Batched solver: many independent small LPs as one device program.
+
+BASELINE.json:11 names the workload — 1024 independent (m=128, n=512)
+problems solved concurrently. The reference plausibly loops problems over
+ranks (SURVEY.md §2 "Batched solver"); the TPU-native design makes the
+batch a *first-class array axis*: the Mehrotra step is ``vmap``-ed over
+the batch, the outer iteration is a ``lax.while_loop`` on device, and
+per-problem convergence is handled by masking (never early exit — shapes
+stay static, SURVEY.md §7 "ragged convergence ... masking, not early
+exit"). The whole solve — every iteration of every problem — is ONE
+compiled XLA program; nothing crosses the host boundary until the final
+states come back.
+
+Batch parallelism over a mesh (SURVEY.md §2.2: batch-axis sharding *is*
+the data parallelism of this domain) falls out of placement: shard the
+leading axis of (A, b, c) over the mesh and the same compiled program
+runs B/K problems per device with no per-iteration collectives at all —
+the only cross-device reduction is the cheap ``any(active)`` loop
+predicate.
+
+Converged problems are frozen by masking rather than dropped: their
+iterates stay exactly at the accepted solution while stragglers continue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedlpsolver_tpu.backends.dense import _make_ops
+from distributedlpsolver_tpu.ipm import core
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import IPMState, Status
+from distributedlpsolver_tpu.models.generators import BatchedLP
+from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+_RUNNING, _OPTIMAL, _MAXITER, _NUMERR = 0, 1, 2, 3
+
+
+@dataclasses.dataclass
+class BatchedResult:
+    """Per-problem outcomes of a batched solve."""
+
+    status: np.ndarray  # (B,) Status values
+    objective: np.ndarray  # (B,)
+    x: np.ndarray  # (B, n)
+    iterations: np.ndarray  # (B,)
+    rel_gap: np.ndarray  # (B,)
+    pinf: np.ndarray  # (B,)
+    dinf: np.ndarray  # (B,)
+    solve_time: float = 0.0
+    setup_time: float = 0.0
+
+    @property
+    def n_optimal(self) -> int:
+        return int(np.sum(self.status == Status.OPTIMAL))
+
+
+def _single_step(A, data, state, reg, params, factor_dtype):
+    ops = _make_ops(A, reg, factor_dtype, 0)
+    return core.mehrotra_step(ops, data, params, state)
+
+
+def _single_start(A, data, reg, params, factor_dtype):
+    ops = _make_ops(A, reg, factor_dtype, 0)
+    return core.starting_point(ops, data, params)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "max_iter", "max_refactor", "reg_grow", "factor_dtype")
+)
+def _solve_batched_jit(A, data, reg0, params, max_iter, max_refactor, reg_grow, factor_dtype):
+    fdt = jnp.dtype(factor_dtype)
+    B = A.shape[0]
+    states0 = jax.vmap(lambda a, d: _single_start(a, d, reg0, params, fdt))(A, data)
+
+    def cond(carry):
+        _, active, it, *_ = carry
+        return jnp.any(active) & (it < max_iter)
+
+    def body(carry):
+        states, active, it, regs, badcount, status, iters = carry
+        new_states, stats = jax.vmap(
+            lambda a, d, st, rg: _single_step(a, d, st, rg, params, fdt)
+        )(A, data, states, regs)
+        bad = stats.bad
+        conv = (
+            (stats.rel_gap <= params.tol)
+            & (stats.pinf <= params.tol)
+            & (stats.dinf <= params.tol)
+        )
+        accept = active & ~bad
+        # Freeze non-accepted problems component-wise.
+        states = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(
+                accept.reshape((B,) + (1,) * (new.ndim - 1)), new, old
+            ),
+            new_states,
+            states,
+        )
+        iters = iters + accept.astype(jnp.int32)
+        # Per-problem regularization escalation on failed factorizations.
+        regs = jnp.where(active & bad, jnp.maximum(regs, 1e-12) * reg_grow, regs)
+        badcount = jnp.where(active & bad, badcount + 1, badcount)
+        give_up = badcount > max_refactor
+        newly_opt = accept & conv
+        status = jnp.where(newly_opt, _OPTIMAL, status)
+        status = jnp.where(active & give_up, _NUMERR, status)
+        active = active & ~newly_opt & ~give_up
+        return states, active, it + 1, regs, badcount, status, iters
+
+    dtype = A.dtype
+    carry0 = (
+        states0,
+        jnp.ones(B, dtype=bool),
+        jnp.asarray(0, jnp.int32),
+        jnp.full(B, reg0, dtype=dtype),
+        jnp.zeros(B, jnp.int32),
+        jnp.full(B, _RUNNING, jnp.int32),
+        jnp.zeros(B, jnp.int32),
+    )
+    states, active, _, _, _, status, iters = jax.lax.while_loop(cond, body, carry0)
+    status = jnp.where(status == _RUNNING, _MAXITER, status)
+
+    # Final per-problem diagnostics.
+    def final_norms(a, d, st):
+        ops = _make_ops(a, jnp.asarray(0.0, dtype), fdt, 0)
+        pinf, dinf, _, rel_gap, pobj, _, _ = core.residual_norms(ops, d, st)
+        return pinf, dinf, rel_gap, pobj
+
+    pinf, dinf, rel_gap, pobj = jax.vmap(final_norms)(A, data, states)
+    return states, status, iters, pinf, dinf, rel_gap, pobj
+
+
+def solve_batched(
+    batch: BatchedLP,
+    config: Optional[SolverConfig] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    batch_axis: str = "batch",
+    **config_overrides,
+) -> BatchedResult:
+    """Solve every problem in ``batch`` concurrently on device.
+
+    ``mesh`` shards the batch axis (data parallelism); the batch size must
+    then be divisible by the mesh size.
+    """
+    import time
+
+    cfg = config or SolverConfig()
+    if config_overrides:
+        cfg = cfg.replace(**config_overrides)
+    dtype = jnp.dtype(cfg.dtype)
+    fname = jnp.dtype(cfg.factor_dtype or cfg.dtype).name
+
+    t0 = time.perf_counter()
+    A = np.asarray(batch.A, dtype=dtype)
+    b = np.asarray(batch.b, dtype=dtype)
+    c = np.asarray(batch.c, dtype=dtype)
+    Bsz, m, n = A.shape
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if Bsz % mesh.shape[batch_axis] != 0:
+            raise ValueError(
+                f"batch {Bsz} not divisible by mesh axis {mesh.shape[batch_axis]}"
+            )
+        sh = lambda *spec: NamedSharding(mesh, P(*spec))
+        A = jax.device_put(A, sh(batch_axis, None, None))
+        b = jax.device_put(b, sh(batch_axis, None))
+        c = jax.device_put(c, sh(batch_axis, None))
+    else:
+        A, b, c = jnp.asarray(A), jnp.asarray(b), jnp.asarray(c)
+
+    u = jnp.full((Bsz, n), jnp.inf, dtype=dtype)
+    data = jax.vmap(lambda cc, bb, uu: core.make_problem_data(jnp, cc, bb, uu, dtype))(
+        c, b, u
+    )
+    params = cfg.step_params()
+    setup_time = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_jit(
+        A,
+        data,
+        jnp.asarray(cfg.reg_dual, dtype),
+        params,
+        cfg.max_iter,
+        cfg.max_refactor,
+        cfg.reg_grow,
+        fname,
+    )
+    jax.block_until_ready(states)
+    solve_time = time.perf_counter() - t1
+
+    code_map = {
+        _OPTIMAL: Status.OPTIMAL,
+        _MAXITER: Status.ITERATION_LIMIT,
+        _NUMERR: Status.NUMERICAL_ERROR,
+    }
+    status_np = np.asarray(status)
+    return BatchedResult(
+        status=np.array([code_map[int(sc)] for sc in status_np], dtype=object),
+        objective=np.asarray(pobj, dtype=np.float64),
+        x=np.asarray(states.x, dtype=np.float64),
+        iterations=np.asarray(iters),
+        rel_gap=np.asarray(rel_gap, dtype=np.float64),
+        pinf=np.asarray(pinf, dtype=np.float64),
+        dinf=np.asarray(dinf, dtype=np.float64),
+        solve_time=solve_time,
+        setup_time=setup_time,
+    )

@@ -1,0 +1,143 @@
+"""Command-line driver: ``solve <file.mps> --backend=<name>``.
+
+The reference's top layer is a CLI that parses flags (including backend
+selection via ``--backend=``, BASELINE.json:5), loads the problem, runs
+the solver, and reports iterations/gap/wall-clock (the published metric
+surface, BASELINE.json:2). Subcommands:
+
+    solve      solve an MPS file (or a generated problem) to tolerance
+    backends   list registered SolverBackend names
+    generate   write a generated benchmark problem to MPS
+
+Run as ``python -m distributedlpsolver_tpu.cli ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _add_solver_flags(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--backend", default="tpu", help="SolverBackend name")
+    ap.add_argument("--tol", type=float, default=1e-8, help="relative gap/infeasibility tolerance")
+    ap.add_argument("--max-iter", type=int, default=200)
+    ap.add_argument("--quiet", action="store_true", help="suppress per-iteration log")
+    ap.add_argument("--log-jsonl", default=None, help="write per-iteration JSONL here")
+    ap.add_argument("--checkpoint", default=None, help="iterate checkpoint path")
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--profile-dir", default=None, help="jax.profiler trace directory")
+    ap.add_argument("--factor-dtype", default=None, help="e.g. float32 for MXU Cholesky")
+    ap.add_argument("--json", action="store_true", help="print result as one JSON object")
+    ap.add_argument("--x-out", default=None, help="write solution vector as .npy")
+
+
+def _config_from(args) -> "SolverConfig":
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+
+    return SolverConfig(
+        tol=args.tol,
+        max_iter=args.max_iter,
+        verbose=not args.quiet,
+        log_jsonl=args.log_jsonl,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
+        profile_dir=args.profile_dir,
+        factor_dtype=args.factor_dtype,
+    )
+
+
+def _report(result, as_json: bool, x_out: Optional[str]) -> int:
+    if x_out and result.x is not None:
+        import numpy as np
+
+        np.save(x_out, result.x)
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "name": result.name,
+                    "status": result.status.value,
+                    "objective": result.objective,
+                    "iterations": result.iterations,
+                    "rel_gap": result.rel_gap,
+                    "pinf": result.pinf,
+                    "dinf": result.dinf,
+                    "solve_time_s": result.solve_time,
+                    "setup_time_s": result.setup_time,
+                    "iters_per_sec": result.iters_per_sec,
+                    "backend": result.backend,
+                }
+            )
+        )
+    else:
+        print(result.summary())
+    from distributedlpsolver_tpu.ipm.state import Status
+
+    return 0 if result.status == Status.OPTIMAL else 2
+
+
+def cmd_solve(args) -> int:
+    from distributedlpsolver_tpu.io.mps import read_mps
+    from distributedlpsolver_tpu.ipm import solve
+
+    problem = read_mps(args.file)
+    result = solve(problem, backend=args.backend, config=_config_from(args))
+    return _report(result, args.json, args.x_out)
+
+
+def cmd_backends(_args) -> int:
+    from distributedlpsolver_tpu.backends import available_backends
+
+    for name in available_backends():
+        print(name)
+    return 0
+
+
+def cmd_generate(args) -> int:
+    from distributedlpsolver_tpu.io.mps import write_mps
+    from distributedlpsolver_tpu.models import generators as gen
+
+    if args.kind == "dense":
+        p = gen.random_dense_lp(args.m, args.n, seed=args.seed)
+    elif args.kind == "general":
+        p = gen.random_general_lp(args.m, args.n, seed=args.seed)
+    else:
+        p = gen.block_angular_lp(
+            args.blocks, args.m, args.n, args.link, seed=args.seed
+        )
+    write_mps(p, args.out)
+    print(f"wrote {p.name} ({p.m}x{p.n}) to {args.out}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="distributedlpsolver_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    ap_solve = sub.add_parser("solve", help="solve an MPS file")
+    ap_solve.add_argument("file", help="MPS path (optionally .gz)")
+    _add_solver_flags(ap_solve)
+    ap_solve.set_defaults(fn=cmd_solve)
+
+    ap_b = sub.add_parser("backends", help="list registered backends")
+    ap_b.set_defaults(fn=cmd_backends)
+
+    ap_g = sub.add_parser("generate", help="write a generated problem to MPS")
+    ap_g.add_argument("kind", choices=["dense", "general", "block"])
+    ap_g.add_argument("out")
+    ap_g.add_argument("--m", type=int, default=100)
+    ap_g.add_argument("--n", type=int, default=250)
+    ap_g.add_argument("--blocks", type=int, default=4)
+    ap_g.add_argument("--link", type=int, default=20)
+    ap_g.add_argument("--seed", type=int, default=0)
+    ap_g.set_defaults(fn=cmd_generate)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+# NOTE: do not re-export a name `build` here — it would shadow the
+# `native.build` submodule on the package object and break
+# `import distributedlpsolver_tpu.native.build`.
+from distributedlpsolver_tpu.native.build import (
+    NativeBuildError,
+    available,
+    load,
+)
+from distributedlpsolver_tpu.native.build import build as build_kernels
+
+__all__ = ["build_kernels", "load", "available", "NativeBuildError"]

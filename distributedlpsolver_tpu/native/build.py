@@ -1,0 +1,79 @@
+"""Build + load the native kernels (g++ → .so, consumed via ctypes).
+
+No pybind11 in this image; the C ABI + ctypes is the binding layer. The
+shared object is rebuilt automatically whenever kernels.cpp is newer than
+the cached .so (so `git pull` level changes just work), and loading is
+process-cached.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "kernels.cpp")
+_SO = os.path.join(_DIR, "libdlps_kernels.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build(force: bool = False) -> str:
+    """Compile kernels.cpp if needed; returns the .so path."""
+    with _lock:
+        if (
+            not force
+            and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        ):
+            return _SO
+        cmd = [
+            "g++", "-O3", "-march=native", "-fPIC", "-shared", "-fopenmp",
+            "-std=c++17", _SRC, "-o", _SO + ".tmp",
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise NativeBuildError(f"g++ not available: {e}") from e
+        except subprocess.CalledProcessError as e:
+            raise NativeBuildError(f"native build failed:\n{e.stderr}") from e
+        os.replace(_SO + ".tmp", _SO)
+        return _SO
+
+
+def load() -> ctypes.CDLL:
+    """Build if needed and load with typed signatures (process-cached)."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build()
+    lib = ctypes.CDLL(path)
+    dp = ctypes.POINTER(ctypes.c_double)
+    lib.dlps_normal_eq.argtypes = [
+        dp, dp, ctypes.c_int, ctypes.c_int, ctypes.c_double, dp, dp
+    ]
+    lib.dlps_normal_eq.restype = None
+    lib.dlps_cholesky.argtypes = [dp, ctypes.c_int]
+    lib.dlps_cholesky.restype = ctypes.c_int
+    lib.dlps_cho_solve.argtypes = [dp, dp, ctypes.c_int, dp]
+    lib.dlps_cho_solve.restype = None
+    lib.dlps_num_threads.argtypes = []
+    lib.dlps_num_threads.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except NativeBuildError:
+        return False

@@ -1,0 +1,113 @@
+"""CPU + native-kernel backend tests (SURVEY.md §2.1) and CLI smoke tests.
+
+The CPU path is an independent execution engine for the shared IPM core
+(numpy eager vs jitted XLA), so agreement between 'cpu', 'cpu-native',
+and 'tpu' is a strong cross-check of all three.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu import cli
+from distributedlpsolver_tpu.io.mps import write_mps
+from distributedlpsolver_tpu.ipm import Status, solve
+from distributedlpsolver_tpu.models.generators import random_dense_lp, random_general_lp
+from tests.oracle import highs_on_general
+
+try:
+    from distributedlpsolver_tpu.native import available as _native_available
+
+    HAVE_NATIVE = _native_available()
+except Exception:
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not HAVE_NATIVE, reason="g++ unavailable")
+
+
+@pytest.mark.parametrize("backend", ["cpu", pytest.param("cpu-native", marks=needs_native)])
+def test_cpu_backends_match_highs(backend):
+    p = random_general_lp(25, 45, seed=4)
+    r = solve(p, backend=backend, max_iter=60)
+    hi = highs_on_general(p)
+    assert r.status == Status.OPTIMAL
+    assert abs(r.objective - hi.fun) <= 2e-6 * (1 + abs(hi.fun))
+
+
+@needs_native
+def test_native_agrees_with_numpy_cpu():
+    p = random_dense_lp(35, 80, seed=9)
+    r1 = solve(p, backend="cpu", max_iter=60)
+    r2 = solve(p, backend="cpu-native", max_iter=60)
+    assert r1.status == r2.status == Status.OPTIMAL
+    # identical algorithm, different kernels: same iterate path to rounding
+    assert r1.iterations == r2.iterations
+    assert r2.objective == pytest.approx(r1.objective, rel=1e-9)
+
+
+@needs_native
+def test_native_kernels_against_numpy_oracle(rng):
+    """Kernel-level unit tests: AD²Aᵀ assembly and Cholesky solve vs
+    NumPy/SciPy (SURVEY.md §4 'kernel tests ... vs NumPy oracle')."""
+    import ctypes
+
+    from distributedlpsolver_tpu.native import load
+
+    lib = load()
+    m, n = 17, 29
+    A = np.ascontiguousarray(rng.standard_normal((m, n)))
+    d = np.ascontiguousarray(rng.uniform(0.5, 2.0, n))
+    M = np.empty((m, m))
+    scratch = np.empty((m, n))
+    dp = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    lib.dlps_normal_eq(dp(A), dp(d), m, n, 0.0, dp(scratch), dp(M))
+    np.testing.assert_allclose(M, (A * d) @ A.T, rtol=1e-12, atol=1e-12)
+
+    Mreg = M + np.eye(m) * 1e-6
+    L = np.ascontiguousarray(Mreg.copy())
+    info = lib.dlps_cholesky(dp(L), m)
+    assert info == 0
+    rhs = np.ascontiguousarray(rng.standard_normal(m))
+    out = np.empty(m)
+    lib.dlps_cho_solve(dp(L), dp(rhs), m, dp(out))
+    np.testing.assert_allclose(out, np.linalg.solve(Mreg, rhs), rtol=1e-9, atol=1e-10)
+
+    # non-PD must be reported, not crash
+    bad = np.ascontiguousarray(-np.eye(m))
+    assert lib.dlps_cholesky(dp(bad), m) == 1
+
+
+def test_cli_solve_json(tmp_path, capsys):
+    p = random_general_lp(15, 25, seed=6)
+    f = str(tmp_path / "p.mps")
+    write_mps(p, f)
+    rc = cli.main(["solve", f, "--backend", "cpu", "--quiet", "--json"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert rc == 0
+    assert rec["status"] == "optimal"
+    hi = highs_on_general(p)
+    assert abs(rec["objective"] - hi.fun) <= 2e-6 * (1 + abs(hi.fun))
+
+
+def test_cli_generate_and_backends(tmp_path, capsys):
+    f = str(tmp_path / "g.mps")
+    rc = cli.main(["generate", "block", f, "--m", "10", "--n", "20", "--blocks", "2", "--link", "4"])
+    assert rc == 0
+    rc = cli.main(["backends"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for name in ["cpu", "tpu", "sharded", "cpu-native"]:
+        assert name in out
+
+
+def test_cli_x_out_roundtrip(tmp_path, capsys):
+    p = random_dense_lp(12, 25, seed=8)
+    f = str(tmp_path / "p.mps")
+    xf = str(tmp_path / "x.npy")
+    write_mps(p, f)
+    rc = cli.main(["solve", f, "--backend", "cpu", "--quiet", "--x-out", xf])
+    assert rc == 0
+    x = np.load(xf)
+    assert p.max_violation(x) <= 1e-6 * (1 + float(np.abs(x).max()))
