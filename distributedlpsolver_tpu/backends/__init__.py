@@ -12,3 +12,4 @@ __all__ = ["SolverBackend", "available_backends", "get_backend", "register_backe
 import distributedlpsolver_tpu.backends.sharded  # noqa: F401  (registers sharded/mesh)
 import distributedlpsolver_tpu.backends.cpu  # noqa: F401  (registers cpu/numpy/scipy)
 import distributedlpsolver_tpu.backends.cpu_native  # noqa: F401  (registers cpu-native)
+import distributedlpsolver_tpu.backends.block_angular  # noqa: F401  (registers block/schur)

@@ -52,6 +52,11 @@ class LPProblem:
     col_names: Optional[list] = None
     integer_cols: list = dataclasses.field(default_factory=list)  # LP-relaxed
     maximize: bool = False  # original sense; c/c0 are always stored minimized
+    # Optional block-angular layout hint {num_blocks, block_m, block_n,
+    # link_m} describing A's row/col grouping (rows: K·block_m block rows
+    # then link_m linking rows; cols: block k owns columns
+    # [k·block_n, (k+1)·block_n)). Consumed by the Schur-complement backend.
+    block_structure: Optional[dict] = None
 
     def __post_init__(self):
         self.c = np.asarray(self.c, dtype=np.float64).ravel()
@@ -132,6 +137,7 @@ class InteriorForm:
     col_shift: np.ndarray  # (nt,) additive shift applied before sign flip
     col_sign: np.ndarray  # (nt,) +1 or -1
     name: str = "LP"
+    block_structure: Optional[dict] = None  # propagated LPProblem hint
 
     @property
     def m(self) -> int:
@@ -272,4 +278,5 @@ def to_interior_form(p: LPProblem) -> InteriorForm:
         col_shift=shift_t,
         col_sign=sign_t,
         name=p.name,
+        block_structure=p.block_structure,
     )

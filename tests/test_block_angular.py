@@ -1,0 +1,106 @@
+"""Block-angular Schur-complement backend tests (BASELINE.json:8 path,
+SURVEY.md §3.2): per-block factorization + Allreduce-combined linking
+Schur complement, batched over K and optionally sharded over a mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.backends.block_angular import (
+    BlockAngularBackend,
+    analyze_structure,
+)
+from distributedlpsolver_tpu.ipm import SolverConfig, Status, solve
+from distributedlpsolver_tpu.models.generators import block_angular_lp, random_dense_lp
+from distributedlpsolver_tpu.models.problem import to_interior_form
+from distributedlpsolver_tpu.parallel import make_mesh
+from tests.oracle import highs_on_general
+
+
+@pytest.mark.parametrize("K,mb,nb,lk", [(4, 12, 30, 8), (6, 10, 25, 5)])
+def test_block_matches_highs_and_dense(K, mb, nb, lk):
+    p = block_angular_lp(K, mb, nb, lk, seed=1, sparse=False)
+    r = solve(p, backend="block", max_iter=60)
+    rd = solve(p, backend="tpu", max_iter=60)
+    hi = highs_on_general(p)
+    assert r.status == Status.OPTIMAL
+    assert abs(r.objective - hi.fun) <= 2e-6 * (1 + abs(hi.fun))
+    # identical algorithm through a different factorization path
+    assert r.objective == pytest.approx(rd.objective, rel=1e-9, abs=1e-9)
+
+
+def test_sparse_input_accepted():
+    p = block_angular_lp(4, 10, 24, 6, seed=2, sparse=True)
+    r = solve(p, backend="block", max_iter=60)
+    hi = highs_on_general(p)
+    assert r.status == Status.OPTIMAL
+    assert abs(r.objective - hi.fun) <= 2e-6 * (1 + abs(hi.fun))
+
+
+def test_structure_detection():
+    p = block_angular_lp(4, 10, 24, 6, seed=0, sparse=False)
+    inf = to_interior_form(p)
+    lay, info = analyze_structure(inf)
+    assert lay.K == 4 and lay.mb == 10 and lay.link == 6
+    # border = linking-row slacks plus any sparse column whose only
+    # nonzeros happen to sit in linking rows
+    assert lay.n0 >= 6
+    assert lay.nb <= 24
+    assert lay.K * lay.nb + lay.n0 >= inf.n - 6
+
+
+def test_missing_hint_raises():
+    p = random_dense_lp(10, 20, seed=0)
+    inf = to_interior_form(p)
+    with pytest.raises(ValueError, match="block_structure"):
+        analyze_structure(inf)
+
+
+def test_cross_block_column_rejected():
+    p = block_angular_lp(3, 8, 16, 4, seed=0, sparse=False)
+    A = np.asarray(p.A).copy()
+    A[0, 17] = 1.0  # block-0 row entry for a block-1 column
+    p.A = A
+    inf = to_interior_form(p)
+    with pytest.raises(ValueError, match="spans blocks"):
+        analyze_structure(inf)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_block_sharded_over_mesh():
+    """K blocks sharded over the mesh: the Σ_k Schur sum must become an
+    all-reduce (the reference's MPI_Allreduce, BASELINE.json:5) and the
+    result must match the unsharded run."""
+    p = block_angular_lp(8, 10, 24, 6, seed=3, sparse=False)
+    mesh = make_mesh(axis_names=("blocks",))
+    be = BlockAngularBackend(mesh=mesh)
+    r = solve(p, backend=be, max_iter=60)
+    r_ref = solve(p, backend="block", max_iter=60)
+    assert r.status == Status.OPTIMAL
+    assert r.objective == pytest.approx(r_ref.objective, rel=1e-9, abs=1e-9)
+
+    from distributedlpsolver_tpu.backends.block_angular import _block_step
+    import jax.numpy as jnp
+
+    be2 = BlockAngularBackend(mesh=mesh)
+    cfg = SolverConfig()
+    be2.setup(to_interior_form(p), cfg)
+    st = be2.starting_point()
+    hlo = (
+        _block_step.lower(
+            be2._tensors, be2._lay, be2._data, st,
+            jnp.asarray(cfg.reg_dual, be2._dtype), be2._params,
+        )
+        .compile()
+        .as_text()
+    )
+    assert "all-reduce" in hlo
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_block_mesh_divisibility_check():
+    p = block_angular_lp(6, 8, 16, 4, seed=0, sparse=False)  # 6 % 8 != 0
+    mesh = make_mesh(axis_names=("blocks",))
+    be = BlockAngularBackend(mesh=mesh)
+    with pytest.raises(ValueError, match="not divisible"):
+        be.setup(to_interior_form(p), SolverConfig())
