@@ -55,6 +55,14 @@ class SolverBackend(abc.ABC):
         of headroom (driver then reports NUMERICAL_ERROR)."""
         return False
 
+    def solve_full(self, state: IPMState):
+        """Optional fused path: run the WHOLE solve as one device program
+        (lax.while_loop). Returns (state, iterations, status_code,
+        stats_buffer) or None when unsupported — the driver then falls back
+        to its per-iteration host loop. Status codes are
+        ipm.core.STATUS_*; the buffer rows are core.N_STAT stats columns."""
+        return None
+
     def to_host(self, state: IPMState) -> IPMState:
         """Materialize a state as host numpy arrays."""
         return IPMState(*(np.asarray(v) for v in state))

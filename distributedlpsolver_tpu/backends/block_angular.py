@@ -212,6 +212,17 @@ def _block_start(tensors, lay, data, reg, params):
     return core.starting_point(ops, data, params)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("lay", "params", "max_iter", "max_refactor", "reg_grow")
+)
+def _block_solve_full(tensors, lay, data, state0, reg0, params, max_iter, max_refactor, reg_grow):
+    def step(state, reg):
+        ops = _block_ops(tensors, lay, reg, None)
+        return core.mehrotra_step(ops, data, params, state)
+
+    return core.fused_solve(step, state0, reg0, params, max_iter, max_refactor, reg_grow)
+
+
 @register_backend("block", "schur", "block-angular")
 class BlockAngularBackend(SolverBackend):
     """Schur-complement execution over the arrow structure; optionally
@@ -269,6 +280,19 @@ class BlockAngularBackend(SolverBackend):
             return False
         self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
         return True
+
+    def solve_full(self, state: IPMState):
+        return _block_solve_full(
+            self._tensors,
+            self._lay,
+            self._data,
+            state,
+            jnp.asarray(self._reg, self._dtype),
+            self._params,
+            self._cfg.max_iter,
+            self._cfg.max_refactor,
+            self._cfg.reg_grow,
+        )
 
     def block_until_ready(self, obj) -> None:
         jax.block_until_ready(obj)

@@ -90,6 +90,20 @@ def _dense_start(A, data, reg, params, factor_dtype, refine_steps):
     return core.starting_point(ops, data, params)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "factor_dtype", "refine_steps", "max_iter", "max_refactor", "reg_grow"),
+)
+def _dense_solve_full(
+    A, data, state0, reg0, params, factor_dtype, refine_steps, max_iter, max_refactor, reg_grow
+):
+    def step(state, reg):
+        ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps)
+        return core.mehrotra_step(ops, data, params, state)
+
+    return core.fused_solve(step, state0, reg0, params, max_iter, max_refactor, reg_grow)
+
+
 @register_backend("tpu", "dense", "jax")
 class DenseJaxBackend(SolverBackend):
     """Single-device dense path (afiro / random-dense configs,
@@ -180,6 +194,20 @@ class DenseJaxBackend(SolverBackend):
             return False
         self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
         return True
+
+    def solve_full(self, state: IPMState):
+        return _dense_solve_full(
+            self._A,
+            self._data,
+            state,
+            jnp.asarray(self._reg, self._dtype),
+            self._params,
+            self._factor_dtype_name,
+            self._refine,
+            self._cfg.max_iter,
+            self._cfg.max_refactor,
+            self._cfg.reg_grow,
+        )
 
     def to_host(self, state: IPMState) -> IPMState:
         n = self._n_orig

@@ -38,6 +38,10 @@ class SolverConfig:
     # distribution (sharded backends)
     mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices
     mesh_axis: str = "cols"  # axis name for the variable-sharded mesh dim
+    # Fused on-device solve loop (lax.while_loop over iterations; no
+    # per-iteration host round trip). None = auto: used when the backend
+    # supports it and per-iteration checkpointing is off.
+    fused_loop: Optional[bool] = None
     # diagnostics
     verbose: bool = False
     log_jsonl: Optional[str] = None  # per-iteration JSONL path (SURVEY.md §5.5)

@@ -289,6 +289,70 @@ def mehrotra_step(
     return new_state, stats
 
 
+STATUS_RUNNING, STATUS_OPTIMAL, STATUS_MAXITER, STATUS_NUMERR = 0, 1, 2, 3
+N_STAT = 10  # mu, gap, rel_gap, pinf, dinf, pobj, dobj, alpha_p, alpha_d, sigma
+
+
+def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow):
+    """Entire IPM solve as one traced program (``lax.while_loop`` over
+    iterations) — jax-only, called from inside a backend's jit.
+
+    Removes the per-iteration host↔device round trip, which dominates
+    wall-clock on a tunneled/remote accelerator. Mirrors the host driver's
+    loop semantics: deterministic regularization escalation on bad steps
+    (state frozen, reg ×= grow, give up after max_refactor), convergence
+    at params.tol on rel_gap/pinf/dinf. Per-iteration stats stream into a
+    fixed (max_iter, N_STAT) buffer so the host can reconstruct the full
+    iteration log afterwards. Returns (state, iterations, status, buffer).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def cond(carry):
+        _, it, _, _, status, _ = carry
+        return (status == STATUS_RUNNING) & (it < max_iter)
+
+    def body(carry):
+        state, it, reg, badcount, status, buf = carry
+        new_state, stats = step_fn(state, reg)
+        bad = stats.bad
+        conv = (
+            (stats.rel_gap <= params.tol)
+            & (stats.pinf <= params.tol)
+            & (stats.dinf <= params.tol)
+        )
+        state = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(bad, o, n), new_state, state
+        )
+        row = jnp.stack(
+            [stats.mu, stats.gap, stats.rel_gap, stats.pinf, stats.dinf,
+             stats.pobj, stats.dobj, stats.alpha_p, stats.alpha_d, stats.sigma]
+        )
+        buf = jnp.where(bad, buf, buf.at[it].set(row))
+        it = jnp.where(bad, it, it + 1)
+        badcount = jnp.where(bad, badcount + 1, badcount)
+        status = jnp.where(
+            bad & ((badcount > max_refactor) | (reg * reg_grow > 1e-2)),
+            STATUS_NUMERR,
+            jnp.where(conv & ~bad, STATUS_OPTIMAL, status),
+        )
+        reg = jnp.where(bad, jnp.maximum(reg, 1e-12) * reg_grow, reg)
+        return state, it, reg, badcount, status, buf
+
+    buf0 = jnp.zeros((max_iter, N_STAT), dtype=state0.x.dtype)
+    carry0 = (
+        state0,
+        jnp.asarray(0, jnp.int32),
+        reg0,
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(STATUS_RUNNING, jnp.int32),
+        buf0,
+    )
+    state, it, reg, _, status, buf = jax.lax.while_loop(cond, body, carry0)
+    status = jnp.where(status == STATUS_RUNNING, STATUS_MAXITER, status)
+    return state, it, status, buf
+
+
 def starting_point(ops: LinOps, data: ProblemData, cfg: StepParams) -> IPMState:
     """Mehrotra's least-squares starting point, extended to upper bounds.
 

@@ -83,6 +83,18 @@ def solve(
         state, start_iter = be.starting_point(), 0
     setup_time = time.perf_counter() - t_setup0
 
+    use_fused = cfg.fused_loop
+    if use_fused is None:
+        use_fused = not (cfg.checkpoint_every and cfg.checkpoint_path)
+    if use_fused:
+        fused = _try_fused(be, state, cfg, logger)
+        if fused is not None:
+            state, status, history, last, solve_time = fused
+            return _finalize(
+                be, state, status, history, last, solve_time, setup_time,
+                inf, original, backend, start_iter,
+            )
+
     status = Status.ITERATION_LIMIT
     history = []
     last = None
@@ -130,6 +142,53 @@ def solve(
         solve_time = time.perf_counter() - t_solve0
         logger.close()
 
+    return _finalize(
+        be, state, status, history, last, solve_time, setup_time,
+        inf, original, backend, start_iter, extra_iters=it - start_iter,
+    )
+
+
+_STAT_FIELDS = (
+    "mu", "gap", "rel_gap", "pinf", "dinf", "pobj", "dobj",
+    "alpha_p", "alpha_d", "sigma",
+)
+
+
+def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
+    """Run the backend's fused on-device loop; None if unsupported."""
+    from distributedlpsolver_tpu.ipm import core
+
+    t0 = time.perf_counter()
+    out = be.solve_full(state)
+    if out is None:
+        return None
+    state, it_dev, status_code, buf = out
+    be.block_until_ready(it_dev)
+    solve_time = time.perf_counter() - t0
+
+    iters = int(np.asarray(it_dev))
+    buf = np.asarray(buf)[:iters]
+    status = {
+        core.STATUS_OPTIMAL: Status.OPTIMAL,
+        core.STATUS_MAXITER: Status.ITERATION_LIMIT,
+        core.STATUS_NUMERR: Status.NUMERICAL_ERROR,
+    }.get(int(np.asarray(status_code)), Status.NUMERICAL_ERROR)
+
+    t_avg = solve_time / max(iters, 1)
+    history, last = [], None
+    for i in range(iters):
+        last = dict(zip(_STAT_FIELDS, (float(v) for v in buf[i])))
+        rec = IterRecord(iter=i + 1, t_iter=t_avg, **last)
+        history.append(rec)
+        logger.log(rec)
+    logger.close()
+    return state, status, history, last, solve_time
+
+
+def _finalize(
+    be, state, status, history, last, solve_time, setup_time,
+    inf, original, backend, start_iter, extra_iters=None,
+):
     host = be.to_host(state)
     x_t = np.asarray(host.x, dtype=np.float64)
     obj_min = inf.objective(x_t)
@@ -144,7 +203,7 @@ def solve(
         status=status,
         x=x_orig,
         objective=objective,
-        iterations=it - start_iter,
+        iterations=extra_iters if extra_iters is not None else len(history),
         rel_gap=last["rel_gap"] if last else np.inf,
         pinf=last["pinf"] if last else np.inf,
         dinf=last["dinf"] if last else np.inf,

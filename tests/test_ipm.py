@@ -138,3 +138,16 @@ def test_jsonl_logging(tmp_path):
     records = [json.loads(line) for line in open(path)]
     assert len(records) == r.iterations
     assert {"iter", "mu", "rel_gap", "pinf", "dinf", "t_iter"} <= set(records[0])
+
+
+def test_fused_loop_matches_host_loop():
+    """The on-device lax.while_loop solve must replay the host loop
+    exactly (same semantics, zero per-iteration round trips)."""
+    p = random_dense_lp(30, 70, seed=13)
+    rf = solve(p, backend=BACKEND, fused_loop=True)
+    rl = solve(p, backend=BACKEND, fused_loop=False)
+    assert rf.status == rl.status == Status.OPTIMAL
+    assert rf.iterations == rl.iterations
+    assert rf.objective == rl.objective
+    assert len(rf.history) == rf.iterations
+    assert rf.history[-1].rel_gap <= 1e-8
