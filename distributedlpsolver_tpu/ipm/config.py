@@ -35,6 +35,9 @@ class SolverConfig:
     factor_dtype: Optional[str] = None  # Cholesky dtype; None = same as dtype
     refine_steps: int = 0  # normal-equations-level refinement sweeps per solve
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
+    # Ruiz-equilibrate the interior form before solving (presolve scaling;
+    # convergence is then tested in the scaled space, standard practice).
+    scale: bool = True
     # distribution (sharded backends)
     mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices
     mesh_axis: str = "cols"  # axis name for the variable-sharded mesh dim

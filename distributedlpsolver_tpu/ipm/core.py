@@ -290,7 +290,30 @@ def mehrotra_step(
 
 
 STATUS_RUNNING, STATUS_OPTIMAL, STATUS_MAXITER, STATUS_NUMERR = 0, 1, 2, 3
+STATUS_PINFEAS, STATUS_DINFEAS = 4, 5
 N_STAT = 10  # mu, gap, rel_gap, pinf, dinf, pobj, dobj, alpha_p, alpha_d, sigma
+
+DIVERGE_MU = 1e30
+
+
+def classify_divergence(mu, pinf, dinf, rel_gap, pobj, dobj):
+    """Heuristic infeasibility/unboundedness signals (works on host floats
+    and on traced scalars).
+
+    * Primal infeasible: complementarity has converged (μ ≈ 0) while primal
+      infeasibility is stuck far above tolerance — the iteration found a
+      Farkas-like stationary point (observed signature: μ→1e-10, pinf
+      frozen ~1e-1) — or the dual objective runs away upward.
+    * Primal unbounded (dual infeasible): dual infeasibility is stuck while
+      the primal objective dives along a recession ray; rel_gap→1 is the
+      scale-free confirmation (gap ≈ |pobj|).
+    These are heuristics, not certificates — a homogeneous self-dual
+    embedding would give certified rays (future work, SURVEY.md §5.3 notes
+    the reference has no such machinery either).
+    """
+    pinfeas = ((mu < 1e-8) & (pinf > 1e-3)) | (dobj > 1e12)
+    dinfeas = ((dinf > 1e-3) & (pobj < -1e8) & (rel_gap > 0.99)) | (pobj < -1e12)
+    return pinfeas, dinfeas
 
 
 def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow):
@@ -335,6 +358,17 @@ def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow)
             bad & ((badcount > max_refactor) | (reg * reg_grow > 1e-2)),
             STATUS_NUMERR,
             jnp.where(conv & ~bad, STATUS_OPTIMAL, status),
+        )
+        ok = ~bad & (status == STATUS_RUNNING)
+        pinfeas, dinfeas = classify_divergence(
+            stats.mu, stats.pinf, stats.dinf, stats.rel_gap, stats.pobj, stats.dobj
+        )
+        status = jnp.where(ok & pinfeas, STATUS_PINFEAS, status)
+        status = jnp.where(ok & dinfeas, STATUS_DINFEAS, status)
+        status = jnp.where(
+            ok & (~jnp.isfinite(stats.mu) | (stats.mu > DIVERGE_MU)),
+            STATUS_NUMERR,
+            status,
         )
         reg = jnp.where(bad, jnp.maximum(reg, 1e-12) * reg_grow, reg)
         return state, it, reg, badcount, status, buf

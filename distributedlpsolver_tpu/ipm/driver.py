@@ -64,21 +64,33 @@ def solve(
     original: Optional[LPProblem] = problem if isinstance(problem, LPProblem) else None
     inf = to_interior_form(problem) if isinstance(problem, LPProblem) else problem
 
+    scaling = None
+    inf_solve = inf
+    if cfg.scale:
+        from distributedlpsolver_tpu.models.scaling import equilibrate
+
+        inf_solve, scaling = equilibrate(inf)
+
     be = get_backend(backend) if isinstance(backend, str) else backend
     logger = IterLogger(cfg.verbose, cfg.log_jsonl)
 
+    def to_solver_space(host_state):
+        return be.from_host(
+            scaling.scale_state(host_state) if scaling else host_state
+        )
+
     t_setup0 = time.perf_counter()
-    be.setup(inf, cfg)
+    be.setup(inf_solve, cfg)
     resumed = ckpt.maybe_load(cfg.checkpoint_path) if warm_start is None else None
     if warm_start is not None:
-        state, start_iter = be.from_host(warm_start), 0
+        state, start_iter = to_solver_space(warm_start), 0
     elif (
         resumed is not None
         and resumed[2] == inf.name
         and resumed[0].x.shape == (inf.n,)
         and resumed[0].y.shape == (inf.m,)
     ):
-        state, start_iter = be.from_host(resumed[0]), resumed[1]
+        state, start_iter = to_solver_space(resumed[0]), resumed[1]
     else:
         state, start_iter = be.starting_point(), 0
     setup_time = time.perf_counter() - t_setup0
@@ -92,7 +104,7 @@ def solve(
             state, status, history, last, solve_time = fused
             return _finalize(
                 be, state, status, history, last, solve_time, setup_time,
-                inf, original, backend, start_iter,
+                inf, original, backend, start_iter, scaling=scaling,
             )
 
     status = Status.ITERATION_LIMIT
@@ -126,13 +138,28 @@ def solve(
             history.append(rec)
             logger.log(rec)
             if cfg.checkpoint_every and it % cfg.checkpoint_every == 0 and cfg.checkpoint_path:
-                ckpt.save_state(cfg.checkpoint_path, be.to_host(state), it, inf.name)
+                host_state = be.to_host(state)
+                if scaling is not None:
+                    host_state = scaling.unscale_state(host_state)
+                ckpt.save_state(cfg.checkpoint_path, host_state, it, inf.name)
             if (
                 last["rel_gap"] <= cfg.tol
                 and last["pinf"] <= cfg.tol
                 and last["dinf"] <= cfg.tol
             ):
                 status = Status.OPTIMAL
+                break
+            from distributedlpsolver_tpu.ipm import core as _core
+
+            pinfeas, dinfeas = _core.classify_divergence(
+                last["mu"], last["pinf"], last["dinf"], last["rel_gap"],
+                last["pobj"], last["dobj"],
+            )
+            if pinfeas:
+                status = Status.PRIMAL_INFEASIBLE
+                break
+            if dinfeas:
+                status = Status.DUAL_INFEASIBLE
                 break
             if not np.isfinite(last["mu"]) or last["mu"] > _DIVERGE:
                 status = Status.NUMERICAL_ERROR
@@ -145,6 +172,7 @@ def solve(
     return _finalize(
         be, state, status, history, last, solve_time, setup_time,
         inf, original, backend, start_iter, extra_iters=it - start_iter,
+        scaling=scaling,
     )
 
 
@@ -172,6 +200,8 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
         core.STATUS_OPTIMAL: Status.OPTIMAL,
         core.STATUS_MAXITER: Status.ITERATION_LIMIT,
         core.STATUS_NUMERR: Status.NUMERICAL_ERROR,
+        core.STATUS_PINFEAS: Status.PRIMAL_INFEASIBLE,
+        core.STATUS_DINFEAS: Status.DUAL_INFEASIBLE,
     }.get(int(np.asarray(status_code)), Status.NUMERICAL_ERROR)
 
     t_avg = solve_time / max(iters, 1)
@@ -187,9 +217,11 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
 
 def _finalize(
     be, state, status, history, last, solve_time, setup_time,
-    inf, original, backend, start_iter, extra_iters=None,
+    inf, original, backend, start_iter, extra_iters=None, scaling=None,
 ):
     host = be.to_host(state)
+    if scaling is not None:
+        host = scaling.unscale_state(host)
     x_t = np.asarray(host.x, dtype=np.float64)
     obj_min = inf.objective(x_t)
     if original is not None:

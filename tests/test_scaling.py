@@ -1,0 +1,64 @@
+"""Ruiz equilibration tests (presolve scaling)."""
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import Status, solve
+from distributedlpsolver_tpu.models.generators import random_general_lp, random_dense_lp
+from distributedlpsolver_tpu.models.problem import to_interior_form
+from distributedlpsolver_tpu.models.scaling import equilibrate
+from tests.oracle import highs_on_general
+
+
+def test_equilibrate_unit_norms():
+    p = random_general_lp(20, 35, seed=2)
+    inf = to_interior_form(p)
+    # blow up the coefficient spread
+    inf.A[:, 0] *= 1e6
+    inf.A[3, :] *= 1e-5
+    scaled, sc = equilibrate(inf)
+    row = np.abs(scaled.A).max(axis=1)
+    col = np.abs(scaled.A).max(axis=0)
+    assert np.all(np.abs(row[row > 0] - 1) < 0.1)
+    assert np.all(np.abs(col[col > 0] - 1) < 0.1)
+    # round trip: Dr A_orig Dc == A_scaled
+    np.testing.assert_allclose(
+        (inf.A * sc.dr[:, None]) * sc.dc[None, :], scaled.A, rtol=1e-12
+    )
+
+
+def test_badly_scaled_problem_solves():
+    """Coefficients spanning 10 orders of magnitude still reach 1e-8."""
+    rng = np.random.default_rng(5)
+    p = random_dense_lp(25, 55, seed=5)
+    scale_r = 10.0 ** rng.uniform(-4, 4, size=p.m)
+    p2 = random_dense_lp(25, 55, seed=5)
+    p2.A = p.A * scale_r[:, None]
+    p2.rlb = p.rlb * scale_r
+    p2.rub = p.rub * scale_r
+    r = solve(p2, backend="tpu", max_iter=80)
+    hi = highs_on_general(p2)
+    assert r.status == Status.OPTIMAL
+    assert abs(r.objective - hi.fun) <= 5e-6 * (1 + abs(hi.fun))
+
+
+def test_scaling_off_still_works():
+    p = random_dense_lp(20, 40, seed=1)
+    r_on = solve(p, backend="tpu", scale=True)
+    r_off = solve(p, backend="tpu", scale=False)
+    assert r_on.status == r_off.status == Status.OPTIMAL
+    assert r_on.objective == pytest.approx(r_off.objective, rel=1e-8)
+
+
+def test_unscale_scale_roundtrip():
+    p = random_general_lp(15, 30, seed=3)
+    inf = to_interior_form(p)
+    inf.A[:, 1] *= 1e4
+    _, sc = equilibrate(inf)
+    from distributedlpsolver_tpu.ipm.state import IPMState
+
+    rng = np.random.default_rng(0)
+    st = IPMState(*(rng.uniform(0.5, 2.0, size=k) for k in [inf.n, inf.m, inf.n, inf.n, inf.n]))
+    back = sc.scale_state(sc.unscale_state(st))
+    for a, b in zip(st, back):
+        np.testing.assert_allclose(a, b, rtol=1e-12)

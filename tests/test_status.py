@@ -1,0 +1,60 @@
+"""Infeasibility/unboundedness detection (divergence heuristics)."""
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import Status, solve
+from distributedlpsolver_tpu.models.problem import LPProblem
+
+INF = np.inf
+
+
+def _infeasible_lp():
+    # x1 + x2 = 2  AND  x1 + x2 <= 1, x >= 0
+    return LPProblem(
+        c=[1.0, 1.0],
+        A=np.array([[1.0, 1.0], [1.0, 1.0]]),
+        rlb=[2.0, -INF],
+        rub=[2.0, 1.0],
+        lb=[0.0, 0.0],
+        ub=[INF, INF],
+        name="infeasible",
+    )
+
+
+def _unbounded_lp():
+    # min -x1, x1 - x2 = 0, x >= 0 → ray (t, t)
+    return LPProblem(
+        c=[-1.0, 0.0],
+        A=np.array([[1.0, -1.0]]),
+        rlb=[0.0],
+        rub=[0.0],
+        lb=[0.0, 0.0],
+        ub=[INF, INF],
+        name="unbounded",
+    )
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_infeasible_detected(fused):
+    r = solve(_infeasible_lp(), backend="tpu", max_iter=100, fused_loop=fused)
+    assert r.status in (Status.PRIMAL_INFEASIBLE, Status.ITERATION_LIMIT, Status.NUMERICAL_ERROR)
+    assert r.status != Status.OPTIMAL
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_unbounded_detected(fused):
+    r = solve(_unbounded_lp(), backend="tpu", max_iter=100, fused_loop=fused)
+    assert r.status in (Status.DUAL_INFEASIBLE, Status.ITERATION_LIMIT, Status.NUMERICAL_ERROR)
+    assert r.status != Status.OPTIMAL
+
+
+def test_infeasible_gets_specific_status():
+    """The divergence heuristic should fire, not just hit the iteration cap."""
+    r = solve(_infeasible_lp(), backend="tpu", max_iter=200)
+    assert r.status == Status.PRIMAL_INFEASIBLE, r.summary()
+
+
+def test_unbounded_gets_specific_status():
+    r = solve(_unbounded_lp(), backend="tpu", max_iter=200)
+    assert r.status == Status.DUAL_INFEASIBLE, r.summary()
