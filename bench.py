@@ -1,16 +1,19 @@
 """Benchmark harness — prints ONE JSON line for the round driver.
 
 Metric (BASELINE.json:2): IPM iterations/sec and wall-clock to a 1e-8
-relative duality gap. The reference publishes no numbers and no pds-20
-file is fetchable in this zero-egress image (BASELINE.md), so the
-headline config is the block-angular generator at a pds-like shape, and
-``vs_baseline`` compares the accelerated backend against the same
-problem solved by this package's own host/CPU path on this machine —
-the stand-in for the reference's 8-rank MPI/CPU baseline until real
-Netlib files are present in ``data/`` (drop pds-20.mps there to switch
-the bench to it automatically).
+relative duality gap. The reference publishes no numbers and no Netlib/
+Mittelmann files are fetchable in this zero-egress image (BASELINE.md), so
+each of the reference's five benchmark configs (BASELINE.json:7-11) runs on
+a generated stand-in of the same structure and scale class, and
+``vs_baseline`` compares the accelerated backend against this package's own
+host/CPU path on the same problem — the stand-in for the reference's 8-rank
+MPI/CPU baseline until real files are present in ``data/`` (drop
+``pds-20.mps`` there to switch the headline bench to it automatically).
 
-Usage: python bench.py [--quick] [--backend tpu|sharded] [--json-only]
+Usage:
+  python bench.py [--quick] [--backend tpu|sharded] [--mps FILE]
+  python bench.py --suite [--quick]    # all five reference configs,
+                                       # detailed rows → BENCH_SUITE.json
 """
 
 from __future__ import annotations
@@ -21,6 +24,8 @@ import os
 import sys
 import time
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
 
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -29,13 +34,163 @@ def _log(msg: str) -> None:
 def _solve_timed(problem, backend: str, **cfg):
     from distributedlpsolver_tpu.ipm import solve
 
-    r = solve(problem, backend=backend, **cfg)
-    return r
+    return solve(problem, backend=backend, **cfg)
+
+
+def _headline_problem(args):
+    """The headline config: a real pds-20.mps if present, else the pds-like
+    block-angular stand-in (BASELINE.json:8 structure)."""
+    from distributedlpsolver_tpu.io.mps import read_mps
+    from distributedlpsolver_tpu.models.generators import block_angular_lp
+
+    if args.mps and not os.path.exists(args.mps):
+        raise SystemExit(f"--mps {args.mps!r}: file not found")
+    pds20_path = args.mps or os.path.join(_REPO, "data", "pds-20.mps")
+    if os.path.exists(pds20_path):
+        return read_mps(pds20_path), os.path.basename(pds20_path)
+    if args.quick:
+        return (
+            block_angular_lp(4, 24, 48, 12, seed=0, sparse=False),
+            "block_angular(K=4,24x48,link=12) [quick]",
+        )
+    return (
+        block_angular_lp(8, 96, 256, 64, seed=0, sparse=False),
+        "block_angular(K=8,96x256,link=64) pds-like stand-in",
+    )
+
+
+def _bench_one(problem, backend: str, baseline: str | None, **cfg):
+    """Warm-up (compile) + timed solve on ``backend``; optional baseline
+    solve for the speedup ratio. Returns a result row dict."""
+    from distributedlpsolver_tpu.backends import available_backends
+
+    _solve_timed(problem, backend, max_iter=3, **cfg)  # compile warm-up
+    r = _solve_timed(problem, backend, **cfg)
+    _log(f"  {backend}: " + r.summary())
+    row = {
+        "backend": backend,
+        "time_s": round(r.solve_time, 4),
+        "iters": int(r.iterations),
+        "iters_per_sec": round(r.iters_per_sec, 2),
+        "status": r.status.value,
+        "vs_baseline": 1.0,
+    }
+    if baseline and baseline in available_backends() and baseline != backend:
+        try:
+            _solve_timed(problem, baseline, max_iter=3)  # compile warm-up
+            rb = _solve_timed(problem, baseline)
+            _log(f"  baseline {baseline}: " + rb.summary())
+            if rb.solve_time > 0 and r.solve_time > 0:
+                row["baseline_backend"] = baseline
+                row["baseline_time_s"] = round(rb.solve_time, 4)
+                row["vs_baseline"] = round(rb.solve_time / r.solve_time, 3)
+        except Exception as e:  # baseline must never sink the bench
+            _log(f"  baseline {baseline} failed: {e}")
+    return row
+
+
+def _bench_batched(quick: bool):
+    """Config 5 (BASELINE.json:11): 1024 independent (128, 512) LPs."""
+    from distributedlpsolver_tpu.backends.batched import solve_batched
+    from distributedlpsolver_tpu.models.generators import random_batched_lp
+
+    B, m, n = (32, 16, 40) if quick else (1024, 128, 512)
+    batch = random_batched_lp(B, m, n, seed=0)
+    solve_batched(batch, max_iter=3)  # compile warm-up
+    t0 = time.perf_counter()
+    res = solve_batched(batch)
+    dt = time.perf_counter() - t0
+    ok = sum(1 for s in res.status if s.value == "optimal")
+    _log(f"  batched: {B} LPs in {res.solve_time:.3f}s, {ok}/{B} optimal")
+    return {
+        "backend": "batched(vmap)",
+        "time_s": round(res.solve_time, 4),
+        "problems": B,
+        "problems_per_sec": round(B / max(res.solve_time, 1e-9), 1),
+        "optimal": ok,
+        "wall_s": round(dt, 4),
+        "vs_baseline": 1.0,
+    }
+
+
+def run_suite(args) -> list:
+    """All five reference benchmark configs (BASELINE.json:7-11)."""
+    from distributedlpsolver_tpu.models.generators import (
+        block_angular_lp,
+        random_dense_lp,
+        random_general_lp,
+    )
+
+    q = args.quick
+    accel = args.backend
+    rows = []
+
+    def add(config, row):
+        row = {"config": config, **row}
+        rows.append(row)
+        _log(json.dumps(row))
+
+    # 1. afiro-class tiny dense (BASELINE.json:7) — 27x51, general form.
+    _log("[1/5] afiro-class dense 27x51")
+    add(
+        "afiro-like general LP 27x51",
+        _bench_one(random_general_lp(27, 51, seed=0), accel, "cpu"),
+    )
+
+    # 2. pds-02/pds-10-class block-angular (BASELINE.json:8) — the
+    # reference's 4-rank row-partitioned configs; here the Schur-complement
+    # block backend vs the dense CPU path.
+    _log("[2/5] pds-class block-angular (Schur backend)")
+    shape = (4, 24, 48, 12) if q else (4, 64, 160, 32)
+    add(
+        f"pds-02-like block_angular{shape}",
+        _bench_one(
+            block_angular_lp(*shape, seed=1, sparse=False), "block", "cpu-native"
+        ),
+    )
+
+    # 3. Random dense full-Cholesky path (BASELINE.json:9; m=10k n=50k in
+    # the reference — scaled to fit a single v5e's HBM and test budget,
+    # --full restores the reference shape).
+    m, n = (128, 320) if q else ((10_000, 50_000) if args.full else (2_048, 10_240))
+    _log(f"[3/5] random dense {m}x{n} (mixed-precision + Pallas assembly)")
+    add(
+        f"random dense {m}x{n}",
+        _bench_one(
+            random_dense_lp(m, n, seed=2),
+            accel,
+            "cpu-native" if q else None,  # dense CPU baseline is hours at full size
+            factor_dtype="float32",
+            kkt_refine=3,
+        ),
+    )
+
+    # 4. Large-sparse class (BASELINE.json:10, neos3/stormG2-like):
+    # stormG2 IS block-angular (stochastic program) → sparse stand-in on
+    # the sparse-direct CPU backend vs densified CPU.
+    _log("[4/5] large sparse (SuperLU sparse-direct backend)")
+    shape = (4, 24, 48, 12) if q else (16, 96, 192, 48)
+    add(
+        f"stormG2-like sparse block_angular{shape}",
+        _bench_one(
+            block_angular_lp(*shape, seed=3, sparse=True, density=0.15),
+            "cpu-sparse",
+            "cpu",
+        ),
+    )
+
+    # 5. Batched concurrent LPs (BASELINE.json:11).
+    _log("[5/5] batched 1024x(128,512) vmap solve")
+    add("batched 1024x(128x512)" if not q else "batched 32x(16x40)", _bench_batched(q))
+
+    return rows
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (smoke)")
+    ap.add_argument("--suite", action="store_true", help="all five reference configs")
+    ap.add_argument("--full", action="store_true", help="reference-scale shapes")
     ap.add_argument("--backend", default="tpu")
     ap.add_argument("--baseline-backend", default="cpu-native")
     ap.add_argument("--mps", default=None, help="bench this MPS file instead")
@@ -52,51 +207,23 @@ def main() -> int:
     _log(f"devices: {devs}")
 
     from distributedlpsolver_tpu.backends import available_backends
-    from distributedlpsolver_tpu.models.generators import block_angular_lp
-    from distributedlpsolver_tpu.io.mps import read_mps
-
-    pds20_path = args.mps or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "data", "pds-20.mps"
-    )
-    if os.path.exists(pds20_path):
-        problem = read_mps(pds20_path)
-        config_name = os.path.basename(pds20_path)
-    elif args.quick:
-        problem = block_angular_lp(4, 24, 48, 12, seed=0, sparse=False)
-        config_name = "block_angular(K=4,24x48,link=12) [quick]"
-    else:
-        # pds-like block-angular stand-in (BASELINE.json:8 structure).
-        problem = block_angular_lp(8, 96, 256, 64, seed=0, sparse=False)
-        config_name = "block_angular(K=8,96x256,link=64) pds-like stand-in"
 
     backend = args.backend
     if backend not in available_backends():
         _log(f"backend {backend!r} unknown; using 'tpu'")
-        backend = "tpu"
+        backend = args.backend = "tpu"
 
-    # Warm-up solve (compile) then timed solve.
-    _log(f"warm-up (compile) on backend={backend} ...")
-    _solve_timed(problem, backend, max_iter=3)
-    _log("timed solve ...")
-    r = _solve_timed(problem, backend)
-    _log(r.summary())
+    if args.suite:
+        rows = run_suite(args)
+        out = os.path.join(_REPO, "BENCH_SUITE.json")
+        with open(out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+        _log(f"suite rows -> {out}")
 
-    # Baseline: same problem on the host/CPU reference path.
-    vs_baseline = None
-    base = args.baseline_backend
-    if base not in available_backends():
-        base = None
-    if base and base != backend:
-        try:
-            _solve_timed(problem, base, max_iter=3)
-            rb = _solve_timed(problem, base)
-            _log("baseline " + rb.summary())
-            if rb.solve_time > 0 and r.solve_time > 0:
-                vs_baseline = rb.solve_time / r.solve_time
-        except Exception as e:  # baseline must never sink the bench
-            _log(f"baseline failed: {e}")
-    if vs_baseline is None:
-        vs_baseline = 1.0
+    # Headline metric (always printed last, the ONE stdout JSON line).
+    problem, config_name = _headline_problem(args)
+    _log(f"headline: {config_name} on backend={backend}")
+    row = _bench_one(problem, backend, args.baseline_backend)
 
     print(
         json.dumps(
@@ -104,12 +231,12 @@ def main() -> int:
                 "metric": (
                     "wall-clock to 1e-8 rel duality gap, "
                     f"{config_name}, backend={backend} "
-                    f"[{r.iterations} iters, {r.iters_per_sec:.2f} it/s, "
-                    f"status={r.status.value}]"
+                    f"[{row['iters']} iters, {row['iters_per_sec']:.2f} it/s, "
+                    f"status={row['status']}]"
                 ),
-                "value": round(r.solve_time, 4),
+                "value": row["time_s"],
                 "unit": "seconds",
-                "vs_baseline": round(vs_baseline, 3),
+                "vs_baseline": row["vs_baseline"],
             }
         )
     )

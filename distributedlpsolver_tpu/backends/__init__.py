@@ -13,3 +13,4 @@ import distributedlpsolver_tpu.backends.sharded  # noqa: F401  (registers sharde
 import distributedlpsolver_tpu.backends.cpu  # noqa: F401  (registers cpu/numpy/scipy)
 import distributedlpsolver_tpu.backends.cpu_native  # noqa: F401  (registers cpu-native)
 import distributedlpsolver_tpu.backends.block_angular  # noqa: F401  (registers block/schur)
+import distributedlpsolver_tpu.backends.cpu_sparse  # noqa: F401  (registers cpu-sparse)

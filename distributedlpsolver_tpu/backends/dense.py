@@ -34,17 +34,30 @@ from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
 from distributedlpsolver_tpu.models.problem import InteriorForm
 
 
-def _cholesky_ops(A, factor_dtype, refine_steps):
+def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
     """Build factorize/solve closures over a (traced) matrix ``A``.
 
     ``factorize(d, reg)`` returns ``(L, M)`` with ``M = A·diag(d)·Aᵀ``
     plus a per-row relative diagonal perturbation, ``M`` kept at full
     precision for refinement and ``L`` its (possibly lower-precision)
     Cholesky factor.
+
+    With ``use_pallas`` the assembly runs through the fused Pallas kernel
+    (ops/normal_eq.py) in ``factor_dtype`` — no scaled-matrix HBM
+    round trip. Only auto-selected when ``factor_dtype`` is single
+    precision on a TPU with no normal-equations-level refinement
+    (refinement wants the full-precision M this path never forms).
     """
 
     def factorize(d, reg):
-        M = (A * d[None, :]) @ A.T
+        if use_pallas:
+            from distributedlpsolver_tpu.ops import normal_eq_pallas
+
+            # Af is the loop-invariant precast copy from setup — casting
+            # A here would re-materialize an m×n array every iteration.
+            M = normal_eq_pallas(Af, d.astype(factor_dtype)).astype(A.dtype)
+        else:
+            M = (A * d[None, :]) @ A.T
         # Per-row *relative* diagonal perturbation: with heterogeneous d the
         # diagonal spans many orders of magnitude, and a uniform (trace- or
         # norm-scaled) shift would swamp the small rows and wreck the
@@ -67,8 +80,8 @@ def _cholesky_ops(A, factor_dtype, refine_steps):
     return factorize, solve
 
 
-def _make_ops(A, reg, factor_dtype, refine_steps):
-    factorize, solve = _cholesky_ops(A, factor_dtype, refine_steps)
+def _make_ops(A, reg, factor_dtype, refine_steps, use_pallas=False, Af=None):
+    factorize, solve = _cholesky_ops(A, factor_dtype, refine_steps, use_pallas, Af)
     return core.LinOps(
         xp=jnp,
         matvec=lambda v: A @ v,
@@ -78,27 +91,38 @@ def _make_ops(A, reg, factor_dtype, refine_steps):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("params", "factor_dtype", "refine_steps"))
-def _dense_step(A, data, state, reg, params, factor_dtype, refine_steps):
-    ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps)
+@functools.partial(
+    jax.jit, static_argnames=("params", "factor_dtype", "refine_steps", "use_pallas")
+)
+def _dense_step(
+    A, data, state, reg, params, factor_dtype, refine_steps, use_pallas=False, Af=None
+):
+    ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
     return core.mehrotra_step(ops, data, params, state)
 
 
-@functools.partial(jax.jit, static_argnames=("params", "factor_dtype", "refine_steps"))
-def _dense_start(A, data, reg, params, factor_dtype, refine_steps):
-    ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps)
+@functools.partial(
+    jax.jit, static_argnames=("params", "factor_dtype", "refine_steps", "use_pallas")
+)
+def _dense_start(
+    A, data, reg, params, factor_dtype, refine_steps, use_pallas=False, Af=None
+):
+    ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
     return core.starting_point(ops, data, params)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("params", "factor_dtype", "refine_steps", "max_iter", "max_refactor", "reg_grow"),
+    static_argnames=(
+        "params", "factor_dtype", "refine_steps", "max_iter", "max_refactor", "reg_grow", "use_pallas"
+    ),
 )
 def _dense_solve_full(
-    A, data, state0, reg0, params, factor_dtype, refine_steps, max_iter, max_refactor, reg_grow
+    A, data, state0, reg0, params, factor_dtype, refine_steps, max_iter, max_refactor, reg_grow,
+    use_pallas=False, Af=None,
 ):
     def step(state, reg):
-        ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps)
+        ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
         return core.mehrotra_step(ops, data, params, state)
 
     return core.fused_solve(step, state0, reg0, params, max_iter, max_refactor, reg_grow)
@@ -165,6 +189,30 @@ class DenseJaxBackend(SolverBackend):
         self._factor_dtype_name = jnp.dtype(factor_dtype).name
         self._refine = refine
         self._dtype = dtype
+        # Fused Pallas normal-equations assembly: auto on single-device TPU
+        # placement with a single-precision factor dtype and no
+        # M-level refinement (which needs the full-precision M the fused
+        # path never materializes). Sharded placement would need the kernel
+        # wrapped in shard_map — not done yet, so it stays on plain XLA,
+        # which GSPMD-partitions into the psum-combined Schur form.
+        from distributedlpsolver_tpu.ops import supports_pallas
+
+        pallas_ok = mat_s is None and refine == 0 and supports_pallas(factor_dtype)
+        if config.use_pallas is None:
+            self._use_pallas = pallas_ok
+        elif config.use_pallas and not pallas_ok:
+            raise ValueError(
+                "use_pallas=True requires single-device placement, "
+                "refine_steps=0, and a single-precision factor_dtype on a "
+                f"TPU (got factor_dtype={jnp.dtype(factor_dtype).name}, "
+                f"refine_steps={refine}, sharded={mat_s is not None}, "
+                f"platform={jax.default_backend()})"
+            )
+        else:
+            self._use_pallas = bool(config.use_pallas)
+        # Loop-invariant precast for the Pallas path: cast once here, not
+        # per factorize call (A never changes across iterations).
+        self._Af = A.astype(factor_dtype) if self._use_pallas else None
 
     def starting_point(self) -> IPMState:
         state = _dense_start(
@@ -174,6 +222,8 @@ class DenseJaxBackend(SolverBackend):
             self._params,
             self._factor_dtype_name,
             self._refine,
+            self._use_pallas,
+            self._Af,
         )
         jax.block_until_ready(state)
         return state
@@ -187,6 +237,8 @@ class DenseJaxBackend(SolverBackend):
             self._params,
             self._factor_dtype_name,
             self._refine,
+            self._use_pallas,
+            self._Af,
         )
 
     def bump_regularization(self) -> bool:
@@ -207,6 +259,8 @@ class DenseJaxBackend(SolverBackend):
             self._cfg.max_iter,
             self._cfg.max_refactor,
             self._cfg.reg_grow,
+            self._use_pallas,
+            self._Af,
         )
 
     def to_host(self, state: IPMState) -> IPMState:

@@ -33,6 +33,10 @@ class SolverConfig:
     max_refactor: int = 5  # NaN-recovery attempts per iteration
     dtype: str = "float64"  # iterate/residual dtype
     factor_dtype: Optional[str] = None  # Cholesky dtype; None = same as dtype
+    # Fused Pallas normal-equations assembly (ops/normal_eq.py). None =
+    # auto: on for single-device TPU placement with a single-precision
+    # factor_dtype and refine_steps == 0.
+    use_pallas: Optional[bool] = None
     refine_steps: int = 0  # normal-equations-level refinement sweeps per solve
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
     # Ruiz-equilibrate the interior form before solving (presolve scaling;

@@ -83,8 +83,9 @@ def equilibrate(inf: InteriorForm, iterations: int = 10, tol: float = 1e-2):
             np.abs(col[col > 0] - 1.0) < tol
         ).all():
             break
-        r = np.where(row > 0, 1.0 / np.sqrt(row), 1.0)
-        c = np.where(col > 0, 1.0 / np.sqrt(col), 1.0)
+        with np.errstate(divide="ignore"):
+            r = np.where(row > 0, 1.0 / np.sqrt(row), 1.0)
+            c = np.where(col > 0, 1.0 / np.sqrt(col), 1.0)
         if sp.issparse(A):
             A = sp.diags(r) @ A @ sp.diags(c)
         else:

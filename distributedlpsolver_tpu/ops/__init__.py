@@ -1,0 +1,15 @@
+"""Custom TPU ops (Pallas kernels) with plain-XLA fallbacks."""
+
+from distributedlpsolver_tpu.ops.normal_eq import (
+    normal_eq,
+    normal_eq_pallas,
+    normal_eq_reference,
+    supports_pallas,
+)
+
+__all__ = [
+    "normal_eq",
+    "normal_eq_pallas",
+    "normal_eq_reference",
+    "supports_pallas",
+]

@@ -1,0 +1,67 @@
+"""Pallas fused normal-equations kernel vs the plain-XLA oracle.
+
+Runs in interpret mode so it exercises the kernel logic (tiling,
+accumulation, padding) on the CPU test mesh without TPU hardware
+(SURVEY.md §4's fake-backend strategy).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ops import (
+    normal_eq,
+    normal_eq_pallas,
+    normal_eq_reference,
+    supports_pallas,
+)
+
+
+@pytest.mark.parametrize(
+    "m,n",
+    [
+        (32, 64),  # exact tile fit (with small blocks)
+        (100, 300),  # ragged in both axes
+        (257, 130),  # m > n, ragged
+        (1, 7),  # degenerate tiny
+    ],
+)
+def test_pallas_matches_reference(m, n):
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+    d = jnp.asarray(rng.random(n) + 0.1, jnp.float32)
+    M = normal_eq_pallas(A, d, block_m=128, block_k=128, interpret=True)
+    Mr = normal_eq_reference(A, d)
+    np.testing.assert_allclose(np.asarray(M), np.asarray(Mr), rtol=2e-5, atol=1e-5)
+
+
+def test_pallas_accumulates_over_k_tiles():
+    # n spans multiple k-tiles — checks the accumulator zero/flush logic.
+    rng = np.random.default_rng(1)
+    m, n = 64, 700
+    A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+    d = jnp.asarray(rng.random(n) + 0.5, jnp.float32)
+    M = normal_eq_pallas(A, d, block_m=64, block_k=128, interpret=True)
+    Mr = normal_eq_reference(A, d)
+    # f32 accumulation order differs between the tiled kernel and XLA.
+    np.testing.assert_allclose(np.asarray(M), np.asarray(Mr), rtol=2e-4, atol=1e-4)
+
+
+def test_dispatch_falls_back_off_tpu():
+    rng = np.random.default_rng(2)
+    A = jnp.asarray(rng.standard_normal((16, 24)), jnp.float64)
+    d = jnp.asarray(rng.random(24) + 0.1, jnp.float64)
+    # f64 is never pallas-eligible; dispatch must silently use the XLA path.
+    assert not supports_pallas(jnp.float64)
+    M = normal_eq(A, d)
+    np.testing.assert_allclose(np.asarray(M), np.asarray(normal_eq_reference(A, d)))
+
+
+def test_result_is_symmetric_psd_shaped():
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.standard_normal((40, 90)), jnp.float32)
+    d = jnp.asarray(rng.random(90) + 0.1, jnp.float32)
+    M = np.asarray(normal_eq_pallas(A, d, block_m=64, block_k=64, interpret=True))
+    assert M.shape == (40, 40)
+    np.testing.assert_allclose(M, M.T, rtol=1e-5, atol=1e-6)
+    assert np.linalg.eigvalsh(M).min() > -1e-4
