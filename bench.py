@@ -43,8 +43,6 @@ def _headline_problem(args):
     from distributedlpsolver_tpu.io.mps import read_mps
     from distributedlpsolver_tpu.models.generators import block_angular_lp
 
-    if args.mps and not os.path.exists(args.mps):
-        raise SystemExit(f"--mps {args.mps!r}: file not found")
     pds20_path = args.mps or os.path.join(_REPO, "data", "pds-20.mps")
     if os.path.exists(pds20_path):
         return read_mps(pds20_path), os.path.basename(pds20_path)
@@ -195,6 +193,8 @@ def main() -> int:
     ap.add_argument("--baseline-backend", default="cpu-native")
     ap.add_argument("--mps", default=None, help="bench this MPS file instead")
     args = ap.parse_args()
+    if args.mps and not os.path.exists(args.mps):
+        ap.error(f"--mps {args.mps!r}: file not found")  # before any solve
 
     import jax
 

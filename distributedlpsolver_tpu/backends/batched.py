@@ -71,10 +71,10 @@ def _single_start(A, data, reg, params, factor_dtype):
     return core.starting_point(ops, data, params)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("params", "max_iter", "max_refactor", "reg_grow", "factor_dtype")
-)
+@functools.partial(jax.jit, static_argnames=("params", "factor_dtype"))
 def _solve_batched_jit(A, data, reg0, params, max_iter, max_refactor, reg_grow, factor_dtype):
+    # max_iter / max_refactor / reg_grow are traced scalars so one compile
+    # serves every iteration-limit config (warm-up shares the timed compile).
     fdt = jnp.dtype(factor_dtype)
     B = A.shape[0]
     states0 = jax.vmap(lambda a, d: _single_start(a, d, reg0, params, fdt))(A, data)

@@ -212,15 +212,19 @@ def _block_start(tensors, lay, data, reg, params):
     return core.starting_point(ops, data, params)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("lay", "params", "max_iter", "max_refactor", "reg_grow")
-)
-def _block_solve_full(tensors, lay, data, state0, reg0, params, max_iter, max_refactor, reg_grow):
+@functools.partial(jax.jit, static_argnames=("lay", "params", "buf_cap"))
+def _block_solve_full(
+    tensors, lay, data, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap
+):
+    # max_iter / max_refactor / reg_grow are traced — no recompile across
+    # iteration-limit configs (see dense._dense_solve_full).
     def step(state, reg):
         ops = _block_ops(tensors, lay, reg, None)
         return core.mehrotra_step(ops, data, params, state)
 
-    return core.fused_solve(step, state0, reg0, params, max_iter, max_refactor, reg_grow)
+    return core.fused_solve(
+        step, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap
+    )
 
 
 @register_backend("block", "schur", "block-angular")
@@ -289,9 +293,10 @@ class BlockAngularBackend(SolverBackend):
             state,
             jnp.asarray(self._reg, self._dtype),
             self._params,
-            self._cfg.max_iter,
-            self._cfg.max_refactor,
-            self._cfg.reg_grow,
+            jnp.asarray(self._cfg.max_iter, jnp.int32),
+            jnp.asarray(self._cfg.max_refactor, jnp.int32),
+            jnp.asarray(self._cfg.reg_grow, self._dtype),
+            core.buffer_cap(self._cfg.max_iter),
         )
 
     def block_until_ready(self, obj) -> None:

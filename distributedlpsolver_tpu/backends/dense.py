@@ -53,17 +53,21 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
         if use_pallas:
             from distributedlpsolver_tpu.ops import normal_eq_pallas
 
-            # Af is the loop-invariant precast copy from setup — casting
-            # A here would re-materialize an m×n array every iteration.
-            M = normal_eq_pallas(Af, d.astype(factor_dtype)).astype(A.dtype)
+            # Af is the loop-invariant precast, pre-padded copy from setup —
+            # casting or tile-padding A here would re-materialize an m×n
+            # array every iteration. M stays in factor_dtype: the pallas
+            # path requires refine_steps == 0, so the full-precision M the
+            # refinement loop would read is never consumed — casting up to
+            # A.dtype would be an m×m f64 HBM round trip of pure waste.
+            M = normal_eq_pallas(Af, d.astype(factor_dtype), out_m=A.shape[0])
         else:
             M = (A * d[None, :]) @ A.T
         # Per-row *relative* diagonal perturbation: with heterogeneous d the
         # diagonal spans many orders of magnitude, and a uniform (trace- or
         # norm-scaled) shift would swamp the small rows and wreck the
         # Newton direction's primal-residual reduction.
-        M = M + jnp.diag(reg * jnp.diagonal(M))
-        L = jnp.linalg.cholesky(M.astype(factor_dtype))
+        M = M + jnp.diag(jnp.asarray(reg, M.dtype) * jnp.diagonal(M))
+        L = jnp.linalg.cholesky(M if M.dtype == factor_dtype else M.astype(factor_dtype))
         return L, M
 
     def solve(factors, rhs):
@@ -114,18 +118,23 @@ def _dense_start(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "params", "factor_dtype", "refine_steps", "max_iter", "max_refactor", "reg_grow", "use_pallas"
+        "params", "factor_dtype", "refine_steps", "buf_cap", "use_pallas"
     ),
 )
 def _dense_solve_full(
     A, data, state0, reg0, params, factor_dtype, refine_steps, max_iter, max_refactor, reg_grow,
-    use_pallas=False, Af=None,
+    buf_cap, use_pallas=False, Af=None,
 ):
+    # max_iter / max_refactor / reg_grow are traced scalars: one compiled
+    # executable serves every iteration-limit config (only the bucketed
+    # buf_cap is a jit key), so warm-up runs share the timed run's compile.
     def step(state, reg):
         ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
         return core.mehrotra_step(ops, data, params, state)
 
-    return core.fused_solve(step, state0, reg0, params, max_iter, max_refactor, reg_grow)
+    return core.fused_solve(
+        step, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap
+    )
 
 
 @register_backend("tpu", "dense", "jax")
@@ -210,9 +219,14 @@ class DenseJaxBackend(SolverBackend):
             )
         else:
             self._use_pallas = bool(config.use_pallas)
-        # Loop-invariant precast for the Pallas path: cast once here, not
-        # per factorize call (A never changes across iterations).
-        self._Af = A.astype(factor_dtype) if self._use_pallas else None
+        # Loop-invariant precast + tile-pad for the Pallas path: once here,
+        # not per factorize call (A never changes across iterations).
+        if self._use_pallas:
+            from distributedlpsolver_tpu.ops import pad_for_pallas
+
+            self._Af = pad_for_pallas(A.astype(factor_dtype))
+        else:
+            self._Af = None
 
     def starting_point(self) -> IPMState:
         state = _dense_start(
@@ -256,9 +270,10 @@ class DenseJaxBackend(SolverBackend):
             self._params,
             self._factor_dtype_name,
             self._refine,
-            self._cfg.max_iter,
-            self._cfg.max_refactor,
-            self._cfg.reg_grow,
+            jnp.asarray(self._cfg.max_iter, jnp.int32),
+            jnp.asarray(self._cfg.max_refactor, jnp.int32),
+            jnp.asarray(self._cfg.reg_grow, self._dtype),
+            core.buffer_cap(self._cfg.max_iter),
             self._use_pallas,
             self._Af,
         )

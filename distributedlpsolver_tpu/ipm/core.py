@@ -316,7 +316,14 @@ def classify_divergence(mu, pinf, dinf, rel_gap, pobj, dobj):
     return pinfeas, dinfeas
 
 
-def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow):
+def buffer_cap(max_iter: int, quantum: int = 256) -> int:
+    """Static stats-buffer size for :func:`fused_solve`, bucketed so that
+    different ``max_iter`` values share one compiled executable (max_iter
+    itself is a *traced* loop bound; only this cap is a jit key)."""
+    return ((max(int(max_iter), 1) + quantum - 1) // quantum) * quantum
+
+
+def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap=None):
     """Entire IPM solve as one traced program (``lax.while_loop`` over
     iterations) — jax-only, called from inside a backend's jit.
 
@@ -325,15 +332,24 @@ def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow)
     loop semantics: deterministic regularization escalation on bad steps
     (state frozen, reg ×= grow, give up after max_refactor), convergence
     at params.tol on rel_gap/pinf/dinf. Per-iteration stats stream into a
-    fixed (max_iter, N_STAT) buffer so the host can reconstruct the full
+    fixed (buf_cap, N_STAT) buffer so the host can reconstruct the full
     iteration log afterwards. Returns (state, iterations, status, buffer).
+
+    ``max_iter``, ``max_refactor``, and ``reg_grow`` may be traced scalars —
+    changing them never recompiles; only ``buf_cap`` (static, bucketed via
+    :func:`buffer_cap`) is part of the compile key. ``buf_cap`` is REQUIRED
+    whenever ``max_iter`` is traced (the default derives it via
+    ``int(max_iter)``, which only works on concrete values).
     """
     import jax
     import jax.numpy as jnp
 
+    if buf_cap is None:
+        buf_cap = buffer_cap(int(max_iter))
+
     def cond(carry):
         _, it, _, _, status, _ = carry
-        return (status == STATUS_RUNNING) & (it < max_iter)
+        return (status == STATUS_RUNNING) & (it < max_iter) & (it < buf_cap)
 
     def body(carry):
         state, it, reg, badcount, status, buf = carry
@@ -373,7 +389,7 @@ def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow)
         reg = jnp.where(bad, jnp.maximum(reg, 1e-12) * reg_grow, reg)
         return state, it, reg, badcount, status, buf
 
-    buf0 = jnp.zeros((max_iter, N_STAT), dtype=state0.x.dtype)
+    buf0 = jnp.zeros((buf_cap, N_STAT), dtype=state0.x.dtype)
     carry0 = (
         state0,
         jnp.asarray(0, jnp.int32),

@@ -59,6 +59,8 @@ class LPProblem:
     block_structure: Optional[dict] = None
 
     def __post_init__(self):
+        if not sp.issparse(self.A):
+            self.A = np.asarray(self.A, dtype=np.float64)
         self.c = np.asarray(self.c, dtype=np.float64).ravel()
         self.rlb = np.asarray(self.rlb, dtype=np.float64).ravel()
         self.rub = np.asarray(self.rub, dtype=np.float64).ravel()

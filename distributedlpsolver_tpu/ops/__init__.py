@@ -4,6 +4,7 @@ from distributedlpsolver_tpu.ops.normal_eq import (
     normal_eq,
     normal_eq_pallas,
     normal_eq_reference,
+    pad_for_pallas,
     supports_pallas,
 )
 
@@ -11,5 +12,6 @@ __all__ = [
     "normal_eq",
     "normal_eq_pallas",
     "normal_eq_reference",
+    "pad_for_pallas",
     "supports_pallas",
 ]

@@ -35,6 +35,14 @@ def _round_up(x: int, mult: int) -> int:
     return -(-x // mult) * mult
 
 
+# Shared tile-size defaults: pad_for_pallas and normal_eq_pallas MUST agree,
+# or the kernel re-pads the m×n matrix every call (the exact per-iteration
+# HBM copy the setup-time pre-pad exists to avoid) — guarded by the out_m
+# alignment check below.
+BLOCK_M = 256
+BLOCK_K = 512
+
+
 def _ne_kernel(a_i_ref, a_j_ref, d_ref, out_ref, acc_ref):
     k = pl.program_id(2)
 
@@ -59,29 +67,54 @@ def _ne_kernel(a_i_ref, a_j_ref, d_ref, out_ref, acc_ref):
         out_ref[:] = acc_ref[:].astype(out_ref.dtype)
 
 
+def pad_for_pallas(A, block_m: int = BLOCK_M, block_k: int = BLOCK_K):
+    """Zero-pad ``A`` to the kernel's tile multiples ONCE (call at setup).
+
+    ``A`` is loop-invariant across IPM iterations; padding it per
+    ``normal_eq_pallas`` call would re-materialize an m×n HBM copy every
+    factorization. Pass the padded matrix plus ``out_m=<true m>`` instead.
+    """
+    m, n = A.shape
+    mp, np_ = _round_up(m, block_m), _round_up(n, block_k)
+    if (mp, np_) == (m, n):
+        return A
+    return jnp.pad(A, ((0, mp - m), (0, np_ - n)))
+
+
 @functools.partial(
-    jax.jit, static_argnames=("block_m", "block_k", "interpret", "out_dtype")
+    jax.jit, static_argnames=("block_m", "block_k", "interpret", "out_dtype", "out_m")
 )
 def normal_eq_pallas(
     A,
     d,
     *,
-    block_m: int = 256,
-    block_k: int = 512,
+    block_m: int = BLOCK_M,
+    block_k: int = BLOCK_K,
     out_dtype=None,
     interpret: bool = False,
+    out_m: int | None = None,
 ):
     """``A @ diag(d) @ A.T`` without materializing the scaled matrix.
 
-    A: (m, n) f32/bf16; d: (n,) — padded internally to tile multiples
-    (zero-padding d zeroes the padded columns' contribution, so the result
-    is exact). Returns (m, m) in ``out_dtype`` (default f32).
+    A: (m, n) f32/bf16; d: (n',) with n' ≤ n — both padded to tile
+    multiples (zero-padding d zeroes the padded columns' contribution, so
+    the result is exact). ``A`` may be pre-padded via :func:`pad_for_pallas`
+    with ``out_m`` giving the true row count; padding here is skipped when
+    shapes are already aligned. Returns (out_m, out_m) in ``out_dtype``
+    (default f32).
     """
     m, n = A.shape
     out_dtype = jnp.dtype(out_dtype or jnp.float32)
     mp, np_ = _round_up(m, block_m), _round_up(n, block_k)
-    Ap = jnp.pad(A, ((0, mp - m), (0, np_ - n)))
-    dp = jnp.pad(d.astype(A.dtype), (0, np_ - n)).reshape(1, np_)
+    if out_m is not None and (mp, np_) != (m, n):
+        raise ValueError(
+            f"A {A.shape} with out_m={out_m} must be pre-padded to tile "
+            f"multiples ({block_m}, {block_k}) — use pad_for_pallas with "
+            "matching block sizes"
+        )
+    out_m = out_m if out_m is not None else m
+    Ap = A if (mp, np_) == (m, n) else jnp.pad(A, ((0, mp - m), (0, np_ - n)))
+    dp = jnp.pad(d.astype(A.dtype), (0, np_ - d.shape[0])).reshape(1, np_)
 
     grid = (mp // block_m, mp // block_m, np_ // block_k)
     out = pl.pallas_call(
@@ -102,7 +135,7 @@ def normal_eq_pallas(
         ),
         interpret=interpret,
     )(Ap, Ap, dp)
-    return out[:m, :m]
+    return out[:out_m, :out_m]
 
 
 def normal_eq_reference(A, d):
