@@ -155,7 +155,7 @@ def solve_batched(
     if config_overrides:
         cfg = cfg.replace(**config_overrides)
     dtype = jnp.dtype(cfg.dtype)
-    fname = jnp.dtype(cfg.factor_dtype or cfg.dtype).name
+    fname = jnp.dtype(cfg.factor_dtype_resolved()).name
 
     t0 = time.perf_counter()
     A = np.asarray(batch.A, dtype=dtype)

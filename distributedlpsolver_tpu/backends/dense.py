@@ -60,6 +60,11 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
             # refinement loop would read is never consumed — casting up to
             # A.dtype would be an m×m f64 HBM round trip of pure waste.
             M = normal_eq_pallas(Af, d.astype(factor_dtype), out_m=A.shape[0])
+        elif Af is not None:
+            # Plain-XLA low-precision assembly on the precast copy: the
+            # O(m²n) GEMM runs in factor_dtype on the MXU instead of
+            # emulated f64 (two-phase phase 1 off-TPU-pallas / sharded).
+            M = (Af * d.astype(Af.dtype)[None, :]) @ Af.T
         else:
             M = (A * d[None, :]) @ A.T
         # Per-row *relative* diagonal perturbation: with heterogeneous d the
@@ -137,6 +142,57 @@ def _dense_solve_full(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "params", "params_p1", "refine_steps", "buf_cap", "pallas_p1", "stall_window"
+    ),
+)
+def _dense_solve_two_phase(
+    A, A32, data, state0, reg0, params, params_p1, max_iter, max_refactor,
+    reg_grow, buf_cap, refine_steps, pallas_p1, stall_window,
+):
+    """Mixed-precision fused solve: f32 factorizations (MXU-native) down to
+    the handoff tolerance, then f64 warm-started from the same iterate —
+    one compiled program, one stats buffer, global iteration count.
+
+    Phase 1 is pure speed (every factorization + assembly in f32, KKT
+    residuals/refinement still f64) and runs under ``params_p1``, whose
+    loosened tol both ends the phase at the handoff point and keys the
+    μ-floor so the iterate stays centered — grinding f32 at its ~1e-6
+    noise floor instead injures the iterate beyond f64 repair (observed:
+    handing over a stalled iterate leaves even f64 stuck). Phase 2 always
+    re-enters at full precision/tolerance: a phase-1 "optimal" is only
+    optimal at the handoff tol, and a phase-1 numerical failure deserves an
+    f64 retry, so both reset to RUNNING. This is the SURVEY.md §7
+    mixed-precision design, scheduled rather than per-solve-chosen.
+    """
+    f32 = jnp.dtype(jnp.float32)
+
+    def step32(state, reg):
+        ops = _make_ops(A, reg, f32, 0, pallas_p1, A32)
+        return core.mehrotra_step(ops, data, params_p1, state)
+
+    def step64(state, reg):
+        ops = _make_ops(A, reg, A.dtype, refine_steps, False, None)
+        return core.mehrotra_step(ops, data, params, state)
+
+    st1, it1, status1, buf = core.fused_solve(
+        step32, state0, reg0, params_p1, max_iter, max_refactor, reg_grow,
+        buf_cap, stall_window=stall_window, finalize=False,
+    )
+    # Every phase-1 verdict is provisional: "optimal" is only optimal at
+    # the handoff tol, a numerical failure deserves an f64 retry, and the
+    # infeasibility heuristics can misfire on f32 factorization error —
+    # phase 2 re-derives all of them at full precision.
+    status1 = jnp.full_like(status1, core.STATUS_RUNNING)
+    return core.fused_solve(
+        step64, st1, reg0, params, max_iter, max_refactor, reg_grow,
+        buf_cap, stall_window=2 * stall_window if stall_window else 0,
+        carry_in=(it1, status1, buf), finalize=True,
+    )
+
+
 @register_backend("tpu", "dense", "jax")
 class DenseJaxBackend(SolverBackend):
     """Single-device dense path (afiro / random-dense configs,
@@ -168,7 +224,7 @@ class DenseJaxBackend(SolverBackend):
         self._cfg = config
         self._reg = config.reg_dual
         dtype = jnp.dtype(config.dtype)
-        factor_dtype = jnp.dtype(config.factor_dtype or config.dtype)
+        factor_dtype = jnp.dtype(config.factor_dtype_resolved())
         refine = config.refine_steps
 
         A_host = inf.A.toarray() if sp.issparse(inf.A) else np.asarray(inf.A)
@@ -206,19 +262,21 @@ class DenseJaxBackend(SolverBackend):
         # which GSPMD-partitions into the psum-combined Schur form.
         from distributedlpsolver_tpu.ops import supports_pallas
 
+        two_phase = config.two_phase_enabled(jax.default_backend()) and mat_s is None
         pallas_ok = mat_s is None and refine == 0 and supports_pallas(factor_dtype)
         if config.use_pallas is None:
             self._use_pallas = pallas_ok
-        elif config.use_pallas and not pallas_ok:
+        elif config.use_pallas and not (pallas_ok or two_phase):
             raise ValueError(
                 "use_pallas=True requires single-device placement, "
-                "refine_steps=0, and a single-precision factor_dtype on a "
-                f"TPU (got factor_dtype={jnp.dtype(factor_dtype).name}, "
+                "refine_steps=0, and a single-precision (or auto two-phase) "
+                f"factor_dtype on a TPU (got factor_dtype="
+                f"{jnp.dtype(factor_dtype).name}, "
                 f"refine_steps={refine}, sharded={mat_s is not None}, "
                 f"platform={jax.default_backend()})"
             )
         else:
-            self._use_pallas = bool(config.use_pallas)
+            self._use_pallas = bool(config.use_pallas) and pallas_ok
         # Loop-invariant precast + tile-pad for the Pallas path: once here,
         # not per factorize call (A never changes across iterations).
         if self._use_pallas:
@@ -227,6 +285,21 @@ class DenseJaxBackend(SolverBackend):
             self._Af = pad_for_pallas(A.astype(factor_dtype))
         else:
             self._Af = None
+
+        # Two-phase (f32→f64) fused schedule: "auto" factor dtype on a TPU,
+        # single-device placement only for now (the sharded path would need
+        # the f32 copy laid out on the mesh — future work). The f32 copy is
+        # materialized lazily in solve_full: the host-driver path (e.g.
+        # per-iteration checkpointing disables the fused loop) never reads
+        # it, and at large m×n it is real HBM. An explicit use_pallas=False
+        # opts phase 1 out of the Pallas kernel too (plain-XLA f32 GEMM).
+        self._two_phase = two_phase
+        self._pallas_p1 = (
+            two_phase
+            and supports_pallas(jnp.float32)
+            and config.use_pallas is not False
+        )
+        self._A32 = None
 
     def starting_point(self) -> IPMState:
         state = _dense_start(
@@ -262,6 +335,34 @@ class DenseJaxBackend(SolverBackend):
         return True
 
     def solve_full(self, state: IPMState):
+        if self._two_phase:
+            cfg = self._cfg
+            if self._A32 is None:
+                if self._pallas_p1:
+                    from distributedlpsolver_tpu.ops import pad_for_pallas
+
+                    self._A32 = pad_for_pallas(self._A.astype(jnp.float32))
+                else:  # plain-XLA f32 assembly (pallas opted out/unsupported)
+                    self._A32 = self._A.astype(jnp.float32)
+            params_p1 = cfg.replace(
+                tol=max(cfg.tol, cfg.phase1_tol)
+            ).step_params()
+            return _dense_solve_two_phase(
+                self._A,
+                self._A32,
+                self._data,
+                state,
+                jnp.asarray(self._reg, self._dtype),
+                self._params,
+                params_p1,
+                jnp.asarray(self._cfg.max_iter, jnp.int32),
+                jnp.asarray(self._cfg.max_refactor, jnp.int32),
+                jnp.asarray(self._cfg.reg_grow, self._dtype),
+                core.buffer_cap(self._cfg.max_iter),
+                self._refine,
+                self._pallas_p1,
+                self._cfg.stall_window,
+            )
         return _dense_solve_full(
             self._A,
             self._data,

@@ -29,7 +29,11 @@ def _add_solver_flags(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--checkpoint", default=None, help="iterate checkpoint path")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--profile-dir", default=None, help="jax.profiler trace directory")
-    ap.add_argument("--factor-dtype", default=None, help="e.g. float32 for MXU Cholesky")
+    ap.add_argument(
+        "--factor-dtype",
+        default="auto",
+        help="Cholesky dtype: auto = f32→f64 two-phase on TPU; or float32/float64",
+    )
     ap.add_argument("--json", action="store_true", help="print result as one JSON object")
     ap.add_argument("--x-out", default=None, help="write solution vector as .npy")
 

@@ -32,7 +32,22 @@ class SolverConfig:
     reg_grow: float = 100.0  # factor applied on factorization failure
     max_refactor: int = 5  # NaN-recovery attempts per iteration
     dtype: str = "float64"  # iterate/residual dtype
-    factor_dtype: Optional[str] = None  # Cholesky dtype; None = same as dtype
+    # Cholesky/assembly dtype. "auto" (default) = two-phase on TPU: f32
+    # factorizations (MXU-native) until optimal or stalled, then f64
+    # warm-started to the full tolerance — elsewhere plain ``dtype``.
+    # A concrete name ("float32"/"float64") forces single-phase at that
+    # precision; None = same as dtype.
+    factor_dtype: Optional[str] = "auto"
+    # Accepted steps without ≥10% improvement in max(gap, pinf, dinf)
+    # before a fused-loop phase gives up (phase 1 hands over to f64;
+    # a final phase reports Status.STALLED). 0 disables.
+    stall_window: int = 8
+    # Two-phase handoff tolerance: phase 1 (f32) converges to
+    # max(tol, phase1_tol) and hands the iterate to f64 — safely above the
+    # f32 noise floor (~1e-6), where grinding injures the iterate's
+    # centrality beyond what f64 can repair (observed). Phase 1's μ-floor
+    # is also keyed to this, keeping the handoff iterate well-centered.
+    phase1_tol: float = 3e-5
     # Fused Pallas normal-equations assembly (ops/normal_eq.py). None =
     # auto: on for single-device TPU placement with a single-precision
     # factor_dtype and refine_steps == 0.
@@ -58,6 +73,17 @@ class SolverConfig:
 
     def replace(self, **kw) -> "SolverConfig":
         return dataclasses.replace(self, **kw)
+
+    def factor_dtype_resolved(self) -> str:
+        """Concrete factorization dtype for single-phase execution paths
+        ("auto" resolves to ``dtype`` — the two-phase schedule is a backend
+        decision, see :meth:`two_phase_enabled`)."""
+        fd = self.factor_dtype
+        return self.dtype if fd in (None, "auto") else fd
+
+    def two_phase_enabled(self, platform: str) -> bool:
+        """Whether the f32→f64 two-phase fused solve should be used."""
+        return self.factor_dtype == "auto" and platform == "tpu"
 
     def step_params(self) -> "StepParams":
         return StepParams(

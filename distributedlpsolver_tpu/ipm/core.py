@@ -291,6 +291,7 @@ def mehrotra_step(
 
 STATUS_RUNNING, STATUS_OPTIMAL, STATUS_MAXITER, STATUS_NUMERR = 0, 1, 2, 3
 STATUS_PINFEAS, STATUS_DINFEAS = 4, 5
+STATUS_STALL = 6  # no max(gap,pinf,dinf) improvement over the stall window
 N_STAT = 10  # mu, gap, rel_gap, pinf, dinf, pobj, dobj, alpha_p, alpha_d, sigma
 
 DIVERGE_MU = 1e30
@@ -323,7 +324,20 @@ def buffer_cap(max_iter: int, quantum: int = 256) -> int:
     return ((max(int(max_iter), 1) + quantum - 1) // quantum) * quantum
 
 
-def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap=None):
+def fused_solve(
+    step_fn,
+    state0,
+    reg0,
+    params,
+    max_iter,
+    max_refactor,
+    reg_grow,
+    buf_cap=None,
+    *,
+    stall_window=0,
+    carry_in=None,
+    finalize=True,
+):
     """Entire IPM solve as one traced program (``lax.while_loop`` over
     iterations) — jax-only, called from inside a backend's jit.
 
@@ -340,6 +354,17 @@ def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow,
     :func:`buffer_cap`) is part of the compile key. ``buf_cap`` is REQUIRED
     whenever ``max_iter`` is traced (the default derives it via
     ``int(max_iter)``, which only works on concrete values).
+
+    ``stall_window`` (static) > 0 adds a stall exit: if the error measure
+    ``max(rel_gap, pinf, dinf)`` fails to improve by ≥10% over that many
+    accepted steps, the loop stops (status ``STATUS_STALL`` if this is the
+    ``finalize`` phase, else left ``STATUS_RUNNING`` for a continuation).
+
+    Phase composition (mixed-precision two-phase solves): pass
+    ``finalize=False`` to leave a non-terminal exit as ``STATUS_RUNNING``
+    and feed ``(it, status, buf)`` of one call as ``carry_in`` of the next —
+    the continuation resumes the global iteration count and appends to the
+    same stats buffer.
     """
     import jax
     import jax.numpy as jnp
@@ -348,11 +373,14 @@ def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow,
         buf_cap = buffer_cap(int(max_iter))
 
     def cond(carry):
-        _, it, _, _, status, _ = carry
-        return (status == STATUS_RUNNING) & (it < max_iter) & (it < buf_cap)
+        _, it, _, _, status, _, _, since = carry
+        go = (status == STATUS_RUNNING) & (it < max_iter) & (it < buf_cap)
+        if stall_window:
+            go = go & (since <= stall_window)
+        return go
 
     def body(carry):
-        state, it, reg, badcount, status, buf = carry
+        state, it, reg, badcount, status, buf, best_err, since = carry
         new_state, stats = step_fn(state, reg)
         bad = stats.bad
         conv = (
@@ -386,20 +414,41 @@ def fused_solve(step_fn, state0, reg0, params, max_iter, max_refactor, reg_grow,
             STATUS_NUMERR,
             status,
         )
+        err = jnp.maximum(stats.rel_gap, jnp.maximum(stats.pinf, stats.dinf))
+        improved = ~bad & (err < 0.9 * best_err)
+        best_err = jnp.where(improved, err, best_err)
+        since = jnp.where(bad, since, jnp.where(improved, 0, since + 1))
         reg = jnp.where(bad, jnp.maximum(reg, 1e-12) * reg_grow, reg)
-        return state, it, reg, badcount, status, buf
+        return state, it, reg, badcount, status, buf, best_err, since
 
-    buf0 = jnp.zeros((buf_cap, N_STAT), dtype=state0.x.dtype)
+    if carry_in is not None:
+        it0, status0, buf0 = carry_in
+        it0 = jnp.asarray(it0, jnp.int32)
+        status0 = jnp.asarray(status0, jnp.int32)
+    else:
+        it0 = jnp.asarray(0, jnp.int32)
+        status0 = jnp.asarray(STATUS_RUNNING, jnp.int32)
+        buf0 = jnp.zeros((buf_cap, N_STAT), dtype=state0.x.dtype)
     carry0 = (
         state0,
-        jnp.asarray(0, jnp.int32),
+        it0,
         reg0,
         jnp.asarray(0, jnp.int32),
-        jnp.asarray(STATUS_RUNNING, jnp.int32),
+        status0,
         buf0,
+        jnp.asarray(jnp.inf, state0.x.dtype),
+        jnp.asarray(0, jnp.int32),
     )
-    state, it, reg, _, status, buf = jax.lax.while_loop(cond, body, carry0)
-    status = jnp.where(status == STATUS_RUNNING, STATUS_MAXITER, status)
+    state, it, reg, _, status, buf, _, since = jax.lax.while_loop(cond, body, carry0)
+    if finalize:
+        stalled = (
+            (since > stall_window) if stall_window else jnp.asarray(False)
+        )
+        status = jnp.where(
+            status == STATUS_RUNNING,
+            jnp.where(stalled & (it < max_iter), STATUS_STALL, STATUS_MAXITER),
+            status,
+        )
     return state, it, status, buf
 
 

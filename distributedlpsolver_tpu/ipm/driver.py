@@ -202,6 +202,7 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
         core.STATUS_NUMERR: Status.NUMERICAL_ERROR,
         core.STATUS_PINFEAS: Status.PRIMAL_INFEASIBLE,
         core.STATUS_DINFEAS: Status.DUAL_INFEASIBLE,
+        core.STATUS_STALL: Status.STALLED,
     }.get(int(np.asarray(status_code)), Status.NUMERICAL_ERROR)
 
     t_avg = solve_time / max(iters, 1)

@@ -52,6 +52,7 @@ class Status(enum.Enum):
     NUMERICAL_ERROR = "numerical_error"
     PRIMAL_INFEASIBLE = "primal_infeasible"
     DUAL_INFEASIBLE = "dual_infeasible"  # == primal unbounded
+    STALLED = "stalled"  # no progress over the stall window (fused loop)
 
 
 @dataclasses.dataclass

@@ -112,6 +112,11 @@ def normal_eq_pallas(
             f"multiples ({block_m}, {block_k}) — use pad_for_pallas with "
             "matching block sizes"
         )
+    # Without out_m, A is unpadded and d must match its columns exactly;
+    # with out_m, d is the pre-pad-length vector (shorter than the padded
+    # n) and the zero-extension below is the intended semantics.
+    if out_m is None and d.shape[0] != n:
+        raise ValueError(f"d has shape {d.shape}, expected ({n},) to match A")
     out_m = out_m if out_m is not None else m
     Ap = A if (mp, np_) == (m, n) else jnp.pad(A, ((0, mp - m), (0, np_ - n)))
     dp = jnp.pad(d.astype(A.dtype), (0, np_ - d.shape[0])).reshape(1, np_)

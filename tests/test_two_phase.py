@@ -1,0 +1,241 @@
+"""Two-phase (f32→f64) fused solve, stall detection, and phase composition.
+
+The two-phase schedule is the default TPU execution path
+(``factor_dtype="auto"``, SURVEY.md §7 mixed-precision design), so its
+machinery — ``fused_solve`` stall exits, ``carry_in`` composition,
+``buffer_cap`` bucketing, the Pallas pre-pad contract — is tested here on
+the CPU test platform: phase 1 runs its plain-XLA f32 assembly branch
+(``use_pallas=False``) and the platform gate is monkeypatched, per the
+SURVEY.md §4 fake-backend strategy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import core, solve
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import IPMState, Status, StepStats
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+from distributedlpsolver_tpu.ops import normal_eq_pallas, pad_for_pallas
+from tests.oracle import highs_on_general
+
+
+# ---------------------------------------------------------------- helpers
+def _const_stats(rel_gap, pinf=0.0, dinf=0.0, bad=False):
+    z = jnp.asarray(0.0, jnp.float64)
+    return StepStats(
+        mu=jnp.asarray(rel_gap, jnp.float64),
+        gap=jnp.asarray(rel_gap, jnp.float64),
+        rel_gap=jnp.asarray(rel_gap, jnp.float64),
+        pinf=jnp.asarray(pinf, jnp.float64),
+        dinf=jnp.asarray(dinf, jnp.float64),
+        pobj=z,
+        dobj=z,
+        alpha_p=z,
+        alpha_d=z,
+        sigma=z,
+        bad=jnp.asarray(bad),
+    )
+
+
+def _tiny_state():
+    one = jnp.ones(2, jnp.float64)
+    return IPMState(x=one, y=jnp.ones(1, jnp.float64), s=one, w=one, z=one * 0)
+
+
+_PARAMS = SolverConfig().step_params()
+_REG0 = jnp.asarray(1e-10, jnp.float64)
+
+
+def _run(step, max_iter=50, stall_window=0, carry_in=None, finalize=True):
+    return core.fused_solve(
+        step,
+        _tiny_state(),
+        _REG0,
+        _PARAMS,
+        max_iter,
+        5,
+        100.0,
+        core.buffer_cap(max_iter),
+        stall_window=stall_window,
+        carry_in=carry_in,
+        finalize=finalize,
+    )
+
+
+# ------------------------------------------------------------- buffer_cap
+def test_buffer_cap_buckets():
+    assert core.buffer_cap(1) == 256
+    assert core.buffer_cap(200) == 256
+    assert core.buffer_cap(256) == 256
+    assert core.buffer_cap(257) == 512
+    assert core.buffer_cap(1000) == 1024
+
+
+# ------------------------------------------------- stall exit & finalize
+def test_stall_exit_reports_stalled():
+    # Error never improves -> with a stall window the loop must stop well
+    # before max_iter and report STATUS_STALL (not MAXITER).
+    def step(state, reg):
+        return state, _const_stats(1e-3)
+
+    _, it, status, _ = _run(step, max_iter=100, stall_window=5)
+    assert int(status) == core.STATUS_STALL
+    assert int(it) <= 8  # window + the first few establishing best_err
+
+
+def test_stall_disabled_runs_to_max_iter():
+    def step(state, reg):
+        return state, _const_stats(1e-3)
+
+    _, it, status, _ = _run(step, max_iter=30, stall_window=0)
+    assert int(status) == core.STATUS_MAXITER
+    assert int(it) == 30
+
+
+def test_non_finalize_leaves_running_on_stall():
+    def step(state, reg):
+        return state, _const_stats(1e-3)
+
+    _, _, status, _ = _run(step, max_iter=100, stall_window=5, finalize=False)
+    assert int(status) == core.STATUS_RUNNING
+
+
+# ------------------------------------------------------ phase composition
+def test_carry_in_resumes_iteration_count_and_buffer():
+    # Phase A: 3 iterations whose rel_gap halves every step (derived from
+    # the state), stopped by max_iter=3 with finalize=False.
+    def step_a(state, reg):
+        new = state._replace(x=state.x * 0.5)
+        return new, _const_stats(1e-3)._replace(rel_gap=jnp.sum(new.x))
+
+    st, it1, status1, buf = _run(step_a, max_iter=3, finalize=False)
+    assert int(status1) == core.STATUS_RUNNING
+    assert int(it1) == 3
+    rows_a = np.asarray(buf)[:3, 2]  # rel_gap column
+    assert (rows_a > 0).all()
+
+    # Phase B resumes at iteration 3 and appends to the same buffer.
+    def step_b(state, reg):
+        return state, _const_stats(0.0)  # instantly optimal
+
+    st2, it2, status2, buf2 = core.fused_solve(
+        step_b,
+        st,
+        _REG0,
+        _PARAMS,
+        50,
+        5,
+        100.0,
+        core.buffer_cap(50),
+        carry_in=(it1, status1, buf),
+        finalize=True,
+    )
+    assert int(status2) == core.STATUS_OPTIMAL
+    assert int(it2) == 4  # one phase-B iteration after three phase-A ones
+    out = np.asarray(buf2)
+    np.testing.assert_allclose(out[:3, 2], rows_a)  # phase-A rows intact
+    assert out[3, 2] == 0.0  # phase-B row appended at the global index
+
+
+def test_carry_in_terminal_status_skips_loop():
+    def step(state, reg):  # must never run
+        return state, _const_stats(0.0, bad=True)
+
+    st0 = _tiny_state()
+    buf0 = jnp.zeros((256, core.N_STAT), jnp.float64)
+    _, it, status, _ = core.fused_solve(
+        step,
+        st0,
+        _REG0,
+        _PARAMS,
+        50,
+        5,
+        100.0,
+        256,
+        carry_in=(jnp.asarray(7), jnp.asarray(core.STATUS_OPTIMAL), buf0),
+    )
+    assert int(status) == core.STATUS_OPTIMAL
+    assert int(it) == 7
+
+
+# ------------------------------------------------- end-to-end two-phase
+def test_two_phase_solves_to_full_tol(monkeypatch):
+    # Force the platform gate open on CPU; phase 1 then runs the plain-XLA
+    # f32 assembly branch (use_pallas=False keeps Pallas out of the way).
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+
+    p = random_dense_lp(30, 80, seed=5)
+    be = DenseJaxBackend()
+    r = solve(p, backend=be, factor_dtype="auto", use_pallas=False)
+    assert be._two_phase
+    assert not be._pallas_p1
+    assert r.status == Status.OPTIMAL
+    assert r.rel_gap <= 1e-8 and r.pinf <= 1e-8 and r.dinf <= 1e-8
+    ref = highs_on_general(p)
+    np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
+    # the iteration log must cover every iteration exactly once
+    assert len(r.history) == r.iterations
+    assert [rec.iter for rec in r.history] == list(range(1, r.iterations + 1))
+
+
+def test_auto_is_single_phase_off_tpu():
+    from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+
+    p = random_dense_lp(10, 24, seed=3)
+    be = DenseJaxBackend()
+    r = solve(p, backend=be)  # default factor_dtype="auto" on CPU platform
+    assert not be._two_phase
+    assert be._factor_dtype_name == "float64"
+    assert r.status == Status.OPTIMAL
+
+
+def test_use_pallas_false_respected_in_two_phase(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+
+    p = random_dense_lp(12, 30, seed=2)
+    be = DenseJaxBackend()
+    be.setup(
+        __import__(
+            "distributedlpsolver_tpu.models.problem", fromlist=["to_interior_form"]
+        ).to_interior_form(p),
+        SolverConfig(use_pallas=False),
+    )
+    assert be._two_phase and not be._pallas_p1
+
+
+# --------------------------------------------------- pad_for_pallas contract
+def test_pad_for_pallas_roundtrip_matches_reference():
+    rng = np.random.default_rng(7)
+    m, n = 50, 130
+    A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+    d = jnp.asarray(rng.random(n) + 0.1, jnp.float32)
+    Ap = pad_for_pallas(A, block_m=64, block_k=64)
+    assert Ap.shape == (64, 192)
+    M = normal_eq_pallas(Ap, d, block_m=64, block_k=64, out_m=m, interpret=True)
+    Mr = (A * d[None, :]) @ A.T
+    assert M.shape == (m, m)
+    np.testing.assert_allclose(np.asarray(M), np.asarray(Mr), rtol=2e-4, atol=1e-4)
+
+
+def test_pad_for_pallas_aligned_is_identity():
+    A = jnp.zeros((64, 128), jnp.float32)
+    assert pad_for_pallas(A, block_m=64, block_k=64) is A
+
+
+def test_out_m_requires_prepadded_matrix():
+    A = jnp.zeros((50, 130), jnp.float32)  # NOT tile-aligned
+    d = jnp.ones(130, jnp.float32)
+    with pytest.raises(ValueError, match="pre-padded"):
+        normal_eq_pallas(A, d, block_m=64, block_k=64, out_m=50, interpret=True)
+
+
+def test_short_d_rejected_without_out_m():
+    A = jnp.zeros((64, 128), jnp.float32)
+    d = jnp.ones(100, jnp.float32)  # wrong length
+    with pytest.raises(ValueError, match="expected"):
+        normal_eq_pallas(A, d, block_m=64, block_k=64, interpret=True)
