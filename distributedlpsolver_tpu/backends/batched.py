@@ -40,6 +40,7 @@ from distributedlpsolver_tpu.models.generators import BatchedLP
 from distributedlpsolver_tpu.parallel import mesh as mesh_lib
 
 _RUNNING, _OPTIMAL, _MAXITER, _NUMERR = 0, 1, 2, 3
+_STALL = 6  # aligned with core.STATUS_STALL
 
 
 @dataclasses.dataclass
@@ -71,20 +72,33 @@ def _single_start(A, data, reg, params, factor_dtype):
     return core.starting_point(ops, data, params)
 
 
-@functools.partial(jax.jit, static_argnames=("params", "factor_dtype"))
-def _solve_batched_jit(A, data, reg0, params, max_iter, max_refactor, reg_grow, factor_dtype):
-    # max_iter / max_refactor / reg_grow are traced scalars so one compile
-    # serves every iteration-limit config (warm-up shares the timed compile).
-    fdt = jnp.dtype(factor_dtype)
+def _batched_phase(
+    A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
+    it_stop=None, stall_window=0, stall_status=_RUNNING,
+):
+    """One masked batched IPM while_loop phase over the whole batch.
+
+    ``carry = (states, active, it, regs, badcount, status, iters, best,
+    since)``; ``it`` is phase-local, ``iters`` counts accepted steps per
+    problem globally, ``best``/``since`` drive per-problem stall detection
+    (``stall_window`` accepted steps without 10% improvement in
+    max(gap,pinf,dinf) deactivates a problem with ``stall_status`` — in a
+    non-final phase that's _RUNNING, so the next phase picks it up; without
+    this, f32-stalled problems grind the whole max_iter budget).
+    ``it_stop`` (traced) additionally bounds this call for host
+    segmentation (core.drive_segments' watchdog guard).
+    """
     B = A.shape[0]
-    states0 = jax.vmap(lambda a, d: _single_start(a, d, reg0, params, fdt))(A, data)
 
     def cond(carry):
         _, active, it, *_ = carry
-        return jnp.any(active) & (it < max_iter)
+        go = jnp.any(active) & (it < max_iter)
+        if it_stop is not None:
+            go = go & (it < it_stop)
+        return go
 
     def body(carry):
-        states, active, it, regs, badcount, status, iters = carry
+        states, active, it, regs, badcount, status, iters, best, since = carry
         new_states, stats = jax.vmap(
             lambda a, d, st, rg: _single_step(a, d, st, rg, params, fdt)
         )(A, data, states, regs)
@@ -109,22 +123,107 @@ def _solve_batched_jit(A, data, reg0, params, max_iter, max_refactor, reg_grow, 
         badcount = jnp.where(active & bad, badcount + 1, badcount)
         give_up = badcount > max_refactor
         newly_opt = accept & conv
+        err = jnp.maximum(stats.rel_gap, jnp.maximum(stats.pinf, stats.dinf))
+        improved = accept & (err < 0.9 * best)
+        best = jnp.where(improved, err, best)
+        since = jnp.where(
+            active & ~bad, jnp.where(improved, 0, since + 1), since
+        )
+        if stall_window:
+            stalled = active & (since > stall_window)
+            if stall_status == _STALL:
+                # Final phase: near-tol plateaus deserve patience — only
+                # give up while still far (>1e3·tol) from tolerance.
+                stalled = stalled & (best > 1e3 * params.tol)
+        else:
+            stalled = jnp.zeros_like(active)
         status = jnp.where(newly_opt, _OPTIMAL, status)
         status = jnp.where(active & give_up, _NUMERR, status)
-        active = active & ~newly_opt & ~give_up
-        return states, active, it + 1, regs, badcount, status, iters
+        status = jnp.where(stalled & ~newly_opt & ~give_up, stall_status, status)
+        active = active & ~newly_opt & ~give_up & ~stalled
+        return states, active, it + 1, regs, badcount, status, iters, best, since
 
-    dtype = A.dtype
-    carry0 = (
-        states0,
-        jnp.ones(B, dtype=bool),
-        jnp.asarray(0, jnp.int32),
-        jnp.full(B, reg0, dtype=dtype),
-        jnp.zeros(B, jnp.int32),
-        jnp.full(B, _RUNNING, jnp.int32),
-        jnp.zeros(B, jnp.int32),
+    return jax.lax.while_loop(cond, body, carry)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "factor_dtype", "stall_window", "stall_status"),
+)
+def _batched_segment_jit(
+    A, data, carry, it_stop, max_iter, max_refactor, reg_grow, params,
+    factor_dtype, stall_window=0, stall_status=_RUNNING,
+):
+    out = _batched_phase(
+        A, data, carry, params, max_iter, max_refactor, reg_grow,
+        jnp.dtype(factor_dtype), it_stop, stall_window, stall_status,
     )
-    states, active, _, _, _, status, iters = jax.lax.while_loop(cond, body, carry0)
+    # Packed [it, status, best, since] in core.drive_segments' meta layout
+    # (one device→host transfer per segment — separate scalar fetches cost
+    # a tunnel round trip each). Per-problem statuses/stall live inside the
+    # loop, so the batch-level "status" is just the all-settled predicate.
+    f = A.dtype
+    settled = jnp.where(jnp.any(out[1]), core.STATUS_RUNNING, core.STATUS_OPTIMAL)
+    z = jnp.zeros((), f)
+    meta = jnp.stack([out[2].astype(f), settled.astype(f), z, z])
+    return out, meta
+
+
+@functools.partial(jax.jit, static_argnames=("factor_dtype",))
+def _batched_norms_jit(A, data, states, factor_dtype):
+    fdt = jnp.dtype(factor_dtype)
+
+    def final_norms(a, d, st):
+        ops = _make_ops(a, jnp.asarray(0.0, a.dtype), fdt, 0)
+        pinf, dinf, _, rel_gap, pobj, _, _ = core.residual_norms(ops, d, st)
+        return pinf, dinf, rel_gap, pobj
+
+    return jax.vmap(final_norms)(A, data, states)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "factor_dtype"))
+def _batched_start_jit(A, data, reg0, params, factor_dtype):
+    fdt = jnp.dtype(factor_dtype)
+    return jax.vmap(lambda a, d: _single_start(a, d, reg0, params, fdt))(A, data)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "params_p1", "factor_dtype", "two_phase", "stall_window"),
+)
+def _solve_batched_jit(
+    A, data, reg0, params, params_p1, max_iter, max_refactor, reg_grow,
+    factor_dtype, two_phase, stall_window=0,
+):
+    # max_iter / max_refactor / reg_grow are traced scalars so one compile
+    # serves every iteration-limit config (warm-up shares the timed compile).
+    # With ``two_phase`` the batch first runs with f32 factorizations to the
+    # handoff tolerance (params_p1.tol), then every problem — including
+    # phase-1 "optimal"/"numerical-error"/stalled ones, whose verdicts are
+    # provisional — re-enters a full-precision loop warm-started from its
+    # phase-1 iterate (same design as the dense two-phase, SURVEY.md §7;
+    # each phase has its own ``max_iter`` budget). Per-problem stall
+    # detection keeps f32-stalled members from grinding the whole budget.
+    fdt = jnp.dtype(factor_dtype)
+    B = A.shape[0]
+    dtype = A.dtype
+    start_fdt = jnp.dtype(jnp.float32) if two_phase else fdt
+    states0 = jax.vmap(lambda a, d: _single_start(a, d, reg0, params, start_fdt))(
+        A, data
+    )
+
+    carry = _fresh_batch_carry(states0, jnp.zeros(B, jnp.int32), B, reg0, dtype)
+    if two_phase:
+        carry = _batched_phase(
+            A, data, carry, params_p1, max_iter, max_refactor, reg_grow,
+            jnp.dtype(jnp.float32), None, stall_window, _RUNNING,
+        )
+        # keep states + per-problem iters; reset provisional verdicts
+        carry = _fresh_batch_carry(carry[0], carry[6], B, reg0, dtype)
+    states, active, _, _, _, status, iters, _, _ = _batched_phase(
+        A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
+        None, 2 * stall_window if stall_window else 0, _STALL,
+    )
     status = jnp.where(status == _RUNNING, _MAXITER, status)
 
     # Final per-problem diagnostics.
@@ -137,17 +236,102 @@ def _solve_batched_jit(A, data, reg0, params, max_iter, max_refactor, reg_grow, 
     return states, status, iters, pinf, dinf, rel_gap, pobj
 
 
+_CHUNK_DEFAULT = 256  # per-device-program batch slice; see solve_batched
+
+
+def _fresh_batch_carry(states, iters, B, reg0, dtype):
+    return (
+        states,
+        jnp.ones(B, dtype=bool),
+        jnp.asarray(0, jnp.int32),
+        jnp.full(B, reg0, dtype=dtype),
+        jnp.zeros(B, jnp.int32),
+        jnp.full(B, _RUNNING, jnp.int32),
+        iters,
+        jnp.full(B, jnp.inf, dtype=dtype),
+        jnp.zeros(B, jnp.int32),
+    )
+
+
+def _solve_batched_segmented(A, data, cfg, params, params_p1, fname, two_phase, seg):
+    """Host-segmented batched solve: same phases as _solve_batched_jit but
+    each device program is bounded to ~15s (execution-watchdog guard —
+    long fused batched solves trip the ~60s limit on tunneled TPUs)."""
+    B = A.shape[0]
+    dtype = A.dtype
+    reg0 = jnp.asarray(cfg.reg_dual, dtype)
+    mi = jnp.asarray(cfg.max_iter, jnp.int32)
+    mr = jnp.asarray(cfg.max_refactor, jnp.int32)
+    rg = jnp.asarray(cfg.reg_grow, dtype)
+    start_fdt = "float32" if two_phase else fname
+    states0 = _batched_start_jit(A, data, reg0, params, start_fdt)
+
+    w = cfg.stall_window
+    if two_phase:
+        phases = [
+            (params_p1, "float32", w, _RUNNING),
+            (params, fname, 2 * w if w else 0, _STALL),
+        ]
+    else:
+        phases = [(params, fname, 2 * w if w else 0, _STALL)]
+    carry = _fresh_batch_carry(states0, jnp.zeros(B, jnp.int32), B, reg0, dtype)
+    for pi, (p, f, win, wstat) in enumerate(phases):
+
+        def run_seg(c, stop, _a=(p, f, win, wstat)):
+            pp, ff, w, ws = _a
+            return _batched_segment_jit(
+                A, data, c, jnp.asarray(stop, jnp.int32), mi, mr, rg, pp, ff,
+                w, ws,
+            )
+
+        # Batch-level stall/status live per problem inside the device loop;
+        # the driver only watches the all-settled predicate (window 0).
+        carry, _ = core.drive_segments(run_seg, carry, cfg.max_iter, 0, seg)
+        if pi < len(phases) - 1:
+            # Phase boundary: provisional f32 verdicts reset, iterates kept.
+            carry = _fresh_batch_carry(carry[0], carry[6], B, reg0, dtype)
+
+    states, _, _, _, _, status, iters, _, _ = carry
+    status = jnp.where(status == _RUNNING, _MAXITER, status)
+    pinf, dinf, rel_gap, pobj = _batched_norms_jit(A, data, states, fname)
+    return states, status, iters, pinf, dinf, rel_gap, pobj
+
+
+def _concat_results(parts, solve_time, setup_time) -> BatchedResult:
+    cat = lambda f: np.concatenate([getattr(p, f) for p in parts])
+    return BatchedResult(
+        status=cat("status"),
+        objective=cat("objective"),
+        x=cat("x"),
+        iterations=cat("iterations"),
+        rel_gap=cat("rel_gap"),
+        pinf=cat("pinf"),
+        dinf=cat("dinf"),
+        solve_time=solve_time,
+        setup_time=setup_time,
+    )
+
+
 def solve_batched(
     batch: BatchedLP,
     config: Optional[SolverConfig] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
     batch_axis: str = "batch",
+    chunk: Optional[int] = None,
     **config_overrides,
 ) -> BatchedResult:
     """Solve every problem in ``batch`` concurrently on device.
 
     ``mesh`` shards the batch axis (data parallelism); the batch size must
     then be divisible by the mesh size.
+
+    ``chunk`` bounds how many problems one device program holds (HBM: the
+    per-iteration temps of B emulated-f64 batched GEMMs are ~64·B·m·n
+    bytes — B=1024×(128,512) alone exceeds a v5e's 16 GB). Chunks run
+    sequentially through ONE compiled executable, so throughput is
+    unaffected once B saturates the chip. Default: 256 on TPU (None —
+    no chunking — elsewhere); chunking preserves mesh divisibility by
+    requiring chunk % mesh size == 0.
     """
     import time
 
@@ -156,6 +340,43 @@ def solve_batched(
         cfg = cfg.replace(**config_overrides)
     dtype = jnp.dtype(cfg.dtype)
     fname = jnp.dtype(cfg.factor_dtype_resolved()).name
+
+    B_total = np.asarray(batch.A).shape[0]
+    if chunk is None and jax.default_backend() == "tpu":
+        chunk = _CHUNK_DEFAULT
+    if chunk and mesh is not None and chunk % mesh.shape[batch_axis] != 0:
+        raise ValueError(
+            f"chunk {chunk} not divisible by mesh axis {mesh.shape[batch_axis]}"
+        )
+    if chunk and B_total > chunk:
+        # A non-multiple B leaves one smaller remainder chunk (one extra
+        # compile at that shape) — still chunked: falling through to a
+        # single whole-batch program is exactly the HBM blow-up chunking
+        # exists to prevent. With a mesh, the remainder must still divide
+        # the mesh axis (checked by the recursive call).
+        t0 = time.perf_counter()
+        parts = [
+            solve_batched(
+                BatchedLP(
+                    c=batch.c[i : i + chunk],
+                    A=batch.A[i : i + chunk],
+                    b=batch.b[i : i + chunk],
+                    name=f"{batch.name}[{i}:{i + chunk}]",
+                ),
+                cfg,
+                mesh=mesh,
+                batch_axis=batch_axis,
+                chunk=0,  # no further splitting
+            )
+            for i in range(0, B_total, chunk)
+        ]
+        wall = time.perf_counter() - t0
+        solve_time = sum(p.solve_time for p in parts)
+        return _concat_results(
+            parts,
+            solve_time=solve_time,
+            setup_time=max(wall - solve_time, 0.0),  # wall minus solve, no double count
+        )
 
     t0 = time.perf_counter()
     A = np.asarray(batch.A, dtype=dtype)
@@ -184,16 +405,29 @@ def solve_batched(
     setup_time = time.perf_counter() - t0
 
     t1 = time.perf_counter()
-    states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_jit(
-        A,
-        data,
-        jnp.asarray(cfg.reg_dual, dtype),
-        params,
-        cfg.max_iter,
-        cfg.max_refactor,
-        cfg.reg_grow,
-        fname,
-    )
+    two_phase = cfg.two_phase_enabled(jax.default_backend())
+    params_p1 = cfg.phase1_params()
+    seg = cfg.segment_iters
+    if seg is None:
+        seg = 8 if jax.default_backend() == "tpu" else 0
+    if seg:
+        states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_segmented(
+            A, data, cfg, params, params_p1, fname, two_phase, seg
+        )
+    else:
+        states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_jit(
+            A,
+            data,
+            jnp.asarray(cfg.reg_dual, dtype),
+            params,
+            params_p1,
+            cfg.max_iter,
+            cfg.max_refactor,
+            cfg.reg_grow,
+            fname,
+            two_phase,
+            cfg.stall_window,
+        )
     jax.block_until_ready(states)
     solve_time = time.perf_counter() - t1
 
@@ -201,6 +435,7 @@ def solve_batched(
         _OPTIMAL: Status.OPTIMAL,
         _MAXITER: Status.ITERATION_LIMIT,
         _NUMERR: Status.NUMERICAL_ERROR,
+        _STALL: Status.STALLED,
     }
     status_np = np.asarray(status)
     return BatchedResult(

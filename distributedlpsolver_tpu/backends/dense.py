@@ -123,12 +123,13 @@ def _dense_start(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "params", "factor_dtype", "refine_steps", "buf_cap", "use_pallas"
+        "params", "factor_dtype", "refine_steps", "buf_cap", "use_pallas",
+        "stall_window",
     ),
 )
 def _dense_solve_full(
     A, data, state0, reg0, params, factor_dtype, refine_steps, max_iter, max_refactor, reg_grow,
-    buf_cap, use_pallas=False, Af=None,
+    buf_cap, use_pallas=False, Af=None, stall_window=0,
 ):
     # max_iter / max_refactor / reg_grow are traced scalars: one compiled
     # executable serves every iteration-limit config (only the bucketed
@@ -138,7 +139,51 @@ def _dense_solve_full(
         return core.mehrotra_step(ops, data, params, state)
 
     return core.fused_solve(
-        step, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap
+        step, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap,
+        stall_window=stall_window, stall_patience_floor=1e3 * params.tol,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "params", "factor_dtype", "refine_steps", "buf_cap", "use_pallas",
+        "stall_window", "patience",
+    ),
+)
+def _dense_segment(
+    A, data, carry, it_stop, max_iter, max_refactor, reg_grow,
+    params, factor_dtype, refine_steps, buf_cap, use_pallas=False, Af=None,
+    stall_window=0, patience=0.0,
+):
+    """One bounded continuation of the fused loop (host segmentation —
+    see core.drive_segments). ``carry`` is the raw fused_solve carry;
+    ``max_iter`` here is the phase's global iteration bound (phase start +
+    per-phase budget)."""
+
+    def step(state, reg):
+        ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
+        return core.mehrotra_step(ops, data, params, state)
+
+    out = core.fused_solve(
+        step, None, None, params, max_iter, max_refactor, reg_grow, buf_cap,
+        stall_window=stall_window, stall_patience_floor=patience,
+        resume=carry, it_stop=it_stop, return_carry=True,
+    )
+    return out, core.pack_segment_meta(out)
+
+
+@jax.jit
+def _phase_reset(carry, reg0):
+    """Device-side phase-boundary reset (one dispatch — building the new
+    carry from eager host scalars costs ~8 tiny transfers per phase):
+    keep state/iteration count/stats buffer, reset everything provisional."""
+    st, it, _, _, _, buf, _, _ = carry
+    z = jnp.asarray(0, jnp.int32)
+    return (
+        st, it, reg0, z,
+        jnp.asarray(core.STATUS_RUNNING, jnp.int32), buf,
+        jnp.asarray(jnp.inf, buf.dtype), z,
     )
 
 
@@ -186,9 +231,12 @@ def _dense_solve_two_phase(
     # infeasibility heuristics can misfire on f32 factorization error —
     # phase 2 re-derives all of them at full precision.
     status1 = jnp.full_like(status1, core.STATUS_RUNNING)
+    # Phase 2 gets its own max_iter budget beyond the phase-1 iterations
+    # (it1 + max_iter), matching the batched/segmented paths.
     return core.fused_solve(
-        step64, st1, reg0, params, max_iter, max_refactor, reg_grow,
+        step64, st1, reg0, params, it1 + max_iter, max_refactor, reg_grow,
         buf_cap, stall_window=2 * stall_window if stall_window else 0,
+        stall_patience_floor=1e3 * params.tol,
         carry_in=(it1, status1, buf), finalize=True,
     )
 
@@ -262,7 +310,7 @@ class DenseJaxBackend(SolverBackend):
         # which GSPMD-partitions into the psum-combined Schur form.
         from distributedlpsolver_tpu.ops import supports_pallas
 
-        two_phase = config.two_phase_enabled(jax.default_backend()) and mat_s is None
+        two_phase = config.two_phase_enabled(jax.default_backend())
         pallas_ok = mat_s is None and refine == 0 and supports_pallas(factor_dtype)
         if config.use_pallas is None:
             self._use_pallas = pallas_ok
@@ -286,16 +334,19 @@ class DenseJaxBackend(SolverBackend):
         else:
             self._Af = None
 
-        # Two-phase (f32→f64) fused schedule: "auto" factor dtype on a TPU,
-        # single-device placement only for now (the sharded path would need
-        # the f32 copy laid out on the mesh — future work). The f32 copy is
-        # materialized lazily in solve_full: the host-driver path (e.g.
-        # per-iteration checkpointing disables the fused loop) never reads
-        # it, and at large m×n it is real HBM. An explicit use_pallas=False
-        # opts phase 1 out of the Pallas kernel too (plain-XLA f32 GEMM).
+        # Two-phase (f32→f64) fused schedule: "auto" factor dtype on a TPU.
+        # Sharded placement runs phase 1 on the plain-XLA f32 GEMM (astype
+        # preserves the mesh layout, so GSPMD partitions the f32 assembly
+        # into per-device Schur blocks + psum exactly like the f64 path);
+        # single-device placement additionally gets the Pallas kernel. The
+        # f32 copy is materialized lazily in solve_full: the host-driver
+        # path (e.g. per-iteration checkpointing disables the fused loop)
+        # never reads it, and at large m×n it is real HBM. An explicit
+        # use_pallas=False opts phase 1 out of the Pallas kernel too.
         self._two_phase = two_phase
         self._pallas_p1 = (
             two_phase
+            and mat_s is None
             and supports_pallas(jnp.float32)
             and config.use_pallas is not False
         )
@@ -334,16 +385,113 @@ class DenseJaxBackend(SolverBackend):
         self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
         return True
 
+    def _phase_plan(self):
+        """Per-phase execution specs for the fused solve: (params,
+        factor_dtype_name, refine_steps, use_pallas, Af, stall_window,
+        stall_patience_floor)."""
+        cfg = self._cfg
+        patience = 1e3 * cfg.tol  # near-tol plateaus deserve patience
+        w = cfg.stall_window
+        if not self._two_phase:
+            # Final (only) phase gets the same stall semantics as the
+            # two-phase finish and the batched backend: window 2·w with
+            # the near-tol patience floor.
+            return [
+                (self._params, self._factor_dtype_name, self._refine,
+                 self._use_pallas, self._Af, 2 * w if w else 0, patience)
+            ]
+        if self._A32 is None:
+            if self._pallas_p1:
+                from distributedlpsolver_tpu.ops import pad_for_pallas
+
+                self._A32 = pad_for_pallas(self._A.astype(jnp.float32))
+            else:  # plain-XLA f32 assembly (pallas opted out/unsupported)
+                self._A32 = self._A.astype(jnp.float32)
+        params_p1 = cfg.phase1_params()
+        return [
+            (params_p1, "float32", 0, self._pallas_p1, self._A32, w, 0.0),
+            (self._params, self._dtype.name, self._refine, False, None,
+             2 * w if w else 0, patience),
+        ]
+
+    def _segment_iters(self) -> int:
+        seg = self._cfg.segment_iters
+        if seg is None:
+            seg = 8 if jax.default_backend() == "tpu" else 0
+        return seg
+
+    def _solve_segmented(self, state: IPMState, seg: int):
+        """Host-driven segmented fused solve (core.drive_segments): bounds
+        single device-program runtime under execution watchdogs."""
+        cfg = self._cfg
+        dtype = self._dtype
+        # Each phase gets its own max_iter budget (matching the batched
+        # path), so a tiny-max_iter warm-up still reaches and compiles
+        # every phase; the buffer covers the 2-phase worst case.
+        n_phases = 2 if self._two_phase else 1
+        buf_cap = core.buffer_cap(n_phases * cfg.max_iter)
+        mr = jnp.asarray(cfg.max_refactor, jnp.int32)
+        rg = jnp.asarray(cfg.reg_grow, dtype)
+
+        def fresh_carry(st, it, buf):
+            return (
+                st,
+                jnp.asarray(it, jnp.int32),
+                jnp.asarray(self._reg, dtype),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(core.STATUS_RUNNING, jnp.int32),
+                buf if buf is not None
+                else jnp.zeros((buf_cap, core.N_STAT), dtype),
+                jnp.asarray(jnp.inf, dtype),
+                jnp.asarray(0, jnp.int32),
+            )
+
+        plan = self._phase_plan()
+        carry = fresh_carry(state, 0, None)
+        reg0 = jnp.asarray(self._reg, dtype)
+        window, patience, bound = 0, 0.0, cfg.max_iter
+        it, status, best, since = 0, core.STATUS_RUNNING, float("inf"), 0
+        for pi, (params, fdt, refine, pallas, Af, window, patience) in enumerate(plan):
+            bound = it + cfg.max_iter  # phase-local budget
+            mi = jnp.asarray(bound, jnp.int32)
+
+            def run_seg(c, stop, _a=(params, fdt, refine, pallas, Af, window, patience, mi)):
+                p, f, r, up, af, w, pat, m = _a
+                return _dense_segment(
+                    self._A, self._data, c, jnp.asarray(stop, jnp.int32),
+                    m, mr, rg, p, f, r, buf_cap, up, af, w, pat,
+                )
+
+            carry, (it, status, best, since) = core.drive_segments(
+                run_seg, carry, bound, window, seg,
+                stall_patience_floor=patience, it0_status0=(it, status),
+            )
+            if pi < len(plan) - 1:
+                # Phase boundary: every phase-1 verdict is provisional (see
+                # _dense_solve_two_phase) — reset to RUNNING, keep
+                # state/iteration count/stats buffer.
+                carry = _phase_reset(carry, reg0)
+                status = core.STATUS_RUNNING
+
+        st = carry[0]
+        buf = carry[5]
+        if status == core.STATUS_RUNNING:
+            stalled = (
+                window
+                and since > window
+                and it < bound
+                and (not patience or best > patience)
+            )
+            status = core.STATUS_STALL if stalled else core.STATUS_MAXITER
+        return st, it, jnp.asarray(status, jnp.int32), buf
+
     def solve_full(self, state: IPMState):
+        seg = self._segment_iters()
+        if seg:
+            return self._solve_segmented(state, seg)
         if self._two_phase:
             cfg = self._cfg
-            if self._A32 is None:
-                if self._pallas_p1:
-                    from distributedlpsolver_tpu.ops import pad_for_pallas
-
-                    self._A32 = pad_for_pallas(self._A.astype(jnp.float32))
-                else:  # plain-XLA f32 assembly (pallas opted out/unsupported)
-                    self._A32 = self._A.astype(jnp.float32)
+            self._phase_plan()  # materializes A32
             params_p1 = cfg.replace(
                 tol=max(cfg.tol, cfg.phase1_tol)
             ).step_params()
@@ -358,7 +506,7 @@ class DenseJaxBackend(SolverBackend):
                 jnp.asarray(self._cfg.max_iter, jnp.int32),
                 jnp.asarray(self._cfg.max_refactor, jnp.int32),
                 jnp.asarray(self._cfg.reg_grow, self._dtype),
-                core.buffer_cap(self._cfg.max_iter),
+                core.buffer_cap(2 * self._cfg.max_iter),
                 self._refine,
                 self._pallas_p1,
                 self._cfg.stall_window,
@@ -377,6 +525,7 @@ class DenseJaxBackend(SolverBackend):
             core.buffer_cap(self._cfg.max_iter),
             self._use_pallas,
             self._Af,
+            2 * self._cfg.stall_window if self._cfg.stall_window else 0,
         )
 
     def to_host(self, state: IPMState) -> IPMState:

@@ -64,6 +64,12 @@ class SolverConfig:
     # per-iteration host round trip). None = auto: used when the backend
     # supports it and per-iteration checkpointing is off.
     fused_loop: Optional[bool] = None
+    # Segment the fused loop into host-driven chunks of ~this many
+    # iterations (adaptively resized toward ~15s of device time each).
+    # Bounds single-program runtime — tunneled/remote TPUs enforce an
+    # execution watchdog (~60s observed) that a long fused solve trips.
+    # None = auto: 8 on TPU, 0 (unsegmented) elsewhere.
+    segment_iters: Optional[int] = None
     # diagnostics
     verbose: bool = False
     log_jsonl: Optional[str] = None  # per-iteration JSONL path (SURVEY.md §5.5)
@@ -84,6 +90,13 @@ class SolverConfig:
     def two_phase_enabled(self, platform: str) -> bool:
         """Whether the f32→f64 two-phase fused solve should be used."""
         return self.factor_dtype == "auto" and platform == "tpu"
+
+    def phase1_params(self) -> "StepParams":
+        """Step params of the two-phase f32 phase: tol loosened to the
+        handoff tolerance (single source of the handoff rule — the
+        loosened tol also keys the μ-floor that keeps the handoff iterate
+        centered)."""
+        return self.replace(tol=max(self.tol, self.phase1_tol)).step_params()
 
     def step_params(self) -> "StepParams":
         return StepParams(

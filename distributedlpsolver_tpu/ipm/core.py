@@ -317,10 +317,13 @@ def classify_divergence(mu, pinf, dinf, rel_gap, pobj, dobj):
     return pinfeas, dinfeas
 
 
-def buffer_cap(max_iter: int, quantum: int = 256) -> int:
+def buffer_cap(max_iter: int, quantum: int = 512) -> int:
     """Static stats-buffer size for :func:`fused_solve`, bucketed so that
     different ``max_iter`` values share one compiled executable (max_iter
-    itself is a *traced* loop bound; only this cap is a jit key)."""
+    itself is a *traced* loop bound; only this cap is a jit key). The
+    quantum covers two phase budgets of the default max_iter (2×200), so a
+    tiny-max_iter warm-up lands in the same bucket as production runs —
+    the buffer is (cap, N_STAT) scalars, so a generous cap costs ~40 KB."""
     return ((max(int(max_iter), 1) + quantum - 1) // quantum) * quantum
 
 
@@ -335,8 +338,12 @@ def fused_solve(
     buf_cap=None,
     *,
     stall_window=0,
+    stall_patience_floor=0.0,
     carry_in=None,
     finalize=True,
+    it_stop=None,
+    resume=None,
+    return_carry=False,
 ):
     """Entire IPM solve as one traced program (``lax.while_loop`` over
     iterations) — jax-only, called from inside a backend's jit.
@@ -359,12 +366,24 @@ def fused_solve(
     ``max(rel_gap, pinf, dinf)`` fails to improve by ≥10% over that many
     accepted steps, the loop stops (status ``STATUS_STALL`` if this is the
     ``finalize`` phase, else left ``STATUS_RUNNING`` for a continuation).
+    ``stall_patience_floor`` suppresses the stall exit while the best error
+    is at or below it — IPM tails can plateau for dozens of iterations
+    within ~100× of tolerance and still converge (observed), so final
+    phases pass ~1e3·tol here; 0 means stall always exits.
 
     Phase composition (mixed-precision two-phase solves): pass
     ``finalize=False`` to leave a non-terminal exit as ``STATUS_RUNNING``
     and feed ``(it, status, buf)`` of one call as ``carry_in`` of the next —
     the continuation resumes the global iteration count and appends to the
     same stats buffer.
+
+    Segmentation (bounding single device-program runtime, e.g. for
+    execution watchdogs on tunneled accelerators): pass ``it_stop`` (a
+    traced iteration bound for THIS call) and ``return_carry=True`` to get
+    the raw loop carry back; feed it to the next call via ``resume`` to
+    continue exactly where the segment stopped (regularization, stall
+    counters and stats buffer included). ``return_carry`` skips the
+    ``finalize`` status mapping — the segment driver owns it.
     """
     import jax
     import jax.numpy as jnp
@@ -373,10 +392,15 @@ def fused_solve(
         buf_cap = buffer_cap(int(max_iter))
 
     def cond(carry):
-        _, it, _, _, status, _, _, since = carry
+        _, it, _, _, status, _, best_err, since = carry
         go = (status == STATUS_RUNNING) & (it < max_iter) & (it < buf_cap)
+        if it_stop is not None:
+            go = go & (it < it_stop)
         if stall_window:
-            go = go & (since <= stall_window)
+            stall = since > stall_window
+            if stall_patience_floor:
+                stall = stall & (best_err > stall_patience_floor)
+            go = go & ~stall
         return go
 
     def body(carry):
@@ -421,25 +445,31 @@ def fused_solve(
         reg = jnp.where(bad, jnp.maximum(reg, 1e-12) * reg_grow, reg)
         return state, it, reg, badcount, status, buf, best_err, since
 
-    if carry_in is not None:
-        it0, status0, buf0 = carry_in
-        it0 = jnp.asarray(it0, jnp.int32)
-        status0 = jnp.asarray(status0, jnp.int32)
+    if resume is not None:
+        carry0 = resume
     else:
-        it0 = jnp.asarray(0, jnp.int32)
-        status0 = jnp.asarray(STATUS_RUNNING, jnp.int32)
-        buf0 = jnp.zeros((buf_cap, N_STAT), dtype=state0.x.dtype)
-    carry0 = (
-        state0,
-        it0,
-        reg0,
-        jnp.asarray(0, jnp.int32),
-        status0,
-        buf0,
-        jnp.asarray(jnp.inf, state0.x.dtype),
-        jnp.asarray(0, jnp.int32),
-    )
-    state, it, reg, _, status, buf, _, since = jax.lax.while_loop(cond, body, carry0)
+        if carry_in is not None:
+            it0, status0, buf0 = carry_in
+            it0 = jnp.asarray(it0, jnp.int32)
+            status0 = jnp.asarray(status0, jnp.int32)
+        else:
+            it0 = jnp.asarray(0, jnp.int32)
+            status0 = jnp.asarray(STATUS_RUNNING, jnp.int32)
+            buf0 = jnp.zeros((buf_cap, N_STAT), dtype=state0.x.dtype)
+        carry0 = (
+            state0,
+            it0,
+            reg0,
+            jnp.asarray(0, jnp.int32),
+            status0,
+            buf0,
+            jnp.asarray(jnp.inf, state0.x.dtype),
+            jnp.asarray(0, jnp.int32),
+        )
+    carry = jax.lax.while_loop(cond, body, carry0)
+    if return_carry:
+        return carry
+    state, it, reg, _, status, buf, _, since = carry
     if finalize:
         stalled = (
             (since > stall_window) if stall_window else jnp.asarray(False)
@@ -450,6 +480,72 @@ def fused_solve(
             status,
         )
     return state, it, status, buf
+
+
+def drive_segments(
+    run_seg, carry0, max_iter, stall_window, seg_init=16, target_s=15.0,
+    stall_patience_floor=0.0, it0_status0=(0, STATUS_RUNNING),
+):
+    """Host loop over bounded fused-solve segments.
+
+    ``run_seg(carry, it_stop) -> (carry, meta)`` executes one device
+    program continuing from ``carry`` until the iteration count reaches
+    ``it_stop`` or the loop exits on its own; ``meta`` is the packed
+    ``[it, status, best_err, since]`` scalar array (ONE device→host
+    transfer per segment — individually fetching loop scalars costs a
+    tunnel round trip each). Repeats — adapting the segment length toward
+    ``target_s`` seconds of device time, the guard against single-program
+    execution watchdogs on tunneled accelerators — until the status
+    leaves RUNNING, the stall window fires, or ``max_iter`` is reached.
+    Returns ``(carry, (it, status, best_err, since))`` — the final carry
+    plus host copies of the loop scalars, so callers never re-fetch them.
+    """
+    import time as _time
+
+    import numpy as _np
+
+    carry = carry0
+    seg = max(int(seg_init), 1)
+    # Entry it/status are read from the packed meta the CALLER already has
+    # (or known statically at a fresh start) — fetching them from carry
+    # here would cost two extra tunnel round trips per phase.
+    it, status = it0_status0
+    best_err, since = float("inf"), 0
+    first = True
+    while status == STATUS_RUNNING and it < max_iter:
+        prev_it = it
+        stop = min(it + seg, max_iter)
+        t0 = _time.perf_counter()
+        carry, meta = run_seg(carry, stop)
+        meta = _np.asarray(meta)  # blocks; the segment's one host read
+        dt = _time.perf_counter() - t0
+        it, status = int(meta[0]), int(meta[1])
+        best_err, since = float(meta[2]), int(meta[3])
+        if (
+            stall_window
+            and since > stall_window
+            and (not stall_patience_floor or best_err > stall_patience_floor)
+        ):
+            break
+        if it == prev_it:  # no progress possible (defensive: avoid spinning)
+            break
+        if not first:  # first call's wall time includes compile — don't adapt
+            # Jump straight to the measured rate (dt is clean post-compile);
+            # the cap keeps one segment well under the watchdog either way.
+            seg = max(1, min(256, int(seg * target_s / max(dt, 1e-3))))
+        first = False
+    return carry, (it, status, best_err, since)
+
+
+def pack_segment_meta(carry):
+    """[it, status, best_err, since] as one array — see drive_segments."""
+    import jax.numpy as jnp
+
+    _, it, _, _, status, _, best_err, since = carry
+    f = best_err.dtype
+    return jnp.stack(
+        [it.astype(f), status.astype(f), best_err, since.astype(f)]
+    )
 
 
 def starting_point(ops: LinOps, data: ProblemData, cfg: StepParams) -> IPMState:
