@@ -248,13 +248,18 @@ class BlockAngularBackend(SolverBackend):
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            K_hint = int((inf.block_structure or {}).get("num_blocks", 0))
-            if K_hint % self._mesh.devices.size != 0:
-                raise ValueError(
-                    f"K={K_hint} blocks not divisible by mesh size "
-                    f"{self._mesh.devices.size}"
-                )
+            # Blocks shard over the OUTER (first) mesh axis — on a hybrid
+            # ICI×DCN mesh that's the DCN axis, which fits: diagonal blocks
+            # exchange only the small linking system. Divisibility is
+            # against that axis's size, not the whole device count.
             axis = self._mesh.axis_names[0]
+            axis_size = self._mesh.shape[axis]
+            K_hint = int((inf.block_structure or {}).get("num_blocks", 0))
+            if K_hint % axis_size != 0:
+                raise ValueError(
+                    f"K={K_hint} blocks not divisible by mesh axis "
+                    f"{axis!r} of size {axis_size}"
+                )
 
             def shard_put(arr, kind):
                 spec = (

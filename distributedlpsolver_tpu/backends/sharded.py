@@ -56,7 +56,15 @@ class ShardedJaxBackend(DenseJaxBackend):
             self._mesh = mesh_lib.make_mesh(
                 config.mesh_shape, axis_names=(config.mesh_axis,)
             )
-        self._axis = self._mesh.axis_names[0]
+        # Shard variables over config.mesh_axis when the mesh has it; else
+        # the last (innermost/fastest) axis — on a hybrid ICI×DCN mesh
+        # ("hosts", "cols") that keeps the per-iteration Schur all-reduce
+        # on ICI while an outer axis remains free for coarse partitions.
+        self._axis = (
+            config.mesh_axis
+            if config.mesh_axis in self._mesh.axis_names
+            else self._mesh.axis_names[-1]
+        )
         super().setup(inf, config)
 
     def pad_multiple(self) -> int:

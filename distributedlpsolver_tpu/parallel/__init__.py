@@ -1,8 +1,23 @@
 from distributedlpsolver_tpu.parallel.mesh import (
     col_sharding,
+    make_hybrid_mesh,
     make_mesh,
     replicated,
     vec_sharding,
 )
+from distributedlpsolver_tpu.parallel.runtime import (
+    init_distributed,
+    is_primary,
+    world,
+)
 
-__all__ = ["make_mesh", "col_sharding", "vec_sharding", "replicated"]
+__all__ = [
+    "make_mesh",
+    "make_hybrid_mesh",
+    "col_sharding",
+    "vec_sharding",
+    "replicated",
+    "init_distributed",
+    "world",
+    "is_primary",
+]

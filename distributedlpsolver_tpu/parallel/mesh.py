@@ -49,6 +49,36 @@ def make_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
+def make_hybrid_mesh(
+    ici_parallelism: int,
+    dcn_parallelism: int = 1,
+    axis_names: Sequence[str] = ("hosts", "cols"),
+) -> Mesh:
+    """ICI×DCN mesh for multi-host runs (the reference's multi-node MPI
+    world, SURVEY.md §5.8).
+
+    The inner axis spans each slice's ICI domain (fast — carries the
+    per-iteration Schur all-reduce); the outer axis spans slices over DCN
+    (slow — used for coarse partitions, e.g. independent diagonal blocks
+    of a block-angular problem or the batch axis, which need little or no
+    per-iteration traffic). Uses ``mesh_utils.create_hybrid_device_mesh``
+    on real multi-slice hardware; on a single host it degrades to a
+    reshaped local mesh so the same code path is testable with virtual
+    devices.
+    """
+    from jax.experimental import mesh_utils
+
+    shape = (dcn_parallelism, ici_parallelism)
+    if jax.process_count() > 1:
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, ici_parallelism),
+            dcn_mesh_shape=(dcn_parallelism, 1),
+        )
+    else:
+        return make_mesh(shape, axis_names)
+    return Mesh(arr, tuple(axis_names))
+
+
 def col_sharding(mesh: Mesh, axis: str = "cols") -> NamedSharding:
     """(m, n) matrix sharded along its variable (column) dimension."""
     return NamedSharding(mesh, PartitionSpec(None, axis))

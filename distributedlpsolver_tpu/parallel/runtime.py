@@ -1,0 +1,80 @@
+"""Multi-host runtime: the reference's MPI world, the TPU-native way.
+
+The reference initializes an MPI world (init/finalize, rank/size) and
+spans its row partition across ranks on multiple machines (SURVEY.md §1
+L1, §5.8). The JAX-native equivalent is process-level: each host runs the
+same SPMD program, ``jax.distributed.initialize`` wires the processes
+into one runtime (coordinator + process grid over DCN), and every
+``jax.devices()`` call then sees the *global* accelerator set. All
+cross-host communication remains declarative — XLA routes the Schur
+all-reduce over ICI within a slice and DCN across slices; nothing in the
+solver changes.
+
+On a single host everything here degrades to no-ops, so the same code
+path runs everywhere (the analogue of ``mpirun -np 1``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_INITIALIZED = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Join (or create) the multi-host runtime; returns the world layout.
+
+    Mirrors ``MPI_Init`` + rank/size queries. With no arguments, reads the
+    standard JAX cluster environment (``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``, or the TPU pod metadata
+    when running on one) and falls back to single-process when none is
+    present. Safe to call more than once.
+    """
+    global _INITIALIZED
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    # Multi-host TPU pods without explicit cluster env: the pod metadata
+    # lists every worker — initialize() with no args then auto-detects the
+    # coordinator. A single-entry (or absent) list is a single host, where
+    # initializing would only add a pointless coordinator.
+    pod_workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    multi_host_pod = "," in pod_workers
+
+    if not _INITIALIZED and (
+        coordinator_address or (num_processes or 0) > 1 or multi_host_pod
+    ):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _INITIALIZED = True
+    return world()
+
+
+def world() -> dict:
+    """Rank/size view of the runtime (the MPI_Comm_rank/size analogue)."""
+    return {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+
+
+def is_primary() -> bool:
+    """True on the process that should own logging/IO (rank 0)."""
+    return jax.process_index() == 0

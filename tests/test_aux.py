@@ -1,0 +1,136 @@
+"""Auxiliary subsystems (SURVEY.md §5): CLI surface, checkpoint/resume,
+warm start, and the JSONL metrics stream."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.cli import main as cli_main
+from distributedlpsolver_tpu.io import write_mps
+from distributedlpsolver_tpu.ipm import solve
+from distributedlpsolver_tpu.ipm.state import IPMState, Status
+from distributedlpsolver_tpu.models.generators import random_general_lp
+from distributedlpsolver_tpu.utils import checkpoint as ckpt
+
+
+@pytest.fixture
+def mps_file(tmp_path):
+    p = random_general_lp(10, 24, seed=21)
+    path = str(tmp_path / "prob.mps")
+    write_mps(p, path)
+    return path, p
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_solve_json(mps_file, capsys):
+    path, _ = mps_file
+    rc = cli_main(["solve", path, "--backend=cpu", "--quiet", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["status"] == "optimal"
+    assert out["rel_gap"] <= 1e-8
+    assert out["backend"] == "cpu"
+
+
+def test_cli_solve_writes_solution(mps_file, tmp_path, capsys):
+    path, p = mps_file
+    x_out = str(tmp_path / "x.npy")
+    rc = cli_main(["solve", path, "--backend=cpu", "--quiet", "--x-out", x_out])
+    assert rc == 0
+    x = np.load(x_out)
+    assert x.shape == (p.n,)
+    assert p.max_violation(x) <= 1e-6
+
+
+def test_cli_backends_lists_registry(capsys):
+    assert cli_main(["backends"]) == 0
+    names = capsys.readouterr().out.split()
+    for expected in ("tpu", "cpu", "cpu-native", "cpu-sparse", "sharded", "block"):
+        assert expected in names
+
+
+def test_cli_generate_round_trips(tmp_path, capsys):
+    out = str(tmp_path / "gen.mps")
+    rc = cli_main(["generate", "block", out, "--m", "8", "--n", "20", "--blocks", "2",
+                   "--link", "4"])
+    assert rc == 0
+    rc = cli_main(["solve", out, "--backend=cpu", "--quiet", "--json"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out.strip().splitlines()[-1])["status"] == "optimal"
+
+
+def test_cli_nonoptimal_exit_code(tmp_path, capsys):
+    # An infeasible problem must exit 2, not 0 (scripting contract).
+    from distributedlpsolver_tpu.models.problem import LPProblem
+
+    p = LPProblem(
+        c=[1.0, 1.0], A=[[1.0, 1.0], [1.0, 1.0]],
+        rlb=[2.0, -np.inf], rub=[2.0, 1.0],
+        lb=[0.0, 0.0], ub=[np.inf, np.inf], name="infeas",
+    )
+    path = str(tmp_path / "infeas.mps")
+    write_mps(p, path)
+    rc = cli_main(["solve", path, "--backend=cpu", "--quiet", "--json"])
+    assert rc == 2
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["status"] in ("primal_infeasible", "numerical_error")
+
+
+# -------------------------------------------------- checkpoint / restart
+def test_checkpoint_save_load_round_trip(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    state = IPMState(
+        x=np.arange(4.0), y=np.ones(2), s=np.full(4, 2.0),
+        w=np.ones(4), z=np.zeros(4),
+    )
+    ckpt.save_state(path, state, 17, "prob")
+    loaded, it, name = ckpt.load_state(path)
+    assert (it, name) == (17, "prob")
+    for f in IPMState._fields:
+        np.testing.assert_array_equal(getattr(loaded, f), getattr(state, f))
+    assert ckpt.maybe_load(None) is None
+    assert ckpt.maybe_load(str(tmp_path / "missing.npz")) is None
+
+
+def test_solve_resumes_from_checkpoint(tmp_path):
+    p = random_general_lp(10, 24, seed=5)
+    ck = str(tmp_path / "it.npz")
+    # Interrupted run: checkpoint every iteration, stop early.
+    r1 = solve(p, backend="cpu", checkpoint_path=ck, checkpoint_every=1, max_iter=4)
+    assert r1.status == Status.ITERATION_LIMIT
+    assert os.path.exists(ck)
+    # Resumed run finds the checkpoint and needs fewer iterations than a
+    # cold solve to reach optimality.
+    cold = solve(p, backend="cpu")
+    r2 = solve(p, backend="cpu", checkpoint_path=ck, checkpoint_every=1)
+    assert r2.status == Status.OPTIMAL
+    assert r2.iterations < cold.iterations
+    np.testing.assert_allclose(r2.objective, cold.objective, rtol=1e-7, atol=1e-8)
+
+
+def test_warm_start_accepts_prior_state(tmp_path):
+    # The checkpoint payload is the documented warm-start carrier.
+    p = random_general_lp(8, 18, seed=6)
+    ck = str(tmp_path / "ws.npz")
+    solve(p, backend="cpu", checkpoint_path=ck, checkpoint_every=1, max_iter=6)
+    state, _, _ = ckpt.load_state(ck)
+    r2 = solve(p, backend="cpu", warm_start=state)
+    assert r2.status == Status.OPTIMAL
+
+
+# ------------------------------------------------------------ JSONL logs
+def test_jsonl_iteration_log(tmp_path):
+    p = random_general_lp(10, 24, seed=7)
+    log = str(tmp_path / "iters.jsonl")
+    r = solve(p, backend="cpu", log_jsonl=log)
+    assert r.status == Status.OPTIMAL
+    records = [json.loads(line) for line in open(log)]
+    assert len(records) == r.iterations
+    assert [rec["iter"] for rec in records] == list(range(1, r.iterations + 1))
+    for key in ("mu", "gap", "rel_gap", "pinf", "dinf", "alpha_p", "alpha_d",
+                "sigma", "pobj", "dobj", "t_iter"):
+        assert key in records[0]
+    # the trajectory the metric surface promises: gap decreases to tol
+    assert records[-1]["rel_gap"] <= 1e-8

@@ -1,0 +1,106 @@
+"""Property-based correctness (SURVEY.md §4: "property tests with
+hypothesis — random feasible LPs, check KKT residuals at reported
+optimum").
+
+Each property draws a random-but-feasible-by-construction LP, solves it
+through the public API on the CPU backend, and checks the whole contract:
+optimal status, KKT residuals at the reported solution, agreement with the
+scipy-HiGHS oracle, and feasibility in the *original* problem space (so
+the to_interior_form/recover round trip is covered too).
+"""
+
+import numpy as np
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from distributedlpsolver_tpu.io import read_mps, write_mps
+from distributedlpsolver_tpu.ipm import solve
+from distributedlpsolver_tpu.ipm.state import Status
+from distributedlpsolver_tpu.models.generators import (
+    random_dense_lp,
+    random_general_lp,
+)
+from tests.oracle import highs_on_general
+
+_SETTINGS = dict(
+    max_examples=12,
+    deadline=None,  # a solve is milliseconds-to-seconds, not hypothesis-scale
+    suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large],
+)
+
+
+@settings(**_SETTINGS)
+@given(
+    m=st.integers(3, 14),
+    extra=st.integers(2, 12),
+    seed=st.integers(0, 2**20),
+)
+def test_standard_form_lp_solves_to_kkt(m, extra, seed):
+    p = random_dense_lp(m, m + extra, seed=seed)
+    r = solve(p, backend="cpu")
+    assert r.status == Status.OPTIMAL
+    # Reported residuals honor the advertised tolerance contract.
+    assert r.rel_gap <= 1e-8 and r.pinf <= 1e-8 and r.dinf <= 1e-8
+    # KKT at the reported point, recomputed from scratch in the original
+    # space: primal feasibility and objective value vs the HiGHS oracle.
+    assert p.max_violation(r.x) <= 1e-6
+    hg = highs_on_general(p)
+    assert hg.status == 0
+    np.testing.assert_allclose(r.objective, hg.fun, rtol=1e-6, atol=1e-6)
+
+
+@settings(**_SETTINGS)
+@given(
+    m=st.integers(3, 12),
+    extra=st.integers(2, 10),
+    seed=st.integers(0, 2**20),
+    frac_eq=st.floats(0.0, 1.0),
+    frac_box=st.floats(0.2, 1.0),
+)
+def test_general_form_lp_solves_to_kkt(m, extra, seed, frac_eq, frac_box):
+    p = random_general_lp(m, m + extra, seed=seed, frac_eq=frac_eq, frac_box=frac_box)
+    r = solve(p, backend="cpu")
+    assert r.status == Status.OPTIMAL
+    assert p.max_violation(r.x) <= 1e-6
+    hg = highs_on_general(p)
+    assert hg.status == 0
+    np.testing.assert_allclose(r.objective, hg.fun + p.c0, rtol=1e-6, atol=1e-6)
+
+
+@settings(**_SETTINGS)
+@given(
+    m=st.integers(3, 10),
+    extra=st.integers(2, 8),
+    seed=st.integers(0, 2**20),
+)
+def test_mps_round_trip_preserves_solution(m, extra, seed):
+    import tempfile
+
+    p = random_general_lp(m, m + extra, seed=seed)
+    with tempfile.NamedTemporaryFile(suffix=".mps", delete=False) as fh:
+        path = fh.name
+    write_mps(p, path)
+    q = read_mps(path)
+    assert q.shape == p.shape
+    rp = solve(p, backend="cpu")
+    rq = solve(q, backend="cpu")
+    assert rp.status == rq.status == Status.OPTIMAL
+    np.testing.assert_allclose(rq.objective, rp.objective, rtol=1e-7, atol=1e-8)
+
+
+@settings(**_SETTINGS)
+@given(seed=st.integers(0, 2**20), scale=st.floats(1e-3, 1e3))
+def test_scaling_invariance(seed, scale):
+    # Scaling the objective scales the optimum linearly; the solver's
+    # relative convergence contract must hold at any magnitude.
+    p = random_dense_lp(8, 16, seed=seed)
+    p_scaled = type(p)(
+        c=p.c * scale, A=p.A, rlb=p.rlb, rub=p.rub, lb=p.lb, ub=p.ub,
+        name=p.name + "_scaled",
+    )
+    r = solve(p, backend="cpu")
+    rs = solve(p_scaled, backend="cpu")
+    assert r.status == rs.status == Status.OPTIMAL
+    np.testing.assert_allclose(
+        rs.objective, r.objective * scale, rtol=1e-6, atol=1e-6 * max(1.0, scale)
+    )
