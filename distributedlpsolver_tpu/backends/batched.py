@@ -62,19 +62,22 @@ class BatchedResult:
         return int(np.sum(self.status == Status.OPTIMAL))
 
 
-def _single_step(A, data, state, reg, params, factor_dtype):
-    ops = _make_ops(A, reg, factor_dtype, 0)
+def _single_step(A, data, state, reg, params, factor_dtype, Af=None):
+    # Af: loop-invariant precast copy — with a low-precision factor_dtype
+    # the O(m²n) normal-equations assembly then runs at that precision on
+    # the MXU instead of in emulated f64 (see dense._cholesky_ops).
+    ops = _make_ops(A, reg, factor_dtype, 0, False, Af)
     return core.mehrotra_step(ops, data, params, state)
 
 
-def _single_start(A, data, reg, params, factor_dtype):
-    ops = _make_ops(A, reg, factor_dtype, 0)
+def _single_start(A, data, reg, params, factor_dtype, Af=None):
+    ops = _make_ops(A, reg, factor_dtype, 0, False, Af)
     return core.starting_point(ops, data, params)
 
 
 def _batched_phase(
     A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
-    it_stop=None, stall_window=0, stall_status=_RUNNING,
+    it_stop=None, stall_window=0, stall_status=_RUNNING, A32=None,
 ):
     """One masked batched IPM while_loop phase over the whole batch.
 
@@ -99,9 +102,14 @@ def _batched_phase(
 
     def body(carry):
         states, active, it, regs, badcount, status, iters, best, since = carry
-        new_states, stats = jax.vmap(
-            lambda a, d, st, rg: _single_step(a, d, st, rg, params, fdt)
-        )(A, data, states, regs)
+        if A32 is not None:
+            new_states, stats = jax.vmap(
+                lambda a, a32, d, st, rg: _single_step(a, d, st, rg, params, fdt, a32)
+            )(A, A32, data, states, regs)
+        else:
+            new_states, stats = jax.vmap(
+                lambda a, d, st, rg: _single_step(a, d, st, rg, params, fdt)
+            )(A, data, states, regs)
         bad = stats.bad
         conv = (
             (stats.rel_gap <= params.tol)
@@ -152,20 +160,25 @@ def _batched_phase(
 )
 def _batched_segment_jit(
     A, data, carry, it_stop, max_iter, max_refactor, reg_grow, params,
-    factor_dtype, stall_window=0, stall_status=_RUNNING,
+    factor_dtype, stall_window=0, stall_status=_RUNNING, A32=None,
 ):
     out = _batched_phase(
         A, data, carry, params, max_iter, max_refactor, reg_grow,
-        jnp.dtype(factor_dtype), it_stop, stall_window, stall_status,
+        jnp.dtype(factor_dtype), it_stop, stall_window, stall_status, A32,
     )
-    # Packed [it, status, best, since] in core.drive_segments' meta layout
-    # (one device→host transfer per segment — separate scalar fetches cost
-    # a tunnel round trip each). Per-problem statuses/stall live inside the
-    # loop, so the batch-level "status" is just the all-settled predicate.
+    # Packed [it, status, n_active, n_unfinished] in core.drive_segments'
+    # meta layout (one device→host transfer per segment — separate scalar
+    # fetches cost a tunnel round trip each). Per-problem statuses/stall
+    # live inside the loop, so the batch-level "status" is just the
+    # all-settled predicate; the active and total-unfinished counts ride
+    # the best_err/since slots for tail-extraction early stops.
     f = A.dtype
     settled = jnp.where(jnp.any(out[1]), core.STATUS_RUNNING, core.STATUS_OPTIMAL)
-    z = jnp.zeros((), f)
-    meta = jnp.stack([out[2].astype(f), settled.astype(f), z, z])
+    unfinished = jnp.sum(out[5] != _OPTIMAL)
+    meta = jnp.stack(
+        [out[2].astype(f), settled.astype(f), jnp.sum(out[1]).astype(f),
+         unfinished.astype(f)]
+    )
     return out, meta
 
 
@@ -207,8 +220,17 @@ def _solve_batched_jit(
     fdt = jnp.dtype(factor_dtype)
     B = A.shape[0]
     dtype = A.dtype
-    start_fdt = jnp.dtype(jnp.float32) if two_phase else fdt
-    states0 = jax.vmap(lambda a, d: _single_start(a, d, reg0, params, start_fdt))(
+    # Loop-invariant f32 copy for f32 factorizations AND their assembly
+    # (without it the O(m²n) assembly runs emulated-f64) — used by the
+    # two-phase first phase and by an explicit single-phase f32 config.
+    f32 = jnp.dtype(jnp.float32)
+    A32 = A.astype(f32) if (two_phase or fdt == f32) else None
+    # The starting point stays at full precision even under two-phase: it
+    # is ONE factorization amortized over the whole solve, and an f32
+    # Mehrotra least-squares start can be bad enough on an ill-conditioned
+    # member to strand its entire trajectory (observed: a problem that
+    # solves solo in 16 iterations stalls at gap 6e-2 from an f32 start).
+    states0 = jax.vmap(lambda a, d: _single_start(a, d, reg0, params, fdt))(
         A, data
     )
 
@@ -216,13 +238,14 @@ def _solve_batched_jit(
     if two_phase:
         carry = _batched_phase(
             A, data, carry, params_p1, max_iter, max_refactor, reg_grow,
-            jnp.dtype(jnp.float32), None, stall_window, _RUNNING,
+            jnp.dtype(jnp.float32), None, stall_window, _RUNNING, A32,
         )
         # keep states + per-problem iters; reset provisional verdicts
         carry = _fresh_batch_carry(carry[0], carry[6], B, reg0, dtype)
     states, active, _, _, _, status, iters, _, _ = _batched_phase(
         A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
         None, 2 * stall_window if stall_window else 0, _STALL,
+        A32 if fdt == f32 else None,
     )
     status = jnp.where(status == _RUNNING, _MAXITER, status)
 
@@ -263,8 +286,11 @@ def _solve_batched_segmented(A, data, cfg, params, params_p1, fname, two_phase, 
     mi = jnp.asarray(cfg.max_iter, jnp.int32)
     mr = jnp.asarray(cfg.max_refactor, jnp.int32)
     rg = jnp.asarray(cfg.reg_grow, dtype)
-    start_fdt = "float32" if two_phase else fname
-    states0 = _batched_start_jit(A, data, reg0, params, start_fdt)
+    A32 = A.astype(jnp.float32) if (two_phase or fname == "float32") else None
+    # Starting point at the resolved factor dtype (== full dtype under the
+    # auto two-phase schedule) — see _solve_batched_jit for why an f32
+    # start under two-phase is dangerous.
+    states0 = _batched_start_jit(A, data, reg0, params, fname)
 
     w = cfg.stall_window
     if two_phase:
@@ -275,19 +301,43 @@ def _solve_batched_segmented(A, data, cfg, params, params_p1, fname, two_phase, 
     else:
         phases = [(params, fname, 2 * w if w else 0, _STALL)]
     carry = _fresh_batch_carry(states0, jnp.zeros(B, jnp.int32), B, reg0, dtype)
+    # Tail extraction: a handful of stragglers would otherwise keep the
+    # full-batch masked loop running at whole-batch cost per iteration.
+    # Once ≤ tail problems are active in the FINAL phase, stop — the
+    # leftover problems finish solo through the dense path (solve_batched
+    # cleanup), warm-started from their batched iterates. tail = B//32 is
+    # 0 for small batches (no extraction — a lone member might converge in
+    # the very next segment), and the stop also requires the TOTAL
+    # unfinished count to fit the solo-cleanup bound, so an abandoned
+    # problem is never left without its cleanup solve.
+    tail = B // 32
+    cleanup_cap = max(4, B // 8)
     for pi, (p, f, win, wstat) in enumerate(phases):
+        final = pi == len(phases) - 1
 
         def run_seg(c, stop, _a=(p, f, win, wstat)):
             pp, ff, w, ws = _a
             return _batched_segment_jit(
                 A, data, c, jnp.asarray(stop, jnp.int32), mi, mr, rg, pp, ff,
-                w, ws,
+                w, ws, A32 if ff == "float32" else None,
             )
 
         # Batch-level stall/status live per problem inside the device loop;
         # the driver only watches the all-settled predicate (window 0).
-        carry, _ = core.drive_segments(run_seg, carry, cfg.max_iter, 0, seg)
-        if pi < len(phases) - 1:
+        carry, _ = core.drive_segments(
+            run_seg, carry, cfg.max_iter, 0, seg,
+            early_stop=(
+                (
+                    lambda it, status, n_active, n_unfinished: 0
+                    < n_active
+                    <= tail
+                    and n_unfinished <= cleanup_cap
+                )
+                if final and tail
+                else None
+            ),
+        )
+        if not final:
             # Phase boundary: provisional f32 verdicts reset, iterates kept.
             carry = _fresh_batch_carry(carry[0], carry[6], B, reg0, dtype)
 
@@ -429,7 +479,6 @@ def solve_batched(
             cfg.stall_window,
         )
     jax.block_until_ready(states)
-    solve_time = time.perf_counter() - t1
 
     code_map = {
         _OPTIMAL: Status.OPTIMAL,
@@ -437,15 +486,68 @@ def solve_batched(
         _NUMERR: Status.NUMERICAL_ERROR,
         _STALL: Status.STALLED,
     }
-    status_np = np.asarray(status)
+    status_arr = np.array(
+        [code_map[int(sc)] for sc in np.asarray(status)], dtype=object
+    )
+    # .array (not .asarray): device arrays convert to read-only views and
+    # the solo cleanup below writes per-member rows.
+    objective = np.array(pobj, dtype=np.float64)
+    x = np.array(states.x, dtype=np.float64)
+    iterations = np.array(iters)
+    rel_gap = np.array(rel_gap, dtype=np.float64)
+    pinf = np.array(pinf, dtype=np.float64)
+    dinf = np.array(dinf, dtype=np.float64)
+
+    # Solo cleanup: members the batched loop left unfinished (tail
+    # extraction stopped early, stalls, iteration limits) re-solve
+    # individually through the dense path, warm-started from their batched
+    # iterates — a handful of solo solves beats keeping the whole batch's
+    # masked loop alive at full-batch cost per iteration. Bounded so a
+    # pathological batch can't turn into B sequential solves.
+    bad = [i for i in range(Bsz) if status_arr[i] != Status.OPTIMAL]
+    if bad and len(bad) <= max(4, Bsz // 8):
+        from distributedlpsolver_tpu.ipm.driver import solve as _solve
+        from distributedlpsolver_tpu.models.problem import InteriorForm, _SHIFT
+
+        solo_cfg = cfg.replace(
+            verbose=False, log_jsonl=None, checkpoint_path=None,
+            checkpoint_every=0, profile_dir=None,
+        )
+        for i in bad:
+            # Per-member host conversion — full-batch f64 copies just to
+            # patch a handful of rows would be ~hundreds of MB transient.
+            inf_i = InteriorForm(
+                c=np.asarray(batch.c[i], dtype=np.float64),
+                A=np.asarray(batch.A[i], dtype=np.float64),
+                b=np.asarray(batch.b[i], dtype=np.float64),
+                u=np.full(n, np.inf), c0=0.0, orig_n=n,
+                col_kind=np.full(n, _SHIFT, dtype=np.int8),
+                col_orig=np.arange(n), col_shift=np.zeros(n),
+                col_sign=np.ones(n), name=f"{batch.name}[{i}]",
+            )
+            ws = IPMState(
+                x=x[i],
+                y=np.asarray(states.y[i], dtype=np.float64),
+                s=np.asarray(states.s[i], dtype=np.float64),
+                w=np.asarray(states.w[i], dtype=np.float64),
+                z=np.asarray(states.z[i], dtype=np.float64),
+            )
+            r = _solve(inf_i, backend="tpu", config=solo_cfg, warm_start=ws)
+            status_arr[i] = r.status
+            objective[i] = r.objective
+            x[i] = r.x
+            iterations[i] += r.iterations
+            rel_gap[i], pinf[i], dinf[i] = r.rel_gap, r.pinf, r.dinf
+
+    solve_time = time.perf_counter() - t1
     return BatchedResult(
-        status=np.array([code_map[int(sc)] for sc in status_np], dtype=object),
-        objective=np.asarray(pobj, dtype=np.float64),
-        x=np.asarray(states.x, dtype=np.float64),
-        iterations=np.asarray(iters),
-        rel_gap=np.asarray(rel_gap, dtype=np.float64),
-        pinf=np.asarray(pinf, dtype=np.float64),
-        dinf=np.asarray(dinf, dtype=np.float64),
+        status=status_arr,
+        objective=objective,
+        x=x,
+        iterations=iterations,
+        rel_gap=rel_gap,
+        pinf=pinf,
+        dinf=dinf,
         solve_time=solve_time,
         setup_time=setup_time,
     )

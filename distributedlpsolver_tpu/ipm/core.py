@@ -485,6 +485,7 @@ def fused_solve(
 def drive_segments(
     run_seg, carry0, max_iter, stall_window, seg_init=16, target_s=15.0,
     stall_patience_floor=0.0, it0_status0=(0, STATUS_RUNNING),
+    early_stop=None,
 ):
     """Host loop over bounded fused-solve segments.
 
@@ -499,6 +500,12 @@ def drive_segments(
     leaves RUNNING, the stall window fires, or ``max_iter`` is reached.
     Returns ``(carry, (it, status, best_err, since))`` — the final carry
     plus host copies of the loop scalars, so callers never re-fetch them.
+
+    ``early_stop(it, status, best_err, since) -> bool`` (optional) is
+    consulted after each segment; True ends the drive. Callers with extra
+    loop state pack it into the meta slots (e.g. the batched driver packs
+    its active-problem count into the ``best_err`` slot and stops when the
+    tail is small enough to hand to per-problem cleanup).
     """
     import time as _time
 
@@ -526,6 +533,8 @@ def drive_segments(
             and since > stall_window
             and (not stall_patience_floor or best_err > stall_patience_floor)
         ):
+            break
+        if early_stop is not None and early_stop(it, status, best_err, since):
             break
         if it == prev_it:  # no progress possible (defensive: avoid spinning)
             break
