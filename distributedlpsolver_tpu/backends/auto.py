@@ -1,0 +1,83 @@
+"""Size/structure-aware backend dispatch (``--backend=auto``).
+
+The right execution target depends on the problem, not just the hardware:
+a 27×51 afiro-class LP solves in ~10 ms on the CPU but pays ~0.5 s of
+device dispatch on a (tunneled) TPU, while anything with real FLOPs wants
+the accelerated path, and block-angular structure wants the explicit
+Schur backend. This dispatcher applies those rules once at ``setup`` and
+then delegates every call to the chosen concrete backend — the
+reference's ``--backend=`` selection surface with a sensible default on
+top (BASELINE.json:5; the reference itself appears to require an explicit
+choice, so this is an addition, not a parity item).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+from distributedlpsolver_tpu.backends.base import (
+    SolverBackend,
+    get_backend,
+    register_backend,
+)
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
+from distributedlpsolver_tpu.models.problem import InteriorForm
+
+# Below this many matrix entries the whole solve is cheaper than device
+# dispatch (measured: 27×51 → ~10 ms CPU vs ~0.5 s tunneled-TPU).
+_SMALL_ENTRIES = 200_000
+
+
+def choose_backend_name(inf: InteriorForm, platform: str) -> str:
+    if platform == "cpu":
+        return "cpu-native"
+    # Any accelerator (tpu/gpu/...): tiny problems still go to the CPU —
+    # device dispatch dominates them — everything else runs the JAX path
+    # ("tpu" is the registry name of the accelerated dense backend on
+    # whatever platform jax is using), with block structure preferring the
+    # explicit Schur backend.
+    m, n = inf.m, inf.n
+    if m * n <= _SMALL_ENTRIES:
+        return "cpu-native"
+    K = int((inf.block_structure or {}).get("num_blocks", 0))
+    if K >= 2:
+        return "block"
+    return "tpu"
+
+
+@register_backend("auto")
+class AutoBackend(SolverBackend):
+    """Delegates to the backend :func:`choose_backend_name` picks."""
+
+    def __init__(self):
+        self._inner: SolverBackend | None = None
+
+    def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
+        name = choose_backend_name(inf, jax.default_backend())
+        self._inner = get_backend(name)
+        self.name = f"auto({name})"
+        self._inner.setup(inf, config)
+
+    def starting_point(self) -> IPMState:
+        return self._inner.starting_point()
+
+    def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
+        return self._inner.iterate(state)
+
+    def bump_regularization(self) -> bool:
+        return self._inner.bump_regularization()
+
+    def solve_full(self, state: IPMState):
+        return self._inner.solve_full(state)
+
+    def to_host(self, state: IPMState) -> IPMState:
+        return self._inner.to_host(state)
+
+    def from_host(self, state: IPMState) -> IPMState:
+        return self._inner.from_host(state)
+
+    def block_until_ready(self, obj) -> None:
+        self._inner.block_until_ready(obj)

@@ -262,6 +262,13 @@ def _solve_batched_jit(
 _CHUNK_DEFAULT = 256  # per-device-program batch slice; see solve_batched
 
 
+def _cleanup_cap(B: int) -> int:
+    """Max members the solo-cleanup pass will re-solve — ONE definition,
+    shared by tail extraction's early stop (which promises every abandoned
+    member a cleanup solve) and the cleanup gate itself."""
+    return max(4, B // 8)
+
+
 def _fresh_batch_carry(states, iters, B, reg0, dtype):
     return (
         states,
@@ -311,7 +318,7 @@ def _solve_batched_segmented(A, data, cfg, params, params_p1, fname, two_phase, 
     # unfinished count to fit the solo-cleanup bound, so an abandoned
     # problem is never left without its cleanup solve.
     tail = B // 32
-    cleanup_cap = max(4, B // 8)
+    cleanup_cap = _cleanup_cap(B)
     for pi, (p, f, win, wstat) in enumerate(phases):
         final = pi == len(phases) - 1
 
@@ -505,15 +512,23 @@ def solve_batched(
     # masked loop alive at full-batch cost per iteration. Bounded so a
     # pathological batch can't turn into B sequential solves.
     bad = [i for i in range(Bsz) if status_arr[i] != Status.OPTIMAL]
-    if bad and len(bad) <= max(4, Bsz // 8):
+    if bad and len(bad) <= _cleanup_cap(Bsz):
         from distributedlpsolver_tpu.ipm.driver import solve as _solve
         from distributedlpsolver_tpu.models.problem import InteriorForm, _SHIFT
 
-        solo_cfg = cfg.replace(
+        base_cfg = cfg.replace(
             verbose=False, log_jsonl=None, checkpoint_path=None,
             checkpoint_every=0, profile_dir=None,
         )
         for i in bad:
+            # max_iter is a hard per-problem budget: the solo solve only
+            # gets what the batched loop left unspent (tail-extracted
+            # members keep most of theirs; genuine iteration-limit members
+            # get none and keep that verdict).
+            remaining = cfg.max_iter - int(iterations[i])
+            if remaining <= 0:
+                continue
+            solo_cfg = base_cfg.replace(max_iter=remaining)
             # Per-member host conversion — full-batch f64 copies just to
             # patch a handful of rows would be ~hundreds of MB transient.
             inf_i = InteriorForm(

@@ -34,6 +34,89 @@ from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
 from distributedlpsolver_tpu.models.problem import InteriorForm
 
 
+# Matrix-entry count above which f64 ops on A run tiled: XLA's TPU f64
+# emulation materializes ~8 full-size f32 component copies of each GEMM
+# operand (observed: a 15 GB f32[8,50000,10000] temp at the 10k×50k
+# reference shape — 3× HBM for ONE operand). Tiling every f64 contraction
+# with A keeps each emulated operand at tile scale. 2²⁵ entries ⇒ ~1 GB
+# of split temps per operand; 2²⁶ left the reference shape 665 MB over
+# budget with overlapped double-buffered tiles.
+_CHUNK_ENTRIES = 1 << 25
+
+
+def _tile_rows(m: int, n: int) -> int:
+    # ~_CHUNK_ENTRIES entries per tile, 8-row aligned (TPU sublane); never
+    # larger than m itself (a slice size > operand size fails at trace).
+    return min(m, max(8, (_CHUNK_ENTRIES // max(n, 1)) // 8 * 8))
+
+
+def _normal_eq_chunked(A, d):
+    """``A·diag(d)·Aᵀ`` with BOTH GEMM operands tiled (lax.fori_loop over
+    row-block pairs; one compiled body, clamped dynamic slices — the last
+    partial block is recomputed at a clamped offset, writing identical
+    values, so no padding is needed)."""
+    m, n = A.shape
+    if m * n <= _CHUNK_ENTRIES:
+        return (A * d[None, :]) @ A.T
+    tile = _tile_rows(m, n)
+    nblk = -(-m // tile)
+
+    def ibody(ib, M):
+        i0 = ib * tile
+        Ci = jax.lax.dynamic_slice_in_dim(A, i0, tile, 0) * d[None, :]
+
+        def jbody(jb, M):
+            j0 = jb * tile
+            Aj = jax.lax.dynamic_slice_in_dim(A, j0, tile, 0)
+            return jax.lax.dynamic_update_slice(M, Ci @ Aj.T, (i0, j0))
+
+        return jax.lax.fori_loop(0, nblk, jbody, M)
+
+    return jax.lax.fori_loop(0, nblk, ibody, jnp.zeros((m, m), A.dtype))
+
+
+def _matvec_chunked(A, v):
+    """``A @ v`` via row tiles (bounds emulated-f64 operand temps)."""
+    m, n = A.shape
+    if m * n <= _CHUNK_ENTRIES:
+        return A @ v
+    tile = _tile_rows(m, n)
+    nblk = -(-m // tile)
+
+    def body(ib, out):
+        i0 = ib * tile
+        blk = jax.lax.dynamic_slice_in_dim(A, i0, tile, 0) @ v
+        return jax.lax.dynamic_update_slice(out, blk, (i0,))
+
+    return jax.lax.fori_loop(0, nblk, body, jnp.zeros((m,), A.dtype))
+
+
+def _rmatvec_chunked(A, y):
+    """``Aᵀ @ y`` as a sum of row-tile contributions.
+
+    The clamped-slice trick is NOT safe for an accumulating loop (the last
+    partial tile would double-count), so the ragged tail is handled as a
+    separate masked term.
+    """
+    m, n = A.shape
+    if m * n <= _CHUNK_ENTRIES:
+        return A.T @ y
+    tile = _tile_rows(m, n)
+    nfull = m // tile
+
+    def body(ib, acc):
+        i0 = ib * tile
+        Ai = jax.lax.dynamic_slice_in_dim(A, i0, tile, 0)
+        yi = jax.lax.dynamic_slice_in_dim(y, i0, tile, 0)
+        return acc + Ai.T @ yi
+
+    acc = jax.lax.fori_loop(0, nfull, body, jnp.zeros((n,), A.dtype))
+    rem = m - nfull * tile
+    if rem:
+        acc = acc + A[nfull * tile :].T @ y[nfull * tile :]
+    return acc
+
+
 def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
     """Build factorize/solve closures over a (traced) matrix ``A``.
 
@@ -66,7 +149,7 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
             # emulated f64 (two-phase phase 1 off-TPU-pallas / sharded).
             M = (Af * d.astype(Af.dtype)[None, :]) @ Af.T
         else:
-            M = (A * d[None, :]) @ A.T
+            M = _normal_eq_chunked(A, d)
         # Per-row *relative* diagonal perturbation: with heterogeneous d the
         # diagonal spans many orders of magnitude, and a uniform (trace- or
         # norm-scaled) shift would swamp the small rows and wreck the
@@ -80,7 +163,7 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
         lo = jax.scipy.linalg.cho_solve((L, True), rhs.astype(factor_dtype))
         y = lo.astype(rhs.dtype)
         for _ in range(refine_steps):
-            r = rhs - M @ y
+            r = rhs - _matvec_chunked(M, y)
             y = y + jax.scipy.linalg.cho_solve((L, True), r.astype(factor_dtype)).astype(
                 rhs.dtype
             )
@@ -93,8 +176,8 @@ def _make_ops(A, reg, factor_dtype, refine_steps, use_pallas=False, Af=None):
     factorize, solve = _cholesky_ops(A, factor_dtype, refine_steps, use_pallas, Af)
     return core.LinOps(
         xp=jnp,
-        matvec=lambda v: A @ v,
-        rmatvec=lambda v: A.T @ v,
+        matvec=lambda v: _matvec_chunked(A, v),
+        rmatvec=lambda v: _rmatvec_chunked(A, v),
         factorize=functools.partial(factorize, reg=reg),
         solve=solve,
     )
@@ -446,6 +529,19 @@ class DenseJaxBackend(SolverBackend):
                 jnp.asarray(0, jnp.int32),
             )
 
+        m, n = self._A.shape
+
+        def seg_init_for(fdt_name: str, target_s: float = 15.0) -> int:
+            # Seed the first segments from a FLOP estimate so a big
+            # problem's opening segment can't blow the execution watchdog
+            # before the measured-rate adaptation kicks in (a 10k×50k f64
+            # iteration is tens of seconds on emulated f64). Rates are
+            # deliberately conservative.
+            flops = 2.0 * m * m * n + m**3 / 3.0
+            rate = 2e12 if fdt_name == "float32" else 2.5e11
+            est = flops / rate
+            return max(1, min(seg, int(target_s / max(est, 1e-3))))
+
         plan = self._phase_plan()
         carry = fresh_carry(state, 0, None)
         reg0 = jnp.asarray(self._reg, dtype)
@@ -463,7 +559,7 @@ class DenseJaxBackend(SolverBackend):
                 )
 
             carry, (it, status, best, since) = core.drive_segments(
-                run_seg, carry, bound, window, seg,
+                run_seg, carry, bound, window, seg_init_for(fdt),
                 stall_patience_floor=patience, it0_status0=(it, status),
             )
             if pi < len(plan) - 1:

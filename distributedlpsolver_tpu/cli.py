@@ -21,7 +21,11 @@ from typing import List, Optional
 
 
 def _add_solver_flags(ap: argparse.ArgumentParser) -> None:
-    ap.add_argument("--backend", default="tpu", help="SolverBackend name")
+    ap.add_argument(
+        "--backend",
+        default="auto",
+        help="SolverBackend name (auto = pick by problem size/structure)",
+    )
     ap.add_argument("--tol", type=float, default=1e-8, help="relative gap/infeasibility tolerance")
     ap.add_argument("--max-iter", type=int, default=200)
     ap.add_argument("--quiet", action="store_true", help="suppress per-iteration log")

@@ -280,3 +280,52 @@ def test_short_d_rejected_without_out_m():
     d = jnp.ones(100, jnp.float32)  # wrong length
     with pytest.raises(ValueError, match="expected"):
         normal_eq_pallas(A, d, block_m=64, block_k=64, interpret=True)
+
+
+# ------------------------------------------------- tiled f64 ops contract
+def test_chunked_ops_match_direct(monkeypatch):
+    # Force tiling on small shapes (incl. ragged tails) — at scale these
+    # bound XLA's emulated-f64 operand-split temps (see dense._CHUNK_ENTRIES).
+    import distributedlpsolver_tpu.backends.dense as dense
+
+    monkeypatch.setattr(dense, "_CHUNK_ENTRIES", 300)
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((37, 53)))
+    d = jnp.asarray(rng.random(53) + 0.1)
+    v = jnp.asarray(rng.standard_normal(53))
+    y = jnp.asarray(rng.standard_normal(37))
+    np.testing.assert_allclose(
+        np.asarray(dense._normal_eq_chunked(A, d)),
+        np.asarray((A * d[None, :]) @ A.T), rtol=1e-12, atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense._matvec_chunked(A, v)), np.asarray(A @ v),
+        rtol=1e-12, atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense._rmatvec_chunked(A, y)), np.asarray(A.T @ y),
+        rtol=1e-12, atol=1e-12,
+    )
+
+
+def test_chunked_ops_tiny_m(monkeypatch):
+    # m smaller than the 8-row tile floor must not produce oversized slices.
+    import distributedlpsolver_tpu.backends.dense as dense
+
+    monkeypatch.setattr(dense, "_CHUNK_ENTRIES", 20)
+    rng = np.random.default_rng(1)
+    A = jnp.asarray(rng.standard_normal((3, 40)))
+    d = jnp.asarray(rng.random(40) + 0.1)
+    np.testing.assert_allclose(
+        np.asarray(dense._normal_eq_chunked(A, d)),
+        np.asarray((A * d[None, :]) @ A.T), rtol=1e-12, atol=1e-12,
+    )
+
+
+def test_solve_end_to_end_with_forced_tiling(monkeypatch):
+    import distributedlpsolver_tpu.backends.dense as dense
+
+    monkeypatch.setattr(dense, "_CHUNK_ENTRIES", 500)
+    p = random_dense_lp(20, 50, seed=9)
+    r = solve(p, backend="tpu")  # dense JAX backend on the CPU platform
+    assert r.status == Status.OPTIMAL and r.rel_gap <= 1e-8
