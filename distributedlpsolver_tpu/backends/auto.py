@@ -45,6 +45,17 @@ def choose_backend_name(inf: InteriorForm, platform: str) -> str:
     K = int((inf.block_structure or {}).get("num_blocks", 0))
     if K >= 2:
         return "block"
+    # Large genuinely-sparse problems without block structure must not hit
+    # the dense path — its setup densifies A (a Mittelmann-scale LP would
+    # be a multi-terabyte allocation). The sparse-direct CPU backend is
+    # the honest executor for unstructured sparsity (SURVEY.md §7:
+    # "truly unstructured sparse may route to the CPU backend").
+    import scipy.sparse as sp
+
+    if sp.issparse(inf.A):
+        density = inf.A.nnz / max(m * n, 1)
+        if density < 0.1:
+            return "cpu-sparse"
     return "tpu"
 
 

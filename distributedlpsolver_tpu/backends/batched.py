@@ -520,12 +520,17 @@ def solve_batched(
             verbose=False, log_jsonl=None, checkpoint_path=None,
             checkpoint_every=0, profile_dir=None,
         )
+        # The batched loop's total budget is max_iter PER PHASE (the f32
+        # phase's accepted steps land in the same per-problem counter), so
+        # the cleanup comparison must use the same total — comparing
+        # against a single max_iter would deny tail-extracted members the
+        # cleanup solve the early stop promised them.
+        n_phases = 2 if two_phase else 1
         for i in bad:
-            # max_iter is a hard per-problem budget: the solo solve only
-            # gets what the batched loop left unspent (tail-extracted
-            # members keep most of theirs; genuine iteration-limit members
-            # get none and keep that verdict).
-            remaining = cfg.max_iter - int(iterations[i])
+            # The solo solve only gets what the batched loop left unspent
+            # (tail-extracted members keep most of theirs; genuine
+            # iteration-limit members get none and keep that verdict).
+            remaining = n_phases * cfg.max_iter - int(iterations[i])
             if remaining <= 0:
                 continue
             solo_cfg = base_cfg.replace(max_iter=remaining)

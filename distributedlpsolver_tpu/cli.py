@@ -38,6 +38,14 @@ def _add_solver_flags(ap: argparse.ArgumentParser) -> None:
         default="auto",
         help="Cholesky dtype: auto = f32→f64 two-phase on TPU; or float32/float64",
     )
+    ap.add_argument(
+        "--no-presolve",
+        action="store_true",
+        help="disable structural presolve (singleton/redundant rows, fixed cols)",
+    )
+    ap.add_argument(
+        "--no-scale", action="store_true", help="disable Ruiz equilibration"
+    )
     ap.add_argument("--json", action="store_true", help="print result as one JSON object")
     ap.add_argument("--x-out", default=None, help="write solution vector as .npy")
 
@@ -54,6 +62,8 @@ def _config_from(args) -> "SolverConfig":
         checkpoint_every=args.checkpoint_every,
         profile_dir=args.profile_dir,
         factor_dtype=args.factor_dtype,
+        presolve=not args.no_presolve,
+        scale=not args.no_scale,
     )
 
 

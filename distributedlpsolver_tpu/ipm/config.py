@@ -57,6 +57,11 @@ class SolverConfig:
     # Ruiz-equilibrate the interior form before solving (presolve scaling;
     # convergence is then tested in the scaled space, standard practice).
     scale: bool = True
+    # Structural presolve (models/presolve.py): singleton/empty/redundant
+    # rows, fixed/empty columns, early infeasibility/unboundedness — with
+    # exact primal+dual postsolve. Applied to general-form problems only
+    # (an InteriorForm input or a block_structure hint skips it).
+    presolve: bool = True
     # distribution (sharded backends)
     mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices
     mesh_axis: str = "cols"  # axis name for the variable-sharded mesh dim

@@ -150,6 +150,23 @@ def _centrality_backoff(xp, state, hub, dirs, ap_max, ad_max, ncomp, gamma):
         return ap_max, ad_max
     x, y, s, w, z = state
     dx, ds, dw, dz = dirs
+    # If the CURRENT iterate already sits outside N₋∞(γ), demanding γ from
+    # every candidate rejects them all (α→0 approaches the current point,
+    # which violates γ) and the fallback pins every step at the most-damped
+    # candidate — the solve crawls at α≈0.8²³ forever (observed). Relax the
+    # demand to 0.9× the current centrality ratio in that case: the guard
+    # then only blocks steps that make centrality *worse*, while iterates
+    # inside the neighborhood still get the full γ.
+    xs0 = x * s
+    wz0 = w * z
+    mu0 = (xs0.sum() + (wz0 * hub).sum()) / ncomp
+    inf0 = xp.asarray(xp.inf, dtype=x.dtype)
+    minprod0 = xp.minimum(xs0.min(), xp.where(hub > 0, wz0, inf0).min())
+    ratio0 = minprod0 / xp.maximum(mu0, xp.finfo(x.dtype).tiny)
+    # Only relax when actually outside — an unconditional min() would let
+    # the floor erode geometrically (each accepted step lands near the
+    # floor, then 0.9× it again next iteration).
+    gamma = xp.where(ratio0 < gamma, 0.9 * ratio0, gamma)
     fac = 0.8 ** xp.arange(24, dtype=x.dtype)
     aps = ap_max * fac
     ads = ad_max * fac

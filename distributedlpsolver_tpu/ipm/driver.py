@@ -62,7 +62,21 @@ def solve(
         cfg = cfg.replace(**config_overrides)
 
     original: Optional[LPProblem] = problem if isinstance(problem, LPProblem) else None
-    inf = to_interior_form(problem) if isinstance(problem, LPProblem) else problem
+    presolve_info = None
+    if (
+        cfg.presolve
+        and original is not None
+        and original.block_structure is None  # reductions break the hint
+        and warm_start is None  # warm starts are in the unreduced space
+    ):
+        from distributedlpsolver_tpu.models.presolve import presolve as _presolve
+
+        reduced, presolve_info = _presolve(original)
+        if presolve_info.status is not None:
+            return _presolved_result(original, presolve_info, backend)
+        inf = to_interior_form(reduced)
+    else:
+        inf = to_interior_form(problem) if isinstance(problem, LPProblem) else problem
 
     scaling = None
     inf_solve = inf
@@ -105,6 +119,7 @@ def solve(
             return _finalize(
                 be, state, status, history, last, solve_time, setup_time,
                 inf, original, backend, start_iter, scaling=scaling,
+                presolve_info=presolve_info,
             )
 
     status = Status.ITERATION_LIMIT
@@ -172,7 +187,7 @@ def solve(
     return _finalize(
         be, state, status, history, last, solve_time, setup_time,
         inf, original, backend, start_iter, extra_iters=it - start_iter,
-        scaling=scaling,
+        scaling=scaling, presolve_info=presolve_info,
     )
 
 
@@ -219,14 +234,29 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
 def _finalize(
     be, state, status, history, last, solve_time, setup_time,
     inf, original, backend, start_iter, extra_iters=None, scaling=None,
+    presolve_info=None,
 ):
     host = be.to_host(state)
     if scaling is not None:
         host = scaling.unscale_state(host)
     x_t = np.asarray(host.x, dtype=np.float64)
     obj_min = inf.objective(x_t)
+    y = np.asarray(host.y, dtype=np.float64)
+    s = np.asarray(host.s, dtype=np.float64)
     if original is not None:
         x_orig = inf.recover(x_t)
+        if presolve_info is not None:
+            # ``inf`` was built from the presolve-reduced problem: expand
+            # the primal back to the full variable space and recover exact
+            # duals for the removed rows (models/presolve.py).
+            x_orig = presolve_info.postsolve_x(x_orig)
+            y, s = presolve_info.postsolve_duals(original, x_orig, y)
+            obj_min = float(original.c @ x_orig) + original.c0
+        else:
+            # Same contract without presolve: rows are preserved by
+            # to_interior_form, so y maps 1:1 and the original-space
+            # reduced costs re-derive as c - Aᵀy (minimized sense).
+            s = original.c - np.asarray(original.A.T @ y).ravel()
         objective = -obj_min if original.maximize else obj_min
     else:
         x_orig = x_t
@@ -245,8 +275,40 @@ def _finalize(
         history=history,
         backend=getattr(be, "name", str(backend)),
         name=inf.name,
-        y=np.asarray(host.y, dtype=np.float64),
-        s=np.asarray(host.s, dtype=np.float64),
+        y=y,
+        s=s,
+    )
+
+
+def _presolved_result(original: LPProblem, info, backend) -> IPMResult:
+    """Result for a problem presolve settled without running the IPM."""
+    optimal = info.status == Status.OPTIMAL
+    x = info.postsolve_x(np.empty(0)) if optimal else None
+    y = s = None
+    if optimal:
+        y, s = info.postsolve_duals(original, x, None)
+        obj = -info.objective if original.maximize else info.objective
+    elif info.status == Status.DUAL_INFEASIBLE:
+        # Primal unbounded: the minimized objective runs to -inf
+        # (+inf in the original sense for a maximization).
+        obj = np.inf if original.maximize else -np.inf
+    else:  # infeasible: no attainable objective
+        obj = -np.inf if original.maximize else np.inf
+    return IPMResult(
+        status=info.status,
+        x=x,
+        objective=obj,
+        iterations=0,
+        rel_gap=0.0 if optimal else np.inf,
+        pinf=0.0 if optimal else np.inf,
+        dinf=0.0 if optimal else np.inf,
+        solve_time=0.0,
+        setup_time=0.0,
+        history=[],
+        backend=f"presolve+{backend if isinstance(backend, str) else getattr(backend, 'name', '')}",
+        name=original.name,
+        y=y,
+        s=s,
     )
 
 

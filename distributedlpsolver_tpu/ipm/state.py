@@ -92,7 +92,11 @@ class IPMResult:
     history: List[IterRecord] = dataclasses.field(default_factory=list)
     backend: str = ""
     name: str = ""
-    # interior-form artifacts for diagnostics / warm restart
+    # Dual solution (minimized sense). For an LPProblem input these are in
+    # the ORIGINAL problem space regardless of presolve: y has one entry
+    # per original row (0 for presolve-removed rows except singleton rows,
+    # which receive their absorbed bound multiplier) and s = c - Aᵀy.
+    # For a raw InteriorForm input they are the interior-form duals.
     y: Optional[np.ndarray] = None
     s: Optional[np.ndarray] = None
 

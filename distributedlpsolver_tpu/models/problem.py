@@ -140,6 +140,11 @@ class InteriorForm:
     col_sign: np.ndarray  # (nt,) +1 or -1
     name: str = "LP"
     block_structure: Optional[dict] = None  # propagated LPProblem hint
+    # Baseline contribution per original column: nonzero only for fixed
+    # (lb == ub) columns, which are substituted out during conversion — a
+    # zero-width interior variable (u = 0) has no interior point and
+    # breaks the IPM's 1/x arithmetic.
+    x_base: Optional[np.ndarray] = None
 
     @property
     def m(self) -> int:
@@ -155,7 +160,11 @@ class InteriorForm:
 
     def recover(self, x_tilde: np.ndarray) -> np.ndarray:
         """Map an interior-form solution back to the original variable space."""
-        x = np.zeros(self.orig_n, dtype=np.float64)
+        x = (
+            np.zeros(self.orig_n, dtype=np.float64)
+            if self.x_base is None
+            else np.asarray(self.x_base, dtype=np.float64).copy()
+        )
         contrib = self.col_sign * (np.asarray(x_tilde, dtype=np.float64) + self.col_shift)
         mask = self.col_orig >= 0
         np.add.at(x, self.col_orig[mask], contrib[mask])
@@ -183,6 +192,41 @@ def to_interior_form(p: LPProblem) -> InteriorForm:
     """
     m, n = p.shape
     sparse = _is_sparse(p.A)
+
+    # Fixed columns (lb == ub) are substituted out up front: a zero-width
+    # variable has no interior point (u = 0 ⇒ x̃ = 0 on the boundary) and
+    # wrecks the IPM's 1/x arithmetic. The substitution moves a·v into the
+    # row bounds and c·v into the objective constant; recovery restores the
+    # value via ``x_base``.
+    fixed = np.isfinite(p.lb) & (p.ub <= p.lb)  # validated lb <= ub
+    if fixed.any():
+        keep = np.flatnonzero(~fixed)
+        fidx = np.flatnonzero(fixed)
+        v = p.lb[fixed]
+        Ac = p.A.tocsc() if sparse else p.A
+        shift_rows = np.asarray(Ac[:, fidx] @ v).ravel()
+        q = LPProblem(
+            c=p.c[keep],
+            A=Ac[:, keep],
+            rlb=np.where(np.isfinite(p.rlb), p.rlb - shift_rows, p.rlb),
+            rub=np.where(np.isfinite(p.rub), p.rub - shift_rows, p.rub),
+            lb=p.lb[keep],
+            ub=p.ub[keep],
+            c0=p.c0 + float(p.c[fidx] @ v),
+            name=p.name,
+            maximize=p.maximize,
+            block_structure=p.block_structure,
+        )
+        inf = to_interior_form(q)
+        x_base = np.zeros(n)
+        x_base[fidx] = v
+        # Remap reduced column indices back to the original numbering.
+        col_orig = inf.col_orig.copy()
+        live = col_orig >= 0
+        col_orig[live] = keep[col_orig[live]]
+        return dataclasses.replace(
+            inf, orig_n=n, col_orig=col_orig, x_base=x_base
+        )
 
     is_eq = (p.rlb == p.rub) & np.isfinite(p.rlb)
     ineq_rows = np.flatnonzero(~is_eq)

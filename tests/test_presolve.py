@@ -1,0 +1,268 @@
+"""Structural presolve: reductions, postsolve exactness, dual recovery.
+
+Oracle strategy (SURVEY.md §4): HiGHS on the *original* problem must agree
+with presolve+IPM on the reduced one; dual recovery is validated through
+strong duality computed entirely in the original space.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from distributedlpsolver_tpu.ipm import solve
+from distributedlpsolver_tpu.ipm.state import Status
+from distributedlpsolver_tpu.models.generators import random_general_lp
+from distributedlpsolver_tpu.models.presolve import presolve
+from distributedlpsolver_tpu.models.problem import LPProblem
+
+from tests.oracle import highs_on_general
+
+INF = np.inf
+
+
+def _dual_objective(p: LPProblem, y: np.ndarray, s: np.ndarray) -> float:
+    """General-form dual objective at (y, s): Σ_i y_i·(rlb if y_i>0 else rub)
+    + Σ_j (s_j⁺·lb_j + s_j⁻·ub_j) + c0. Finite iff every positive
+    multiplier pairs with a finite bound — which exact recovery guarantees."""
+    # Multipliers below the solve tolerance are numerically zero; without
+    # clipping, a 1e-11 residual multiplier pairing an infinite bound would
+    # poison the sum with ±inf.
+    y = np.where(np.abs(y) > 1e-7 * (1 + np.abs(y).max()), y, 0.0)
+    s = np.where(np.abs(s) > 1e-7 * (1 + np.abs(s).max()), s, 0.0)
+    row_terms = np.where(y > 0, p.rlb, np.where(y < 0, p.rub, 0.0))
+    col_terms = np.where(s > 0, p.lb, np.where(s < 0, p.ub, 0.0))
+    return float(y @ np.where(y != 0, row_terms, 0.0)
+                 + s @ np.where(s != 0, col_terms, 0.0)) + p.c0
+
+
+def _check_solution(p: LPProblem, r, oracle_obj: float, tol: float = 1e-6):
+    assert r.status == Status.OPTIMAL
+    assert r.objective == pytest.approx(oracle_obj, abs=tol * (1 + abs(oracle_obj)))
+    assert p.max_violation(r.x) < 1e-6
+    # dual recovery: c - Aᵀy = s exactly, strong duality to oracle obj
+    resid = p.c - np.asarray(p.A.T @ r.y).ravel() - r.s
+    assert np.max(np.abs(resid)) < 1e-8 * (1 + np.max(np.abs(p.c)))
+    dobj = _dual_objective(p, r.y, r.s)
+    assert np.isfinite(dobj)
+    sense = -1.0 if p.maximize else 1.0
+    assert sense * r.objective == pytest.approx(dobj, abs=1e-5 * (1 + abs(dobj)))
+
+
+def _mini_lp(**kw):
+    """3 vars, rows: equality + redundant + singleton; col 2 fixed."""
+    defaults = dict(
+        c=[1.0, 2.0, 3.0],
+        A=[
+            [1.0, 1.0, 1.0],   # equality x0+x1+x2 = 10
+            [1.0, 0.0, 0.0],   # singleton: 2 <= x0 <= 8
+            [1.0, 1.0, 1.0],   # redundant copy with slack range
+        ],
+        rlb=[10.0, 2.0, -100.0],
+        rub=[10.0, 8.0, 100.0],
+        lb=[0.0, 0.0, 4.0],
+        ub=[INF, 20.0, 4.0],  # x2 fixed at 4; x1's finite ub keeps row 2's
+        # activity range finite so the redundancy scan can retire it
+        name="mini",
+    )
+    defaults.update(kw)
+    return LPProblem(**defaults)
+
+
+class TestReductions:
+    def test_mini_counts(self):
+        red, info = presolve(_mini_lp())
+        assert info.status is None
+        assert info.reductions["singleton_rows"] == 1
+        assert info.reductions["fixed_cols"] == 1
+        assert info.reductions["redundant_rows"] >= 1
+        m_red, n_red = info.reduced_shape
+        assert n_red == 2 and m_red == 1
+        assert red.shape == (m_red, n_red)
+
+    def test_sparse_matches_dense(self):
+        p = _mini_lp()
+        ps = _mini_lp(A=sp.csr_matrix(np.asarray(p.A)))
+        rd, infd = presolve(p)
+        rs, infs = presolve(ps)
+        assert infd.reduced_shape == infs.reduced_shape
+        assert np.allclose(rd.rlb, rs.rlb) and np.allclose(rd.lb, rs.lb)
+
+    def test_fixpoint_cascade(self):
+        # singleton row fixes x0 → x0 substitution makes row 1 a singleton
+        # on x1 → fixes x1 → row 2 becomes empty (feasible) → drop.
+        p = LPProblem(
+            c=[1.0, 1.0],
+            A=[[1.0, 0.0], [1.0, 1.0], [0.0, 0.0]],
+            rlb=[3.0, 5.0, -1.0],
+            rub=[3.0, 5.0, 1.0],
+            lb=[0.0, 0.0],
+            ub=[INF, INF],
+        )
+        red, info = presolve(p)
+        assert info.status == Status.OPTIMAL
+        x = info.postsolve_x(np.empty(0))
+        assert x == pytest.approx([3.0, 2.0])
+        assert info.objective == pytest.approx(5.0)
+
+    def test_empty_column_cost_direction(self):
+        p = LPProblem(
+            c=[1.0, -2.0, 0.0],
+            A=[[0.0, 0.0, 0.0]],
+            rlb=[-1.0],
+            rub=[1.0],
+            lb=[1.0, 0.0, -3.0],
+            ub=[5.0, 7.0, 8.0],
+        )
+        red, info = presolve(p)
+        assert info.status == Status.OPTIMAL
+        x = info.postsolve_x(np.empty(0))
+        # c>0 → lb; c<0 → ub; c=0 → any feasible (clamp of 0)
+        assert x[0] == pytest.approx(1.0)
+        assert x[1] == pytest.approx(7.0)
+        assert p.lb[2] <= x[2] <= p.ub[2]
+
+
+class TestEarlyStatus:
+    def test_infeasible_crossing_bounds(self):
+        # x ≤ -1 (singleton row) conflicts with lb = 0
+        p = LPProblem(
+            c=[1.0], A=[[1.0]], rlb=[-INF], rub=[-1.0], lb=[0.0], ub=[INF]
+        )
+        _, info = presolve(p)
+        assert info.status == Status.PRIMAL_INFEASIBLE
+
+    def test_infeasible_row_activity(self):
+        # x0 + x1 >= 10 with x0,x1 <= 2 is unsatisfiable
+        p = LPProblem(
+            c=[1.0, 1.0],
+            A=[[1.0, 1.0]],
+            rlb=[10.0],
+            rub=[INF],
+            lb=[0.0, 0.0],
+            ub=[2.0, 2.0],
+        )
+        _, info = presolve(p)
+        assert info.status == Status.PRIMAL_INFEASIBLE
+
+    def test_unbounded_free_costless_constraintless(self):
+        # empty column with negative cost and no upper bound
+        p = LPProblem(
+            c=[-1.0], A=sp.csr_matrix((0, 1)), rlb=np.empty(0), rub=np.empty(0),
+            lb=[0.0], ub=[INF],
+        )
+        _, info = presolve(p)
+        assert info.status == Status.DUAL_INFEASIBLE
+
+    def test_driver_returns_presolve_status(self):
+        p = LPProblem(
+            c=[1.0], A=[[1.0]], rlb=[-INF], rub=[-1.0], lb=[0.0], ub=[INF]
+        )
+        r = solve(p, backend="cpu")
+        assert r.status == Status.PRIMAL_INFEASIBLE
+        assert r.iterations == 0
+
+
+class TestEndToEnd:
+    def test_mini_solve_matches_highs(self):
+        p = _mini_lp()
+        ref = highs_on_general(p)
+        r = solve(p, backend="cpu")
+        _check_solution(p, r, ref.fun)
+
+    def test_presolve_off_same_objective(self):
+        p = _mini_lp()
+        r_on = solve(p, backend="cpu")
+        r_off = solve(p, backend="cpu", presolve=False)
+        assert r_on.objective == pytest.approx(r_off.objective, abs=1e-6)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_with_structure_matches_highs(self, seed):
+        rng = np.random.default_rng(seed)
+        p = random_general_lp(18, 30, seed=seed)
+        # Inject presolve-visible structure: zero out a batch of entries,
+        # fix some cols, add singleton + empty + redundant rows.
+        A = np.asarray(p.A).copy()
+        A[rng.random(A.shape) < 0.3] = 0.0
+        m, n = A.shape
+        extra = np.zeros((3, n))
+        extra[0, 4] = 2.0  # singleton row: 1 ≤ 2·x4 ≤ 6
+        A2 = np.vstack([A, extra])
+        rlb = np.concatenate([p.rlb, [1.0, -1.0, -INF]])
+        rub = np.concatenate([p.rub, [6.0, 1.0, INF]])
+        lb, ub = p.lb.copy(), p.ub.copy()
+        lb[7] = ub[7] = 0.5  # fixed col
+        lb = np.minimum(lb, ub)
+        q = LPProblem(c=p.c, A=A2, rlb=rlb, rub=rub, lb=lb, ub=ub, name="structured")
+        ref = highs_on_general(q)
+        if ref.status != 0:
+            pytest.skip("oracle did not find the perturbed problem optimal")
+        red, info = presolve(q)
+        assert info.reductions["singleton_rows"] >= 1
+        r = solve(q, backend="cpu")
+        _check_solution(q, r, ref.fun, tol=1e-5)
+
+    def test_singleton_dual_attribution(self):
+        # min x subject only to singleton row x >= 3: the row's bound binds
+        # (orig lb=0 is looser) so its multiplier must absorb s = c.
+        p = LPProblem(
+            c=[1.0, 1.0],
+            A=[[1.0, 0.0], [1.0, 1.0]],
+            rlb=[3.0, -INF],
+            rub=[INF, 100.0],
+            lb=[0.0, 0.0],
+            ub=[INF, INF],
+        )
+        ref = highs_on_general(p)
+        r = solve(p, backend="cpu")
+        _check_solution(p, r, ref.fun)
+        assert r.y[0] == pytest.approx(1.0, abs=1e-6)  # absorbed reduced cost
+        assert abs(r.s[0]) < 1e-6
+
+
+class TestDualCascade:
+    def test_cascaded_singletons_dual_feasible(self):
+        # Row 0 fixes x0=3, which turns row 1 (x0+x1=5) into a singleton on
+        # x1 — both rows share column x0, so a one-shot multiplier pass
+        # double-counts and returns s[0]=-1 paired with ub=+inf (dual
+        # objective -inf). Reverse replay must give y=[0,1,0], s=0.
+        p = LPProblem(
+            c=[1.0, 1.0],
+            A=[[1.0, 0.0], [1.0, 1.0], [0.0, 0.0]],
+            rlb=[3.0, 5.0, -1.0],
+            rub=[3.0, 5.0, 1.0],
+            lb=[0.0, 0.0],
+            ub=[INF, INF],
+        )
+        r = solve(p, backend="cpu")
+        assert r.status == Status.OPTIMAL and r.iterations == 0
+        _check_solution(p, r, 5.0)
+        assert r.y == pytest.approx([0.0, 1.0, 0.0], abs=1e-9)
+        assert r.s == pytest.approx([0.0, 0.0], abs=1e-9)
+
+    def test_unbounded_objective_sign(self):
+        base = dict(
+            A=sp.csr_matrix((0, 1)), rlb=np.empty(0), rub=np.empty(0),
+            lb=[0.0], ub=[INF],
+        )
+        r_min = solve(LPProblem(c=[-1.0], **base), backend="cpu")
+        assert r_min.status == Status.DUAL_INFEASIBLE
+        assert r_min.objective == -INF  # min -x unbounded BELOW
+        # maximize stores c minimized: max x ≡ min -x with maximize=True
+        r_max = solve(LPProblem(c=[-1.0], maximize=True, **base), backend="cpu")
+        assert r_max.status == Status.DUAL_INFEASIBLE
+        assert r_max.objective == INF
+
+    def test_duals_original_space_without_presolve(self):
+        p = _mini_lp()
+        r = solve(p, backend="cpu", presolve=False)
+        assert r.y.shape == (p.m,) and r.s.shape == (p.n,)
+        _check_solution(p, r, 18.0, tol=1e-5)
+
+
+class TestPostsolveShapes:
+    def test_x_y_s_full_dimension(self):
+        p = _mini_lp()
+        r = solve(p, backend="cpu")
+        assert r.x.shape == (p.n,)
+        assert r.y.shape == (p.m,)
+        assert r.s.shape == (p.n,)
