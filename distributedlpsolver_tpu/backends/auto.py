@@ -31,7 +31,11 @@ from distributedlpsolver_tpu.models.problem import InteriorForm
 _SMALL_ENTRIES = 200_000
 
 
-def choose_backend_name(inf: InteriorForm, platform: str) -> str:
+def choose_backend_name(inf: InteriorForm, platform: str, detect: bool = False) -> str:
+    """Pick a backend for ``inf``. With ``detect`` (the AutoBackend path),
+    hint-less sparse problems get a block-angular detection pass
+    (models/structure.py) and, on success, the hint is attached to ``inf``
+    so the Schur backend can consume it."""
     if platform == "cpu":
         return "cpu-native"
     # Any accelerator (tpu/gpu/...): tiny problems still go to the CPU —
@@ -47,14 +51,30 @@ def choose_backend_name(inf: InteriorForm, platform: str) -> str:
         return "block"
     # Large genuinely-sparse problems without block structure must not hit
     # the dense path — its setup densifies A (a Mittelmann-scale LP would
-    # be a multi-terabyte allocation). The sparse-direct CPU backend is
-    # the honest executor for unstructured sparsity (SURVEY.md §7:
-    # "truly unstructured sparse may route to the CPU backend").
+    # be a multi-terabyte allocation). Recoverable block-angular structure
+    # (pds/stormG2-class) routes to the TPU Schur backend; truly
+    # unstructured sparsity goes to the sparse-direct CPU backend
+    # (SURVEY.md §7).
     import scipy.sparse as sp
 
     if sp.issparse(inf.A):
         density = inf.A.nnz / max(m * n, 1)
         if density < 0.1:
+            if detect:
+                from distributedlpsolver_tpu.models.structure import (
+                    detect_block_structure,
+                    estimate_block_tensor_entries,
+                )
+
+                hint = detect_block_structure(inf.A)
+                # Veto detections whose padded dense block tensors would
+                # not fit (~2 GiB f64): the structure may be real, but the
+                # sparse-direct path is then the better executor.
+                if hint is not None and (
+                    estimate_block_tensor_entries(inf.A, hint) <= 1 << 28
+                ):
+                    inf.block_structure = hint
+                    return "block"
             return "cpu-sparse"
     return "tpu"
 
@@ -67,7 +87,7 @@ class AutoBackend(SolverBackend):
         self._inner: SolverBackend | None = None
 
     def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
-        name = choose_backend_name(inf, jax.default_backend())
+        name = choose_backend_name(inf, jax.default_backend(), detect=True)
         self._inner = get_backend(name)
         self.name = f"auto({name})"
         self._inner.setup(inf, config)

@@ -51,11 +51,13 @@ from distributedlpsolver_tpu.parallel import mesh as mesh_lib
 class BlockTensors(NamedTuple):
     """Stacked device arrays describing the arrow-structured A."""
 
-    B_all: jnp.ndarray  # (K, mb, nb)  diagonal blocks (zero-padded cols)
+    B_all: jnp.ndarray  # (K, mb, nb)  diagonal blocks (zero-padded rows/cols)
     L_all: jnp.ndarray  # (K, link, nb) linking-row entries of block cols
     A0: jnp.ndarray  # (link, n0)   border columns (linking rows only)
     col_idx: jnp.ndarray  # (K, nb) int32 → index into x_pad (n is the sentinel)
     border_idx: jnp.ndarray  # (n0,) int32
+    row_idx: jnp.ndarray  # (K, mb) int32 → interior row (m is the sentinel)
+    link_idx: jnp.ndarray  # (link,) int32 interior rows of the linking system
 
 
 class BlockLayout(NamedTuple):
@@ -71,6 +73,16 @@ class BlockLayout(NamedTuple):
 def analyze_structure(inf: InteriorForm) -> Tuple[BlockLayout, dict]:
     """Derive the interior-form block layout from the problem's hint.
 
+    Two hint formats are accepted:
+
+    * legacy uniform: ``{num_blocks, block_m, link_m}`` — rows ordered
+      [K·block_m block rows, link_m linking rows];
+    * general: ``{num_blocks, row_block}`` with ``row_block[i] ∈
+      {-1 (linking), 0..K-1}`` in ANY order with ragged block sizes
+      (the format models/structure.py's detector emits). Blocks are
+      padded to the largest block's row count via index maps — no
+      physical permutation of the problem.
+
     Returns the layout plus host-side index arrays. Raises ValueError when
     the hint is missing or a column spans multiple blocks.
     """
@@ -78,22 +90,43 @@ def analyze_structure(inf: InteriorForm) -> Tuple[BlockLayout, dict]:
     if not hint:
         raise ValueError(
             "block backend needs problem.block_structure "
-            "{num_blocks, block_m, block_n, link_m}"
+            "{num_blocks, block_m, link_m} or {num_blocks, row_block}"
         )
-    K, mb, link = int(hint["num_blocks"]), int(hint["block_m"]), int(hint["link_m"])
     m, n = inf.m, inf.n
-    if K * mb + link != m:
-        raise ValueError(f"structure hint rows {K}*{mb}+{link} != m={m}")
+    K = int(hint["num_blocks"])
+    if "row_block" in hint:
+        row_block = np.asarray(hint["row_block"], dtype=np.int64)
+        if row_block.shape != (m,):
+            raise ValueError(
+                f"row_block has shape {row_block.shape}, expected ({m},)"
+            )
+        if row_block.min() < -1 or row_block.max() >= K:
+            # An out-of-range id would silently drop that row's equation
+            # from every operator — reject instead of solving a different LP.
+            raise ValueError(
+                f"row_block ids must lie in [-1, {K - 1}], got range "
+                f"[{row_block.min()}, {row_block.max()}]"
+            )
+    else:
+        mb_u, link_u = int(hint["block_m"]), int(hint["link_m"])
+        if K * mb_u + link_u != m:
+            raise ValueError(f"structure hint rows {K}*{mb_u}+{link_u} != m={m}")
+        row_block = np.concatenate(
+            [np.repeat(np.arange(K, dtype=np.int64), mb_u), np.full(link_u, -1)]
+        )
+    sizes = np.bincount(row_block[row_block >= 0], minlength=K)
+    mb = int(sizes.max()) if K else 0
+    link = int((row_block == -1).sum())
 
     A = sp.csc_matrix(inf.A) if sp.issparse(inf.A) else sp.csc_matrix(np.asarray(inf.A))
     block_of_col = np.full(n, -2, dtype=np.int64)  # -1 = border, k = block
     for j in range(n):
         rows = A.indices[A.indptr[j] : A.indptr[j + 1]]
-        brows = rows[rows < K * mb]
-        if brows.size == 0:
+        blocks = np.unique(row_block[rows])
+        blocks = blocks[blocks >= 0]
+        if blocks.size == 0:
             block_of_col[j] = -1
             continue
-        blocks = np.unique(brows // mb)
         if len(blocks) > 1:
             raise ValueError(
                 f"column {j} spans blocks {blocks.tolist()} — not block-angular"
@@ -104,25 +137,39 @@ def analyze_structure(inf: InteriorForm) -> Tuple[BlockLayout, dict]:
     nb = int(counts.max()) if K else 0
     border = np.flatnonzero(block_of_col == -1)
     layout = BlockLayout(K=K, mb=mb, nb=nb, link=link, n0=len(border), n=n, m=m)
-    return layout, {"block_of_col": block_of_col, "border": border, "A": A}
+    return layout, {
+        "block_of_col": block_of_col,
+        "border": border,
+        "A": A,
+        "row_block": row_block,
+    }
 
 
 def build_tensors(inf: InteriorForm, dtype, shard_put=None) -> Tuple[BlockTensors, BlockLayout]:
     layout, info = analyze_structure(inf)
     K, mb, nb, link, n0, n, m = layout
-    A = info["A"].tocsr()
-    Ad = np.asarray(A.todense(), dtype=np.float64)
+    # Slice per block straight out of the sparse matrix — densifying only
+    # the (mb, nb_k) / (link, nb_k) tiles that exist. Never materialize the
+    # full m×n dense A: for a Mittelmann-scale sparse problem that is the
+    # multi-terabyte allocation the sparse routing exists to avoid.
+    Ar = info["A"].tocsr()
     block_of_col, border = info["block_of_col"], info["border"]
+    row_block = info["row_block"]
+    link_rows = np.flatnonzero(row_block == -1)
+    A_link = Ar[link_rows].tocsc() if link else sp.csc_matrix((0, n))
 
     B_all = np.zeros((K, mb, nb))
     L_all = np.zeros((K, link, nb))
     col_idx = np.full((K, nb), n, dtype=np.int32)  # sentinel → padded zero
+    row_idx = np.full((K, mb), m, dtype=np.int32)  # sentinel → padded zero row
     for k in range(K):
         cols = np.flatnonzero(block_of_col == k)
+        rows = np.flatnonzero(row_block == k)
         col_idx[k, : len(cols)] = cols
-        B_all[k, :, : len(cols)] = Ad[k * mb : (k + 1) * mb, cols]
-        L_all[k, :, : len(cols)] = Ad[K * mb :, cols]
-    A0 = Ad[K * mb :, border] if n0 else np.zeros((link, 0))
+        row_idx[k, : len(rows)] = rows
+        B_all[k, : len(rows), : len(cols)] = Ar[rows][:, cols].toarray()
+        L_all[k, :, : len(cols)] = A_link[:, cols].toarray()
+    A0 = A_link[:, border].toarray() if n0 else np.zeros((link, 0))
 
     put = shard_put or (lambda x, kind: jnp.asarray(x))
     tensors = BlockTensors(
@@ -131,6 +178,8 @@ def build_tensors(inf: InteriorForm, dtype, shard_put=None) -> Tuple[BlockTensor
         A0=put(A0.astype(dtype), "rep"),
         col_idx=put(col_idx, "blocked"),
         border_idx=put(border.astype(np.int32), "rep"),
+        row_idx=put(row_idx, "blocked"),
+        link_idx=put(link_rows.astype(np.int32), "rep"),
     )
     return tensors, layout
 
@@ -144,15 +193,18 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
 
     def matvec(x):
         xb = pad(x)[t.col_idx]  # (K, nb)
-        y_blocks = jnp.einsum("kmn,kn->km", t.B_all, xb).reshape(K * mb)
+        y_blocks = jnp.einsum("kmn,kn->km", t.B_all, xb)
         y_link = jnp.einsum("kln,kn->l", t.L_all, xb)
         if n0:
             y_link = y_link + t.A0 @ x[t.border_idx]
-        return jnp.concatenate([y_blocks, y_link])
+        # Scatter through the row maps (sentinel row m falls off the end);
+        # with the legacy contiguous layout this is a pure permutation.
+        out = jnp.zeros(m + 1, dtype=x.dtype).at[t.row_idx].add(y_blocks)
+        return out.at[t.link_idx].add(y_link)[:m]
 
     def rmatvec(y):
-        yb = y[: K * mb].reshape(K, mb)
-        yL = y[K * mb :]
+        yb = pad(y)[t.row_idx]  # (K, mb); padded rows read 0
+        yL = y[t.link_idx]
         g = jnp.einsum("kmn,km->kn", t.B_all, yb) + jnp.einsum(
             "kln,l->kn", t.L_all, yL
         )
@@ -169,6 +221,14 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
         dB = pad(d)[t.col_idx]  # (K, nb); padded cols get d=0
         Bd = t.B_all * dB[:, None, :]
         Mkk = jnp.einsum("kmn,kpn->kmp", Bd, t.B_all)
+        # Padded (sentinel) rows are all-zero in B_all → zero rows/cols in
+        # M_kk, which would sink the batched Cholesky. A unit diagonal
+        # decouples them: their rhs entries are zero, so their solution
+        # components stay exactly zero.
+        pad_diag = (t.row_idx == m).astype(Mkk.dtype)  # (K, mb)
+        Mkk = Mkk + jnp.zeros_like(Mkk).at[
+            :, jnp.arange(mb), jnp.arange(mb)
+        ].set(pad_diag)
         Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk))
         Gk = jnp.einsum("kln,kmn->klm", t.L_all * dB[:, None, :], t.B_all)
         # H_k = M_kk⁻¹ G_kᵀ (batched two-triangular-solve), (K, mb, link)
@@ -186,14 +246,15 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
 
     def solve(factors, r):
         Lk, Ls, Gk = factors
-        rb = r[: K * mb].reshape(K, mb)
-        rL = r[K * mb :]
+        rb = pad(r)[t.row_idx]  # (K, mb); padded rows read 0
+        rL = r[t.link_idx]
         tmp = jax.scipy.linalg.cho_solve((Lk, True), rb[..., None])[..., 0]
         rS = rL - jnp.einsum("klm,km->l", Gk, tmp)
         yL = jax.scipy.linalg.cho_solve((Ls, True), rS)
         rb2 = rb - jnp.einsum("klm,l->km", Gk, yL)
         yb = jax.scipy.linalg.cho_solve((Lk, True), rb2[..., None])[..., 0]
-        return jnp.concatenate([yb.reshape(K * mb), yL])
+        out = jnp.zeros(m + 1, dtype=r.dtype).at[t.row_idx].add(yb)
+        return out.at[t.link_idx].add(yL)[:m]
 
     return core.LinOps(
         xp=jnp, matvec=matvec, rmatvec=rmatvec, factorize=factorize, solve=solve

@@ -6,8 +6,11 @@ from distributedlpsolver_tpu.models.generators import (
     random_dense_lp,
     random_general_lp,
 )
+from distributedlpsolver_tpu.models.presolve import presolve
+from distributedlpsolver_tpu.models.structure import detect_block_structure
 
 __all__ = [
     "LPProblem", "InteriorForm", "to_interior_form", "BatchedLP",
     "random_dense_lp", "random_general_lp", "random_batched_lp", "block_angular_lp",
+    "presolve", "detect_block_structure",
 ]

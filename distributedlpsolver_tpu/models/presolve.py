@@ -159,6 +159,26 @@ class _Entries:
             self.Ar = self.Ac.tocsr()
         else:
             self.A = np.asarray(A, dtype=np.float64)
+        self._split = None
+
+    def sign_split(self):
+        """Loop-invariant (pos, neg, pat_p, pat_n) operands of the
+        activity-bound scan, built once and reused across presolve rounds
+        (A never changes; only bounds and liveness do)."""
+        if self._split is None:
+            if self.sparse:
+                pos = self.Ar.maximum(0)
+                neg = self.Ar.minimum(0)
+            else:
+                pos = np.clip(self.A, 0.0, None)
+                neg = self.A - pos
+            self._split = (
+                pos,
+                neg,
+                (pos != 0).astype(np.float64),
+                (neg != 0).astype(np.float64),
+            )
+        return self._split
 
     def row_nnz(self) -> np.ndarray:
         if self.sparse:
@@ -198,18 +218,8 @@ def _activity_bounds(E: _Entries, lb, ub, col_live):
     uinf = (~np.isfinite(ube)).astype(np.float64)  # +inf upper bounds
     lbf = np.where(np.isfinite(lbe), lbe, 0.0)
     ubf = np.where(np.isfinite(ube), ube, 0.0)
-    if E.sparse:
-        pos = E.Ar.maximum(0)
-        neg = E.Ar.minimum(0)
-        pat_p = (pos != 0).astype(np.float64)
-        pat_n = (neg != 0).astype(np.float64)
-        dot = lambda M, v: np.asarray(M @ v).ravel()
-    else:
-        pos = np.clip(E.A, 0.0, None)
-        neg = E.A - pos
-        pat_p = (pos != 0).astype(np.float64)
-        pat_n = (neg != 0).astype(np.float64)
-        dot = lambda M, v: M @ v
+    pos, neg, pat_p, pat_n = E.sign_split()
+    dot = (lambda M, v: np.asarray(M @ v).ravel()) if E.sparse else (lambda M, v: M @ v)
     minact = dot(pos, lbf) + dot(neg, ubf)
     maxact = dot(pos, ubf) + dot(neg, lbf)
     minact = np.where((dot(pat_p, linf) + dot(pat_n, uinf)) > 0, -_INF, minact)

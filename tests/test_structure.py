@@ -1,0 +1,177 @@
+"""Block-structure detection + generalized (ragged/permuted) block backend.
+
+SURVEY.md §3.2: the reference's distributed path consumes block-angular
+problems. Generated problems carry a hint; detection recovers the hint
+from the sparsity pattern alone so real (hint-less) files route to the
+Schur backend. The backend's generalized ``row_block`` hint format is
+validated against the shared dense reference and the HiGHS oracle.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from distributedlpsolver_tpu.ipm import solve
+from distributedlpsolver_tpu.ipm.state import Status
+from distributedlpsolver_tpu.models.generators import block_angular_lp
+from distributedlpsolver_tpu.models.problem import LPProblem
+from distributedlpsolver_tpu.models.structure import detect_block_structure
+
+from tests.oracle import highs_on_general
+
+
+def _strip_hint(p: LPProblem) -> LPProblem:
+    import dataclasses
+
+    return dataclasses.replace(p, block_structure=None)
+
+
+def _permute_rows(p: LPProblem, rng) -> tuple:
+    perm = rng.permutation(p.m)
+    A = p.A.tocsr()[perm] if sp.issparse(p.A) else np.asarray(p.A)[perm]
+    q = LPProblem(
+        c=p.c, A=A, rlb=p.rlb[perm], rub=p.rub[perm], lb=p.lb, ub=p.ub,
+        name=p.name + "_perm",
+    )
+    return q, perm
+
+
+class TestDetection:
+    def test_recovers_generated_structure(self):
+        p = block_angular_lp(6, 24, 40, 12, seed=3, sparse=True)
+        hint = detect_block_structure(_strip_hint(p))
+        assert hint is not None
+        K, rb = hint["num_blocks"], hint["row_block"]
+        assert K >= 2
+        # linking rows are exactly the final link_m rows of the generator
+        assert set(np.flatnonzero(rb == -1)) == set(range(6 * 24, 6 * 24 + 12))
+        # every generated block's rows stay together
+        for k in range(6):
+            blocks = np.unique(rb[k * 24 : (k + 1) * 24])
+            assert len(blocks) == 1 and blocks[0] >= 0
+
+    def test_row_permutation_invariant(self, rng):
+        p = block_angular_lp(4, 16, 28, 8, seed=5, sparse=True)
+        q, perm = _permute_rows(_strip_hint(p), rng)
+        hint = detect_block_structure(q)
+        assert hint is not None
+        rb = hint["row_block"]
+        # Pull back to generator order (q's row j is p's row perm[j]):
+        # blocks must still be coherent in the original ordering.
+        rb_orig = np.empty_like(rb)
+        rb_orig[perm] = rb
+        for k in range(4):
+            blocks = np.unique(rb_orig[k * 16 : (k + 1) * 16])
+            assert len(blocks) == 1 and blocks[0] >= 0
+
+    def test_dense_random_returns_none(self):
+        rng = np.random.default_rng(0)
+        A = sp.csr_matrix(rng.standard_normal((64, 96)))  # fully dense
+        assert detect_block_structure(A) is None
+
+    def test_target_blocks_cap(self):
+        p = block_angular_lp(12, 12, 20, 6, seed=7, sparse=True)
+        hint = detect_block_structure(_strip_hint(p), target_blocks=4)
+        assert hint is not None and hint["num_blocks"] <= 4
+
+
+class TestGeneralizedBackend:
+    def test_ragged_row_block_hint(self):
+        # Build a ragged block-angular problem by hand: blocks of 6, 9, 4
+        # rows — padding inside the backend, no physical permutation.
+        rng = np.random.default_rng(11)
+        sizes = [6, 9, 4]
+        nbs = [10, 14, 7]
+        link = 5
+        x0 = rng.uniform(0.5, 2.0, sum(nbs))
+        blocks = [rng.standard_normal((mb, nb)) for mb, nb in zip(sizes, nbs)]
+        L = rng.standard_normal((link, sum(nbs)))
+        A = sp.block_diag([sp.csr_matrix(B) for B in blocks], format="csr")
+        A = sp.vstack([A, sp.csr_matrix(L)], format="csr")
+        b_loc = np.concatenate([B @ x0[o : o + nb] for B, o, nb in zip(
+            blocks, np.cumsum([0] + nbs[:-1]), nbs)])
+        d = L @ x0 + rng.uniform(0.1, 1.0, link)
+        y0 = rng.standard_normal(A.shape[0])
+        y0[-link:] = -np.abs(y0[-link:])
+        c = np.asarray(A.T @ y0).ravel() + rng.uniform(0.5, 2.0, sum(nbs))
+        m = A.shape[0]
+        rlb = np.concatenate([b_loc, np.full(link, -np.inf)])
+        rub = np.concatenate([b_loc, d])
+        row_block = np.concatenate(
+            [np.repeat(np.arange(3), sizes), np.full(link, -1)]
+        )
+        p = LPProblem(
+            c=c, A=A, rlb=rlb, rub=rub, lb=np.zeros(sum(nbs)),
+            ub=np.full(sum(nbs), np.inf), name="ragged",
+            block_structure={"num_blocks": 3, "row_block": row_block},
+        )
+        ref = highs_on_general(p)
+        assert ref.status == 0
+        r = solve(p, backend="block", scale=False)
+        assert r.status == Status.OPTIMAL
+        assert r.objective == pytest.approx(ref.fun, abs=1e-6 * (1 + abs(ref.fun)))
+
+    def test_permuted_rows_via_detection(self, rng):
+        p = block_angular_lp(4, 16, 28, 8, seed=5, sparse=True)
+        ref = highs_on_general(p)
+        q, _ = _permute_rows(_strip_hint(p), rng)
+        hint = detect_block_structure(q)
+        assert hint is not None
+        import dataclasses
+
+        q = dataclasses.replace(q, block_structure=hint)
+        r = solve(q, backend="block", scale=False)
+        assert r.status == Status.OPTIMAL
+        assert r.objective == pytest.approx(ref.fun, abs=1e-6 * (1 + abs(ref.fun)))
+
+    def test_out_of_range_row_block_rejected(self):
+        p = block_angular_lp(2, 8, 12, 4, seed=0, sparse=True)
+        bad = np.concatenate([np.repeat([0, 1], 8), [-1] * 4])
+        bad[3] = 2  # id out of range for num_blocks=2
+        import dataclasses
+
+        q = dataclasses.replace(
+            p, block_structure={"num_blocks": 2, "row_block": bad}
+        )
+        with pytest.raises(ValueError, match="row_block ids"):
+            solve(q, backend="block", scale=False)
+
+    def test_legacy_hint_unchanged(self):
+        p = block_angular_lp(4, 16, 28, 8, seed=5, sparse=False)
+        ref = highs_on_general(p)
+        r = solve(p, backend="block", scale=False)
+        assert r.status == Status.OPTIMAL
+        assert r.objective == pytest.approx(ref.fun, abs=1e-6 * (1 + abs(ref.fun)))
+
+
+class TestAutoDetectRouting:
+    def test_auto_attaches_hint_and_routes_block(self):
+        from distributedlpsolver_tpu.backends.auto import choose_backend_name
+        from distributedlpsolver_tpu.models.problem import to_interior_form
+
+        p = block_angular_lp(8, 48, 96, 16, seed=1, sparse=True)
+        inf = to_interior_form(_strip_hint(p))
+        assert inf.m * inf.n > 200_000  # above the small-problem cutoff
+        name = choose_backend_name(inf, "tpu", detect=True)
+        assert name == "block"
+        assert inf.block_structure is not None
+        assert inf.block_structure["num_blocks"] >= 2
+
+    def test_unstructured_sparse_routes_cpu_sparse(self):
+        rng = np.random.default_rng(2)
+        # random sparse, no block structure (one giant component)
+        A = sp.random(400, 900, density=0.02, random_state=2, format="csr")
+        A = A + sp.csr_matrix(
+            (np.ones(400), (np.arange(400), np.arange(400))), shape=(400, 900)
+        )
+        from distributedlpsolver_tpu.backends.auto import choose_backend_name
+        from distributedlpsolver_tpu.models.problem import InteriorForm
+
+        inf = InteriorForm(
+            c=np.ones(900), A=A.tocsr(), b=np.ones(400),
+            u=np.full(900, np.inf), c0=0.0, orig_n=900,
+            col_kind=np.zeros(900, dtype=np.int8), col_orig=np.arange(900),
+            col_shift=np.zeros(900), col_sign=np.ones(900),
+        )
+        name = choose_backend_name(inf, "tpu", detect=True)
+        assert name == "cpu-sparse"
