@@ -1,0 +1,340 @@
+"""First-order (restarted PDHG / "PDLP"-style) backend for huge sparse LPs.
+
+The reference's large-sparse configs (neos3, stormG2 — BASELINE.json:10)
+strain a normal-equations IPM on TPU: unstructured sparsity densifies, and
+the Cholesky is the wrong tool at Mittelmann scale. The TPU-native answer
+for this problem class is a matrix-free first-order method — each
+iteration is two SpMV/GEMV passes plus vector arithmetic, which maps to
+HBM bandwidth instead of MXU Cholesky flops and shards trivially (prior
+art: MPAX, PAPERS.md:7 — patterns only, clean-room implementation).
+
+Algorithm: primal-dual hybrid gradient on the interior form
+``min cᵀx s.t. Ax = b, 0 ≤ x ≤ u`` —
+
+    x⁺ = clip(x − τ·(c − Aᵀy), 0, u)
+    y⁺ = y + σ·(b − A·(2x⁺ − x))
+
+with the PDLP toolbox on top:
+
+* step sizes ``τ = η/ω, σ = η·ω`` where ``η = 0.9/‖A‖₂`` (power-iteration
+  estimate) and ω is the primal weight;
+* Polyak–Ruppert averaging inside each restart cycle;
+* adaptive restarts: restart at the average when its normalized KKT error
+  beats the last restart point's by ``restart_beta``, or on a fixed long
+  cycle as a safety net;
+* primal-weight updates at restarts from the primal/dual movement ratio.
+
+The whole loop — including restart bookkeeping — is one
+``lax.while_loop`` device program; only final scalars return to the host.
+Sparse inputs use BCOO SpMV (gather/scatter on TPU, bandwidth-bound);
+dense inputs use plain GEMV.
+
+This backend has no analogue in the reference (its sparse path is a
+direct solver); it is an addition for the problem class the reference's
+own benchmarks name. Accuracy: first-order methods earn their keep at
+1e-4..1e-6; 1e-8 is reachable on well-conditioned problems but can take
+many restarts — the default ``tol`` here is still read from the config, so
+callers choose.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from distributedlpsolver_tpu.backends.base import SolverBackend, register_backend
+from distributedlpsolver_tpu.ipm import core
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
+from distributedlpsolver_tpu.models.problem import InteriorForm
+
+
+class PDHGState(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    x_sum: jnp.ndarray  # running averages within the restart cycle
+    y_sum: jnp.ndarray
+    n_avg: jnp.ndarray
+    x_restart: jnp.ndarray  # cycle start (for primal-weight updates)
+    y_restart: jnp.ndarray
+    err_restart: jnp.ndarray  # KKT error at the last restart point
+    omega: jnp.ndarray  # primal weight
+    it_cycle: jnp.ndarray
+
+
+def _estimate_norm(matvec, rmatvec, n, dtype, iters: int = 30, seed: int = 0):
+    """Power iteration for ‖A‖₂ (σ_max) — sets the PDHG step size."""
+    v = jax.random.normal(jax.random.PRNGKey(seed), (n,), dtype=dtype)
+    v = v / jnp.linalg.norm(v)
+
+    def body(_, v):
+        w = rmatvec(matvec(v))
+        return w / jnp.maximum(jnp.linalg.norm(w), 1e-30)
+
+    v = jax.lax.fori_loop(0, iters, body, v)
+    return jnp.sqrt(jnp.linalg.norm(rmatvec(matvec(v))))
+
+
+def _kkt_error(matvec, rmatvec, data, x, y):
+    """(pinf, dinf, gap_rel, pobj, dobj) of an (x, y) pair.
+
+    Reduced costs split by bound structure: r = c − Aᵀy; on finite-u
+    columns a negative r is priced by the upper bound (contributes r·u to
+    the dual objective); on unbounded columns a negative r is dual
+    infeasibility.
+    """
+    c, b, u_f, hub = data.c, data.b, data.u_f, data.hub
+    r_p = b - matvec(x)
+    r = c - rmatvec(y)
+    r_neg = jnp.minimum(r, 0.0)
+    dinf_vec = jnp.where(hub > 0, 0.0, r_neg)  # unbounded cols: r must be ≥ 0
+    pinf = jnp.linalg.norm(r_p) / data.norm_b
+    dinf = jnp.linalg.norm(dinf_vec) / data.norm_c
+    pobj = c @ x
+    dobj = b @ y + (hub * u_f) @ r_neg
+    gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+    return pinf, dinf, gap, pobj, dobj
+
+
+def _err_of(matvec, rmatvec, data, x, y):
+    pinf, dinf, gap, _, _ = _kkt_error(matvec, rmatvec, data, x, y)
+    return jnp.maximum(pinf, jnp.maximum(dinf, gap))
+
+
+@functools.partial(jax.jit, static_argnames=("check_every", "restart_len"))
+def _pdhg_solve(
+    A, AT, data, x0, y0, eta, omega0, max_iter, tol,
+    check_every=40, restart_len=2000, restart_beta=0.5,
+):
+    """Fused restarted-PDHG loop. ``A``/``AT`` are dense arrays or BCOO
+    pytrees — both trace as ordinary jit operands, so one compiled program
+    serves every problem of the same shape/sparsity pattern."""
+    matvec = lambda v: A @ v
+    rmatvec = lambda v: AT @ v
+    dtype = x0.dtype
+    u = jnp.where(data.hub > 0, data.u_f, jnp.inf)
+
+    def one_pdhg(x, y, omega):
+        tau = eta / omega
+        sigma = eta * omega
+        x_new = jnp.clip(x - tau * (data.c - rmatvec(y)), 0.0, u)
+        y_new = y + sigma * (data.b - matvec(2.0 * x_new - x))
+        return x_new, y_new
+
+    st0 = PDHGState(
+        x=x0, y=y0,
+        x_sum=jnp.zeros_like(x0), y_sum=jnp.zeros_like(y0),
+        n_avg=jnp.asarray(0.0, dtype),
+        x_restart=x0, y_restart=y0,
+        err_restart=_err_of(matvec, rmatvec, data, x0, y0),
+        omega=jnp.asarray(omega0, dtype),
+        it_cycle=jnp.asarray(0, jnp.int32),
+    )
+
+    def cond(carry):
+        st, it, err = carry
+        return (it < max_iter) & (err > tol)
+
+    def body(carry):
+        st, it, _ = carry
+
+        # `check_every` inner PDHG steps, fully fused.
+        def inner(_, xy):
+            x, y = xy
+            return one_pdhg(x, y, st.omega)
+
+        x, y = jax.lax.fori_loop(0, check_every, inner, (st.x, st.y))
+        x_sum = st.x_sum + x * check_every  # cheap running average proxy
+        y_sum = st.y_sum + y * check_every
+        n_avg = st.n_avg + check_every
+        x_avg = x_sum / n_avg
+        y_avg = y_sum / n_avg
+
+        err_cur = _err_of(matvec, rmatvec, data, x, y)
+        err_avg = _err_of(matvec, rmatvec, data, x_avg, y_avg)
+        it_cycle = st.it_cycle + check_every
+
+        # Restart candidate: whichever of (current, average) is better.
+        use_avg = err_avg < err_cur
+        x_cand = jnp.where(use_avg, x_avg, x)
+        y_cand = jnp.where(use_avg, y_avg, y)
+        err_cand = jnp.minimum(err_avg, err_cur)
+        do_restart = (err_cand <= restart_beta * st.err_restart) | (
+            it_cycle >= restart_len
+        )
+
+        # Primal-weight update at restarts (PDLP rule: ratio of movements).
+        dx = jnp.linalg.norm(x_cand - st.x_restart)
+        dy = jnp.linalg.norm(y_cand - st.y_restart)
+        omega_new = jnp.where(
+            (dx > 1e-30) & (dy > 1e-30),
+            jnp.exp(0.5 * jnp.log(st.omega) + 0.5 * jnp.log(dy / dx)),
+            st.omega,
+        )
+
+        st_restart = PDHGState(
+            x=x_cand, y=y_cand,
+            x_sum=jnp.zeros_like(x), y_sum=jnp.zeros_like(y),
+            n_avg=jnp.asarray(0.0, dtype),
+            x_restart=x_cand, y_restart=y_cand,
+            err_restart=err_cand,
+            omega=omega_new,
+            it_cycle=jnp.asarray(0, jnp.int32),
+        )
+        st_cont = st._replace(
+            x=x, y=y, x_sum=x_sum, y_sum=y_sum, n_avg=n_avg, it_cycle=it_cycle
+        )
+        st_new = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(do_restart, a, b), st_restart, st_cont
+        )
+        best_err = jnp.minimum(err_cand, err_cur)
+        return st_new, it + check_every, best_err
+
+    st, it, err = jax.lax.while_loop(
+        cond, body, (st0, jnp.asarray(0, jnp.int32), st0.err_restart)
+    )
+    # Report the better of (last, average-of-cycle).
+    has_avg = st.n_avg > 0
+    x_avg = jnp.where(has_avg, st.x_sum / jnp.maximum(st.n_avg, 1.0), st.x)
+    y_avg = jnp.where(has_avg, st.y_sum / jnp.maximum(st.n_avg, 1.0), st.y)
+    err_avg = _err_of(matvec, rmatvec, data, x_avg, y_avg)
+    err_cur = _err_of(matvec, rmatvec, data, st.x, st.y)
+    use_avg = err_avg < err_cur
+    x_fin = jnp.where(use_avg, x_avg, st.x)
+    y_fin = jnp.where(use_avg, y_avg, st.y)
+    return x_fin, y_fin, it, jnp.minimum(err_avg, err_cur)
+
+
+@register_backend("pdlp", "first-order", "pdhg")
+class FirstOrderBackend(SolverBackend):
+    """Restarted-PDHG execution backend (matrix-free; huge-sparse class).
+
+    Plugs into the same driver/CLI surface as every other backend; the
+    IPM-shaped ``iterate`` contract is satisfied by running a bounded
+    number of PDHG sweeps per call and reporting KKT stats.
+    """
+
+    def __init__(self):
+        self._sparse = False
+
+    def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
+        self._cfg = config
+        dtype = jnp.dtype(config.dtype)
+        self._dtype = dtype
+        A = inf.A
+        self._sparse = sp.issparse(A)
+        if self._sparse:
+            from jax.experimental import sparse as jsparse
+
+            Ac = sp.coo_matrix(A)
+            self._A = jsparse.BCOO(
+                (jnp.asarray(Ac.data, dtype=dtype),
+                 jnp.asarray(np.stack([Ac.row, Ac.col], axis=1))),
+                shape=Ac.shape,
+            )
+            AT = Ac.T.tocoo()
+            self._AT = jsparse.BCOO(
+                (jnp.asarray(AT.data, dtype=dtype),
+                 jnp.asarray(np.stack([AT.row, AT.col], axis=1))),
+                shape=AT.shape,
+            )
+        else:
+            self._A = jnp.asarray(np.asarray(A), dtype=dtype)
+            self._AT = self._A.T
+        self._data = core.make_problem_data(
+            jnp,
+            jnp.asarray(np.asarray(inf.c), dtype=dtype),
+            jnp.asarray(np.asarray(inf.b), dtype=dtype),
+            jnp.asarray(np.asarray(inf.u), dtype=dtype),
+            dtype,
+        )
+        A_, AT_ = self._A, self._AT
+        self._matvec = lambda v: A_ @ v
+        self._rmatvec = lambda v: AT_ @ v
+        nrm = _estimate_norm(self._matvec, self._rmatvec, inf.n, dtype)
+        self._eta = float(0.9 / max(float(nrm), 1e-12))
+        self._it_done = 0
+
+    def starting_point(self) -> IPMState:
+        n = self._data.c.shape[0]
+        m = self._data.b.shape[0]
+        x = jnp.zeros(n, dtype=self._dtype)
+        y = jnp.zeros(m, dtype=self._dtype)
+        return self._wrap(x, y)
+
+    def _wrap(self, x, y) -> IPMState:
+        # Carry (x, y) through the IPMState container; s/w/z are derived
+        # quantities for PDHG and reported as reduced costs at the end.
+        r = self._data.c - self._rmatvec(y)
+        s = jnp.maximum(r, 0.0)
+        z = jnp.maximum(-r, 0.0) * (self._data.hub > 0)
+        w = jnp.where(self._data.hub > 0, self._data.u_f - x, 1.0)
+        return IPMState(x=x, y=y, s=s, w=w, z=z)
+
+    def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
+        # One driver "iteration" = a bounded PDHG burst; stats are true KKT
+        # measures so the host convergence test stays meaningful.
+        x, y, it, err = _pdhg_solve(
+            self._A, self._AT, self._data,
+            state.x, state.y,
+            jnp.asarray(self._eta, self._dtype),
+            jnp.asarray(1.0, self._dtype),
+            jnp.asarray(400, jnp.int32),
+            jnp.asarray(self._cfg.tol, self._dtype),
+        )
+        pinf, dinf, gap, pobj, dobj = _kkt_error(
+            self._matvec, self._rmatvec, self._data, x, y
+        )
+        zero = jnp.asarray(0.0, self._dtype)
+        stats = StepStats(
+            mu=gap, gap=jnp.abs(pobj - dobj), rel_gap=gap, pinf=pinf,
+            dinf=dinf, pobj=pobj, dobj=dobj, alpha_p=zero, alpha_d=zero,
+            sigma=zero, bad=~jnp.isfinite(gap),
+        )
+        return self._wrap(x, y), stats
+
+    def bump_regularization(self) -> bool:
+        return False  # nothing to regularize
+
+    def solve_full(self, state: IPMState):
+        cfg = self._cfg
+        # PDHG counts iterations in the thousands; interpret the config's
+        # (IPM-scaled) max_iter as bursts of 400 inner steps.
+        max_inner = jnp.asarray(cfg.max_iter * 400, jnp.int32)
+        x, y, it, err = _pdhg_solve(
+            self._A, self._AT, self._data,
+            state.x, state.y,
+            jnp.asarray(self._eta, self._dtype),
+            jnp.asarray(1.0, self._dtype),
+            max_inner,
+            jnp.asarray(cfg.tol, self._dtype),
+        )
+        pinf, dinf, gap, pobj, dobj = _kkt_error(
+            self._matvec, self._rmatvec, self._data, x, y
+        )
+        ok = (gap <= cfg.tol) & (pinf <= cfg.tol) & (dinf <= cfg.tol)
+        status = jnp.where(ok, core.STATUS_OPTIMAL, core.STATUS_MAXITER)
+        zero = jnp.asarray(0.0, self._dtype)
+        row = jnp.stack(
+            [gap, jnp.abs(pobj - dobj), gap, pinf, dinf, pobj, dobj,
+             zero, zero, zero]
+        )
+        # One summary stats record, but the REAL inner-iteration count —
+        # the driver reports iterations from it (and caps the history read
+        # at the buffer's length), so iters/sec reflects actual PDHG work.
+        buf = row[None, :]
+        return self._wrap(x, y), it, status, buf
+
+    def to_host(self, state: IPMState) -> IPMState:
+        return IPMState(*(np.asarray(v) for v in state))
+
+    def from_host(self, state: IPMState) -> IPMState:
+        return IPMState(*(jnp.asarray(np.asarray(v), dtype=self._dtype) for v in state))
+
+    def block_until_ready(self, obj) -> None:
+        jax.block_until_ready(obj)

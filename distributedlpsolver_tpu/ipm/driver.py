@@ -115,11 +115,11 @@ def solve(
     if use_fused:
         fused = _try_fused(be, state, cfg, logger)
         if fused is not None:
-            state, status, history, last, solve_time = fused
+            state, status, history, last, solve_time, fused_iters = fused
             return _finalize(
                 be, state, status, history, last, solve_time, setup_time,
                 inf, original, backend, start_iter, scaling=scaling,
-                presolve_info=presolve_info,
+                presolve_info=presolve_info, extra_iters=fused_iters,
             )
 
     status = Status.ITERATION_LIMIT
@@ -210,7 +210,9 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
     solve_time = time.perf_counter() - t0
 
     iters = int(np.asarray(it_dev))
-    buf = np.asarray(buf)[:iters]
+    # Backends may report more iterations than stats records (the PDHG
+    # backend returns one summary row for thousands of inner steps).
+    buf = np.asarray(buf)[: min(iters, len(np.asarray(buf)))]
     status = {
         core.STATUS_OPTIMAL: Status.OPTIMAL,
         core.STATUS_MAXITER: Status.ITERATION_LIMIT,
@@ -222,13 +224,13 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
 
     t_avg = solve_time / max(iters, 1)
     history, last = [], None
-    for i in range(iters):
+    for i in range(len(buf)):
         last = dict(zip(_STAT_FIELDS, (float(v) for v in buf[i])))
         rec = IterRecord(iter=i + 1, t_iter=t_avg, **last)
         history.append(rec)
         logger.log(rec)
     logger.close()
-    return state, status, history, last, solve_time
+    return state, status, history, last, solve_time, iters
 
 
 def _finalize(

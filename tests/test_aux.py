@@ -134,3 +134,25 @@ def test_jsonl_iteration_log(tmp_path):
         assert key in records[0]
     # the trajectory the metric surface promises: gap decreases to tol
     assert records[-1]["rel_gap"] <= 1e-8
+
+
+def test_compile_cache_configured_by_default():
+    # Package import points the persistent XLA compilation cache somewhere
+    # (the emulated-f64 batched programs take minutes to compile, ~1 s to
+    # run — caching makes every later process start warm). Environments
+    # that opt out or pre-configure their own dir are respected, so only
+    # the default case is asserted.
+    import os
+
+    import jax
+
+    import distributedlpsolver_tpu  # noqa: F401
+
+    if os.environ.get("TPULP_NO_COMPILE_CACHE"):
+        pytest.skip("cache explicitly disabled in this environment")
+    d = jax.config.jax_compilation_cache_dir
+    custom = os.environ.get("TPULP_COMPILE_CACHE")
+    if custom:
+        assert d == custom
+    else:
+        assert d  # configured to SOME persistent location
