@@ -118,31 +118,12 @@ def analyze_structure(inf: InteriorForm) -> Tuple[BlockLayout, dict]:
     mb = int(sizes.max()) if K else 0
     link = int((row_block == -1).sum())
 
+    from distributedlpsolver_tpu.models.structure import column_block_ids
+
     A = sp.csc_matrix(inf.A) if sp.issparse(inf.A) else sp.csc_matrix(np.asarray(inf.A))
-    # Column → block via segment reductions over the CSC layout (no Python
-    # per-column loop — Mittelmann-scale problems have ~10^6 columns). A
-    # column is valid when every non-linking row it touches carries the
-    # same block id; min == max over the segment checks that in one pass.
-    block_of_col = np.full(n, -1, dtype=np.int64)  # -1 = border, k = block
-    rb_vals = row_block[A.indices]
-    nnz_col = np.diff(A.indptr)
-    nz = np.flatnonzero(nnz_col > 0)
-    if len(nz):
-        big = np.iinfo(np.int64).max
-        vmax = np.maximum.reduceat(
-            np.where(rb_vals >= 0, rb_vals, -1), A.indptr[nz]
-        )
-        vmin = np.minimum.reduceat(
-            np.where(rb_vals >= 0, rb_vals, big), A.indptr[nz]
-        )
-        spans = (vmax >= 0) & (vmin != vmax)
-        if spans.any():
-            k = int(np.argmax(spans))
-            raise ValueError(
-                f"column {int(nz[k])} spans blocks "
-                f"[{int(vmin[k])}, {int(vmax[k])}] — not block-angular"
-            )
-        block_of_col[nz] = vmax  # border columns reduce to -1
+    # Column → block via shared segment reductions (models/structure.py);
+    # validation rejects columns whose non-linking rows disagree.
+    block_of_col = column_block_ids(A, row_block, validate=True)
 
     counts = np.bincount(block_of_col[block_of_col >= 0], minlength=K)
     nb = int(counts.max()) if K else 0

@@ -148,6 +148,43 @@ def detect_block_structure(
     return {"num_blocks": int(best["num_blocks"]), "row_block": best["row_block"]}
 
 
+def column_block_ids(
+    A_csc: sp.csc_matrix, row_block: np.ndarray, validate: bool = False
+) -> np.ndarray:
+    """Per-column block id from the CSC pattern: the block of the column's
+    non-linking rows (-1 for border columns touched only by linking rows).
+
+    Segment reductions over ``indptr`` — no per-column Python loop. With
+    ``validate``, a column whose non-linking rows disagree on the block
+    (min != max over the segment) raises — it breaks the arrow structure.
+    Shared by the block backend's layout analysis and the tensor-footprint
+    estimator, so the two can never diverge.
+    """
+    n = A_csc.shape[1]
+    rb_vals = row_block[A_csc.indices]
+    nnz_col = np.diff(A_csc.indptr)
+    nz = np.flatnonzero(nnz_col > 0)
+    block_of_col = np.full(n, -1, dtype=np.int64)
+    if len(nz):
+        vmax = np.maximum.reduceat(
+            np.where(rb_vals >= 0, rb_vals, -1), A_csc.indptr[nz]
+        )
+        if validate:
+            big = np.iinfo(np.int64).max
+            vmin = np.minimum.reduceat(
+                np.where(rb_vals >= 0, rb_vals, big), A_csc.indptr[nz]
+            )
+            spans = (vmax >= 0) & (vmin != vmax)
+            if spans.any():
+                k = int(np.argmax(spans))
+                raise ValueError(
+                    f"column {int(nz[k])} spans blocks "
+                    f"[{int(vmin[k])}, {int(vmax[k])}] — not block-angular"
+                )
+        block_of_col[nz] = vmax  # border columns reduce to -1
+    return block_of_col
+
+
 def estimate_block_tensor_entries(A, hint: dict) -> int:
     """Dense entries the block backend's stacked tensors would hold for
     ``hint`` — B_all (K·mb·nb) + L_all (K·link·nb) + A0 (link·n0). Used by
@@ -156,19 +193,10 @@ def estimate_block_tensor_entries(A, hint: dict) -> int:
     rb = np.asarray(hint["row_block"], dtype=np.int64)
     K = int(hint["num_blocks"])
     Ac = sp.csc_matrix(A)
-    n = Ac.shape[1]
     sizes = np.bincount(rb[rb >= 0], minlength=K)
     mb = int(sizes.max()) if K else 0
     link = int((rb == -1).sum())
-    # Block of each column = max block id over its rows (block-angular
-    # validity means all non-linking rows of a column agree; border
-    # columns — linking rows only — reduce to -1).
-    blk = rb[Ac.indices]
-    nnz_col = np.diff(Ac.indptr)
-    nz = np.flatnonzero(nnz_col > 0)
-    colmax = np.full(n, -1, dtype=np.int64)
-    if len(nz):
-        colmax[nz] = np.maximum.reduceat(blk, Ac.indptr[nz])
+    colmax = column_block_ids(Ac, rb)
     counts = np.bincount(colmax[colmax >= 0], minlength=K)
     nb = int(counts.max()) if K else 0
     n0 = int((colmax == -1).sum())

@@ -104,3 +104,51 @@ def test_scaling_invariance(seed, scale):
     np.testing.assert_allclose(
         rs.objective, r.objective * scale, rtol=1e-6, atol=1e-6 * max(1.0, scale)
     )
+
+
+@settings(**_SETTINGS)
+@given(
+    m=st.integers(4, 12),
+    extra=st.integers(3, 10),
+    seed=st.integers(0, 2**20),
+    n_fix=st.integers(0, 3),
+    n_sing=st.integers(0, 3),
+)
+def test_presolve_preserves_optimum(m, extra, seed, n_fix, n_sing):
+    """Presolve must never change the optimal value, and its dual
+    postsolve must satisfy c = Aᵀy + s with a finite strong-duality bound
+    — on problems salted with the structures presolve removes."""
+    rng = np.random.default_rng(seed)
+    p = random_general_lp(m, m + extra, seed=seed)
+    A = np.asarray(p.A).copy()
+    lb, ub = p.lb.copy(), p.ub.copy()
+    n = p.n
+    for j in rng.choice(n, size=min(n_fix, n), replace=False):
+        v = rng.uniform(0.1, 1.0)
+        lb[j] = ub[j] = v
+    rows, rlbs, rubs = [A], [p.rlb], [p.rub]
+    for _ in range(n_sing):
+        j = int(rng.integers(0, n))
+        row = np.zeros(n)
+        row[j] = rng.choice([-2.0, 1.0, 3.0])
+        rows.append(row[None, :])
+        rlbs.append([-5.0])
+        rubs.append([5.0])
+    from distributedlpsolver_tpu.models.problem import LPProblem
+
+    q = LPProblem(
+        c=p.c, A=np.vstack(rows), rlb=np.concatenate(rlbs),
+        rub=np.concatenate(rubs), lb=np.minimum(lb, ub), ub=ub,
+    )
+    ref = highs_on_general(q)
+    r_on = solve(q, backend="cpu")
+    if ref.status != 0:
+        assert r_on.status != Status.OPTIMAL or abs(
+            r_on.objective - (ref.fun if ref.fun is not None else np.inf)
+        ) < 1e-4
+        return
+    assert r_on.status == Status.OPTIMAL
+    assert abs(r_on.objective - ref.fun) < 1e-5 * (1 + abs(ref.fun))
+    assert q.max_violation(r_on.x) < 1e-6
+    resid = q.c - np.asarray(q.A.T @ r_on.y).ravel() - r_on.s
+    assert np.max(np.abs(resid)) < 1e-7 * (1 + np.max(np.abs(q.c)))

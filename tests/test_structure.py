@@ -175,3 +175,26 @@ class TestAutoDetectRouting:
         )
         name = choose_backend_name(inf, "tpu", detect=True)
         assert name == "cpu-sparse"
+
+
+class TestTensorEstimate:
+    def test_matches_actual_build(self):
+        from distributedlpsolver_tpu.backends.block_angular import build_tensors
+        from distributedlpsolver_tpu.models.problem import to_interior_form
+        from distributedlpsolver_tpu.models.structure import (
+            estimate_block_tensor_entries,
+        )
+
+        p = block_angular_lp(3, 10, 16, 5, seed=4, sparse=True)
+        inf = to_interior_form(p)
+        hint = detect_block_structure(inf.A)
+        assert hint is not None
+        est = estimate_block_tensor_entries(inf.A, hint)
+        import dataclasses
+
+        inf = dataclasses.replace(inf, block_structure=hint)
+        import jax.numpy as jnp
+
+        tensors, lay = build_tensors(inf, jnp.float64)
+        actual = tensors.B_all.size + tensors.L_all.size + tensors.A0.size
+        assert est == actual
