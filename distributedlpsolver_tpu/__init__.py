@@ -54,15 +54,14 @@ if not os.environ.get("TPULP_NO_COMPILE_CACHE"):
             ),
             "tpulp_xla_cache",
         )
-    _explicit = os.environ.get("TPULP_COMPILE_CACHE")
-    if _explicit:
-        # An explicit TPULP_COMPILE_CACHE always wins — even over a dir
-        # JAX already picked up from JAX_COMPILATION_CACHE_DIR.
-        jax.config.update("jax_compilation_cache_dir", _explicit)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    elif not jax.config.jax_compilation_cache_dir:
-        jax.config.update("jax_compilation_cache_dir", _default_cache)
+    # An explicit TPULP_COMPILE_CACHE always wins — even over a dir JAX
+    # already picked up from JAX_COMPILATION_CACHE_DIR; otherwise only
+    # fill in the default when nothing is configured.
+    _cache_dir = os.environ.get("TPULP_COMPILE_CACHE") or (
+        None if jax.config.jax_compilation_cache_dir else _default_cache
+    )
+    if _cache_dir:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 

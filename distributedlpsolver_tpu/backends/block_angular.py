@@ -253,10 +253,98 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
     )
 
 
+def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout, reg):
+    """Phase-1 LinOps: residual matvecs in full precision against the f64
+    tensors, factorizations/solves through the f32 tensor stack on the MXU
+    (the dense backend's two-phase split, restated for the arrow
+    structure). Solutions cast back up so the Mehrotra step's state stays
+    f64."""
+    base = _block_ops(t64, lay, reg, None)
+    f32 = jnp.float32
+    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None)
+
+    def factorize(d):
+        return ops32.factorize(d.astype(f32))
+
+    def solve(factors, r):
+        return ops32.solve(factors, r.astype(f32)).astype(r.dtype)
+
+    return core.LinOps(
+        xp=jnp,
+        matvec=base.matvec,
+        rmatvec=base.rmatvec,
+        factorize=factorize,
+        solve=solve,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("lay", "params"))
 def _block_step(tensors, lay, data, state, reg, params):
     ops = _block_ops(tensors, lay, reg, None)
     return core.mehrotra_step(ops, data, params, state)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lay", "params", "buf_cap", "stall_window", "patience", "mixed"),
+)
+def _block_segment(
+    tensors, tensors32, lay, data, carry, it_stop, max_iter, max_refactor,
+    reg_grow, params, buf_cap, stall_window=0, patience=0.0, mixed=False,
+):
+    """One bounded continuation of the fused Schur loop (host segmentation
+    against the device execution watchdog — see core.drive_segments and
+    dense._dense_segment). ``mixed`` selects the f32-factorization phase-1
+    ops; ``tensors32`` may be None when not mixed."""
+
+    def step(state, reg):
+        ops = (
+            _block_ops_mixed(tensors, tensors32, lay, reg)
+            if mixed
+            else _block_ops(tensors, lay, reg, None)
+        )
+        return core.mehrotra_step(ops, data, params, state)
+
+    out = core.fused_solve(
+        step, None, None, params, max_iter, max_refactor, reg_grow, buf_cap,
+        stall_window=stall_window, stall_patience_floor=patience,
+        resume=carry, it_stop=it_stop, return_carry=True,
+    )
+    return out, core.pack_segment_meta(out)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("lay", "params", "params_p1", "buf_cap", "stall_window")
+)
+def _block_solve_two_phase(
+    tensors, tensors32, lay, data, state0, reg0, params, params_p1,
+    max_iter, max_refactor, reg_grow, buf_cap, stall_window,
+):
+    """Mixed-precision fused Schur solve: f32 per-block factorizations and
+    linking-system Cholesky down to the handoff tolerance, then f64
+    warm-started to full tolerance — one compiled program, shared stats
+    buffer and global iteration count (mirrors dense._dense_solve_two_phase,
+    including the provisional-verdict reset at the phase boundary)."""
+
+    def step32(state, reg):
+        ops = _block_ops_mixed(tensors, tensors32, lay, reg)
+        return core.mehrotra_step(ops, data, params_p1, state)
+
+    def step64(state, reg):
+        ops = _block_ops(tensors, lay, reg, None)
+        return core.mehrotra_step(ops, data, params, state)
+
+    st1, it1, status1, buf = core.fused_solve(
+        step32, state0, reg0, params_p1, max_iter, max_refactor, reg_grow,
+        buf_cap, stall_window=stall_window, finalize=False,
+    )
+    status1 = jnp.full_like(status1, core.STATUS_RUNNING)
+    return core.fused_solve(
+        step64, st1, reg0, params, it1 + max_iter, max_refactor, reg_grow,
+        buf_cap, stall_window=2 * stall_window if stall_window else 0,
+        stall_patience_floor=1e3 * params.tol,
+        carry_in=(it1, status1, buf), finalize=True,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("lay", "params"))
@@ -322,6 +410,14 @@ class BlockAngularBackend(SolverBackend):
 
         self._tensors, self._lay = build_tensors(inf, dtype, shard_put)
         self._data = core.make_problem_data(jnp, inf.c, inf.b, inf.u, dtype)
+        # Two-phase (f32→f64) schedule: "auto" factor dtype on TPU, exactly
+        # as the dense backend — phase 1 runs every per-block factorization
+        # and the linking Cholesky in f32 on the MXU. The f32 tensor stack
+        # (shares the integer index maps) is materialized lazily on first
+        # solve_full use: the per-iteration iterate() path is pure f64 and
+        # must not pay the +50% HBM for a copy it never reads.
+        self._two_phase = config.two_phase_enabled(jax.default_backend())
+        self._tensors32 = None
 
     def starting_point(self) -> IPMState:
         st = _block_start(
@@ -343,7 +439,94 @@ class BlockAngularBackend(SolverBackend):
         self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
         return True
 
+    def _get_tensors32(self) -> BlockTensors:
+        if self._tensors32 is None:
+            f32 = jnp.float32
+            self._tensors32 = self._tensors._replace(
+                B_all=self._tensors.B_all.astype(f32),
+                L_all=self._tensors.L_all.astype(f32),
+                A0=self._tensors.A0.astype(f32),
+            )
+        return self._tensors32
+
+    def _segment_iters(self) -> int:
+        seg = self._cfg.segment_iters
+        if seg is None:
+            seg = 8 if jax.default_backend() == "tpu" else 0
+        return seg
+
+    def _solve_segmented(self, state: IPMState, seg: int):
+        """Host-driven segmented fused Schur solve: per-phase specs feed
+        the shared driver (core.drive_phase_plan) — same termination
+        semantics as the dense backend by construction."""
+        cfg = self._cfg
+        dtype = self._dtype
+        n_phases = 2 if self._two_phase else 1
+        buf_cap = core.buffer_cap(n_phases * cfg.max_iter)
+        mr = jnp.asarray(cfg.max_refactor, jnp.int32)
+        rg = jnp.asarray(cfg.reg_grow, dtype)
+        K, mb, nb, link, n0, n, m = self._lay
+        # Per-iteration FLOP estimate: per-block normal equations and
+        # Cholesky plus the linking-system dense work.
+        flops = K * (2.0 * mb * mb * nb + mb**3 / 3.0) + (
+            2.0 * link * link * (K * nb + n0) + link**3 / 3.0
+        )
+        w = cfg.stall_window
+        patience = 1e3 * cfg.tol
+        if self._two_phase:
+            plan = [
+                (cfg.phase1_params(), True, self._get_tensors32(), w, 0.0),
+                (self._params, False, None, 2 * w if w else 0, patience),
+            ]
+        else:
+            plan = [(self._params, False, None, 2 * w if w else 0, patience)]
+
+        def make_phase(spec):
+            params, mixed, t32, window, patience_now = spec
+            rate = 2e12 if mixed else 2.5e11  # conservative
+
+            def make_run_seg(bound):
+                mi = jnp.asarray(bound, jnp.int32)
+
+                def run_seg(c, stop):
+                    return _block_segment(
+                        self._tensors, t32, self._lay, self._data, c,
+                        jnp.asarray(stop, jnp.int32), mi, mr, rg, params,
+                        buf_cap, window, patience_now, mixed,
+                    )
+
+                return run_seg
+
+            return (
+                make_run_seg, window, patience_now,
+                core.seg_open(cfg.segment_iters, flops / rate),
+            )
+
+        return core.drive_phase_plan(
+            [make_phase(s) for s in plan],
+            state, jnp.asarray(self._reg, dtype), cfg.max_iter, buf_cap, dtype,
+        )
+
     def solve_full(self, state: IPMState):
+        seg = self._segment_iters()
+        if seg:
+            return self._solve_segmented(state, seg)
+        if self._two_phase:
+            return _block_solve_two_phase(
+                self._tensors,
+                self._get_tensors32(),
+                self._lay,
+                self._data,
+                state,
+                jnp.asarray(self._reg, self._dtype),
+                self._params,
+                self._cfg.phase1_params(),
+                jnp.asarray(self._cfg.max_iter, jnp.int32),
+                jnp.asarray(self._cfg.max_refactor, jnp.int32),
+                jnp.asarray(self._cfg.reg_grow, self._dtype),
+                core.buffer_cap(2 * self._cfg.max_iter),
+                self._cfg.stall_window,
+            )
         return _block_solve_full(
             self._tensors,
             self._lay,

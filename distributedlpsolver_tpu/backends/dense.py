@@ -256,20 +256,6 @@ def _dense_segment(
     return out, core.pack_segment_meta(out)
 
 
-@jax.jit
-def _phase_reset(carry, reg0):
-    """Device-side phase-boundary reset (one dispatch — building the new
-    carry from eager host scalars costs ~8 tiny transfers per phase):
-    keep state/iteration count/stats buffer, reset everything provisional."""
-    st, it, _, _, _, buf, _, _ = carry
-    z = jnp.asarray(0, jnp.int32)
-    return (
-        st, it, reg0, z,
-        jnp.asarray(core.STATUS_RUNNING, jnp.int32), buf,
-        jnp.asarray(jnp.inf, buf.dtype), z,
-    )
-
-
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -504,8 +490,9 @@ class DenseJaxBackend(SolverBackend):
         return seg
 
     def _solve_segmented(self, state: IPMState, seg: int):
-        """Host-driven segmented fused solve (core.drive_segments): bounds
-        single device-program runtime under execution watchdogs."""
+        """Host-driven segmented fused solve: per-phase specs feed the
+        shared driver (core.drive_phase_plan), which bounds single
+        device-program runtime under execution watchdogs."""
         cfg = self._cfg
         dtype = self._dtype
         # Each phase gets its own max_iter budget (matching the batched
@@ -515,71 +502,34 @@ class DenseJaxBackend(SolverBackend):
         buf_cap = core.buffer_cap(n_phases * cfg.max_iter)
         mr = jnp.asarray(cfg.max_refactor, jnp.int32)
         rg = jnp.asarray(cfg.reg_grow, dtype)
-
-        def fresh_carry(st, it, buf):
-            return (
-                st,
-                jnp.asarray(it, jnp.int32),
-                jnp.asarray(self._reg, dtype),
-                jnp.asarray(0, jnp.int32),
-                jnp.asarray(core.STATUS_RUNNING, jnp.int32),
-                buf if buf is not None
-                else jnp.zeros((buf_cap, core.N_STAT), dtype),
-                jnp.asarray(jnp.inf, dtype),
-                jnp.asarray(0, jnp.int32),
-            )
-
         m, n = self._A.shape
+        flops = 2.0 * m * m * n + m**3 / 3.0  # per-iteration FLOP estimate
 
-        def seg_init_for(fdt_name: str, target_s: float = 15.0) -> int:
-            # Seed the first segments from a FLOP estimate so a big
-            # problem's opening segment can't blow the execution watchdog
-            # before the measured-rate adaptation kicks in (a 10k×50k f64
-            # iteration is tens of seconds on emulated f64). Rates are
-            # deliberately conservative.
-            flops = 2.0 * m * m * n + m**3 / 3.0
-            rate = 2e12 if fdt_name == "float32" else 2.5e11
-            est = flops / rate
-            return max(1, min(seg, int(target_s / max(est, 1e-3))))
+        def make_phase(spec):
+            params, fdt, refine, pallas, Af, window, patience = spec
+            rate = 2e12 if fdt == "float32" else 2.5e11  # conservative
 
-        plan = self._phase_plan()
-        carry = fresh_carry(state, 0, None)
-        reg0 = jnp.asarray(self._reg, dtype)
-        window, patience, bound = 0, 0.0, cfg.max_iter
-        it, status, best, since = 0, core.STATUS_RUNNING, float("inf"), 0
-        for pi, (params, fdt, refine, pallas, Af, window, patience) in enumerate(plan):
-            bound = it + cfg.max_iter  # phase-local budget
-            mi = jnp.asarray(bound, jnp.int32)
+            def make_run_seg(bound):
+                mi = jnp.asarray(bound, jnp.int32)
 
-            def run_seg(c, stop, _a=(params, fdt, refine, pallas, Af, window, patience, mi)):
-                p, f, r, up, af, w, pat, m = _a
-                return _dense_segment(
-                    self._A, self._data, c, jnp.asarray(stop, jnp.int32),
-                    m, mr, rg, p, f, r, buf_cap, up, af, w, pat,
-                )
+                def run_seg(c, stop):
+                    return _dense_segment(
+                        self._A, self._data, c, jnp.asarray(stop, jnp.int32),
+                        mi, mr, rg, params, fdt, refine, buf_cap, pallas, Af,
+                        window, patience,
+                    )
 
-            carry, (it, status, best, since) = core.drive_segments(
-                run_seg, carry, bound, window, seg_init_for(fdt),
-                stall_patience_floor=patience, it0_status0=(it, status),
+                return run_seg
+
+            return (
+                make_run_seg, window, patience,
+                core.seg_open(cfg.segment_iters, flops / rate),
             )
-            if pi < len(plan) - 1:
-                # Phase boundary: every phase-1 verdict is provisional (see
-                # _dense_solve_two_phase) — reset to RUNNING, keep
-                # state/iteration count/stats buffer.
-                carry = _phase_reset(carry, reg0)
-                status = core.STATUS_RUNNING
 
-        st = carry[0]
-        buf = carry[5]
-        if status == core.STATUS_RUNNING:
-            stalled = (
-                window
-                and since > window
-                and it < bound
-                and (not patience or best > patience)
-            )
-            status = core.STATUS_STALL if stalled else core.STATUS_MAXITER
-        return st, it, jnp.asarray(status, jnp.int32), buf
+        return core.drive_phase_plan(
+            [make_phase(s) for s in self._phase_plan()],
+            state, jnp.asarray(self._reg, dtype), cfg.max_iter, buf_cap, dtype,
+        )
 
     def solve_full(self, state: IPMState):
         seg = self._segment_iters()

@@ -229,18 +229,25 @@ class FirstOrderBackend(SolverBackend):
         self._dtype = dtype
         self._n_pad = 0
         self._col_sharding = None
-        if self._mesh is None and config.mesh_shape is not None:
+        A = inf.A
+        mesh_explicit = self._mesh is not None
+        if self._mesh is None and config.mesh_shape is not None and not sp.issparse(A):
+            # A config-supplied mesh applies to dense operands only —
+            # sparse inputs keep the single-device BCOO path (this
+            # backend's whole purpose is huge sparse; a shared
+            # config.mesh_shape must not hijack it).
             from distributedlpsolver_tpu.parallel import make_mesh
 
             self._mesh = make_mesh(shape=config.mesh_shape)
-        A = inf.A
         if self._mesh is not None and sp.issparse(A):
-            # BCOO sharding is not wired up; densify small sparse inputs
-            # under an explicit mesh, refuse huge ones.
+            # Only an EXPLICITLY passed mesh reaches here: densify small
+            # sparse inputs, refuse ones where densification is the hazard.
+            assert mesh_explicit
             if A.shape[0] * A.shape[1] > (1 << 26):
                 raise ValueError(
                     "mesh-sharded pdlp supports dense operands; sparse input "
-                    f"of shape {A.shape} is too large to densify"
+                    f"of shape {A.shape} is too large to densify "
+                    "(drop the mesh to use the single-device BCOO path)"
                 )
             A = np.asarray(A.todense())
         self._sparse = sp.issparse(A)

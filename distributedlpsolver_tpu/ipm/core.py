@@ -574,6 +574,105 @@ def pack_segment_meta(carry):
     )
 
 
+def fresh_segment_carry(state, reg0, buf_cap, dtype):
+    """Initial drive_segments carry for a fused solve starting at ``state``
+    (mirrors fused_solve's internal carry layout)."""
+    import jax.numpy as jnp
+
+    return (
+        state,
+        jnp.asarray(0, jnp.int32),
+        reg0,
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(STATUS_RUNNING, jnp.int32),
+        jnp.zeros((buf_cap, N_STAT), dtype),
+        jnp.asarray(jnp.inf, dtype),
+        jnp.asarray(0, jnp.int32),
+    )
+
+
+_PHASE_RESET_JIT = None
+
+
+def segment_phase_reset(carry, reg0):
+    """Device-side phase-boundary reset (one dispatch): keep state,
+    iteration count, and stats buffer; reset everything provisional
+    (regularization, bad-count, status, stall tracking) — every phase-1
+    verdict is provisional and phase 2 re-derives it at full precision."""
+    global _PHASE_RESET_JIT
+    if _PHASE_RESET_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _reset(carry, reg0):
+            st, it, _, _, _, buf, _, _ = carry
+            z = jnp.asarray(0, jnp.int32)
+            return (
+                st, it, reg0, z,
+                jnp.asarray(STATUS_RUNNING, jnp.int32), buf,
+                jnp.asarray(jnp.inf, buf.dtype), z,
+            )
+
+        _PHASE_RESET_JIT = _reset
+    return _PHASE_RESET_JIT(carry, reg0)
+
+
+def drive_phase_plan(phases, state, reg0, max_iter, buf_cap, dtype):
+    """Host driver for a multi-phase segmented fused solve.
+
+    ``phases`` is a list of ``(make_run_seg, stall_window,
+    stall_patience_floor, seg_init)`` where ``make_run_seg(bound) ->
+    run_seg(carry, it_stop)`` builds the phase's device program around its
+    global iteration bound. Each phase gets its own ``max_iter`` budget;
+    between phases the carry is reset via :func:`segment_phase_reset`.
+    Returns ``(state, iterations, status, stats_buffer)`` with the final
+    RUNNING status mapped to STALL/MAXITER exactly as the fused loop
+    would. ONE implementation shared by the dense and block backends so
+    their termination semantics can never diverge.
+    """
+    import jax.numpy as jnp
+
+    carry = fresh_segment_carry(state, reg0, buf_cap, dtype)
+    it, status = 0, STATUS_RUNNING
+    window, patience, bound = 0, 0.0, max_iter
+    best, since = float("inf"), 0
+    for pi, (make_run_seg, window, patience, seg_init) in enumerate(phases):
+        bound = it + max_iter
+        carry, (it, status, best, since) = drive_segments(
+            make_run_seg(bound), carry, bound, window, seg_init,
+            stall_patience_floor=patience, it0_status0=(it, status),
+        )
+        if pi < len(phases) - 1:
+            carry = segment_phase_reset(carry, reg0)
+            status = STATUS_RUNNING
+    st, buf = carry[0], carry[5]
+    if status == STATUS_RUNNING:
+        stalled = (
+            window
+            and since > window
+            and it < bound
+            and (not patience or best > patience)
+        )
+        status = STATUS_STALL if stalled else STATUS_MAXITER
+    return st, it, jnp.asarray(status, jnp.int32), buf
+
+
+# Conservative opening-segment cap in auto mode: big enough that a small
+# fast solve finishes in one or two segments, small enough that a ~4x
+# error in the FLOP-rate model cannot push the (unmeasured) first device
+# program past the execution watchdog before adaptation gets a data point.
+SEG_OPEN_CAP = 32
+
+
+def seg_open(seg_cfg, est_iter_seconds, target_s: float = 15.0) -> int:
+    """Opening segment length: the FLOP-estimated iteration count toward
+    ``target_s``, capped by SEG_OPEN_CAP in auto mode or by the user's
+    explicit ``segment_iters``."""
+    cap = seg_cfg if seg_cfg is not None else SEG_OPEN_CAP
+    return max(1, min(cap, int(target_s / max(est_iter_seconds, 1e-3))))
+
+
 def starting_point(ops: LinOps, data: ProblemData, cfg: StepParams) -> IPMState:
     """Mehrotra's least-squares starting point, extended to upper bounds.
 

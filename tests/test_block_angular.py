@@ -104,3 +104,47 @@ def test_block_mesh_divisibility_check():
     be = BlockAngularBackend(mesh=mesh)
     with pytest.raises(ValueError, match="not divisible"):
         be.setup(to_interior_form(p), SolverConfig())
+
+
+def test_two_phase_matches_single_phase():
+    # The mixed-precision fused Schur solve (f32 per-block factorizations,
+    # f64 finish) must reach the same optimum as the single-phase f64 path.
+    # Exercised directly — config auto-enables it only on TPU platforms.
+    import jax.numpy as jnp
+
+    from distributedlpsolver_tpu.backends import block_angular as ba
+    from distributedlpsolver_tpu.ipm import core
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.models.generators import block_angular_lp
+    from distributedlpsolver_tpu.models.problem import to_interior_form
+
+    p = block_angular_lp(4, 16, 28, 8, seed=9, sparse=True)
+    inf = to_interior_form(p)
+    cfg = SolverConfig()
+    tensors, lay = ba.build_tensors(inf, jnp.float64)
+    t32 = tensors._replace(
+        B_all=tensors.B_all.astype(jnp.float32),
+        L_all=tensors.L_all.astype(jnp.float32),
+        A0=tensors.A0.astype(jnp.float32),
+    )
+    data = core.make_problem_data(jnp, inf.c, inf.b, inf.u, jnp.float64)
+    reg0 = jnp.asarray(cfg.reg_dual, jnp.float64)
+    params = cfg.step_params()
+    mi = jnp.asarray(cfg.max_iter, jnp.int32)
+    mr = jnp.asarray(cfg.max_refactor, jnp.int32)
+    rg = jnp.asarray(cfg.reg_grow, jnp.float64)
+    state0 = ba._block_start(tensors, lay, data, reg0, params)
+
+    st1, it1, status1, _ = ba._block_solve_full(
+        tensors, lay, data, state0, reg0, params, mi, mr, rg,
+        core.buffer_cap(cfg.max_iter),
+    )
+    st2, it2, status2, _ = ba._block_solve_two_phase(
+        tensors, t32, lay, data, state0, reg0, params, cfg.phase1_params(),
+        mi, mr, rg, core.buffer_cap(2 * cfg.max_iter), cfg.stall_window,
+    )
+    assert int(status1) == core.STATUS_OPTIMAL
+    assert int(status2) == core.STATUS_OPTIMAL
+    obj1 = float(data.c @ st1.x)
+    obj2 = float(data.c @ st2.x)
+    assert abs(obj1 - obj2) < 1e-6 * (1 + abs(obj1))
