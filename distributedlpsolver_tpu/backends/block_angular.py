@@ -353,18 +353,24 @@ def _block_start(tensors, lay, data, reg, params):
     return core.starting_point(ops, data, params)
 
 
-@functools.partial(jax.jit, static_argnames=("lay", "params", "buf_cap"))
+@functools.partial(
+    jax.jit, static_argnames=("lay", "params", "buf_cap", "stall_window")
+)
 def _block_solve_full(
-    tensors, lay, data, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap
+    tensors, lay, data, state0, reg0, params, max_iter, max_refactor, reg_grow,
+    buf_cap, stall_window=0,
 ):
     # max_iter / max_refactor / reg_grow are traced — no recompile across
-    # iteration-limit configs (see dense._dense_solve_full).
+    # iteration-limit configs (see dense._dense_solve_full). Stall
+    # semantics match the segmented path (window 2·w, near-tol patience),
+    # so termination status cannot depend on whether segmentation is on.
     def step(state, reg):
         ops = _block_ops(tensors, lay, reg, None)
         return core.mehrotra_step(ops, data, params, state)
 
     return core.fused_solve(
-        step, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap
+        step, state0, reg0, params, max_iter, max_refactor, reg_grow, buf_cap,
+        stall_window=stall_window, stall_patience_floor=1e3 * params.tol,
     )
 
 
@@ -449,13 +455,7 @@ class BlockAngularBackend(SolverBackend):
             )
         return self._tensors32
 
-    def _segment_iters(self) -> int:
-        seg = self._cfg.segment_iters
-        if seg is None:
-            seg = 8 if jax.default_backend() == "tpu" else 0
-        return seg
-
-    def _solve_segmented(self, state: IPMState, seg: int):
+    def _solve_segmented(self, state: IPMState):
         """Host-driven segmented fused Schur solve: per-phase specs feed
         the shared driver (core.drive_phase_plan) — same termination
         semantics as the dense backend by construction."""
@@ -483,7 +483,7 @@ class BlockAngularBackend(SolverBackend):
 
         def make_phase(spec):
             params, mixed, t32, window, patience_now = spec
-            rate = 2e12 if mixed else 2.5e11  # conservative
+            rate = core.SEG_RATE_F32 if mixed else core.SEG_RATE_F64
 
             def make_run_seg(bound):
                 mi = jnp.asarray(bound, jnp.int32)
@@ -508,9 +508,8 @@ class BlockAngularBackend(SolverBackend):
         )
 
     def solve_full(self, state: IPMState):
-        seg = self._segment_iters()
-        if seg:
-            return self._solve_segmented(state, seg)
+        if core.use_segments(self._cfg.segment_iters, jax.default_backend()):
+            return self._solve_segmented(state)
         if self._two_phase:
             return _block_solve_two_phase(
                 self._tensors,
@@ -538,6 +537,7 @@ class BlockAngularBackend(SolverBackend):
             jnp.asarray(self._cfg.max_refactor, jnp.int32),
             jnp.asarray(self._cfg.reg_grow, self._dtype),
             core.buffer_cap(self._cfg.max_iter),
+            2 * self._cfg.stall_window if self._cfg.stall_window else 0,
         )
 
     def block_until_ready(self, obj) -> None:

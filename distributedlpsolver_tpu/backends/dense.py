@@ -483,13 +483,7 @@ class DenseJaxBackend(SolverBackend):
              2 * w if w else 0, patience),
         ]
 
-    def _segment_iters(self) -> int:
-        seg = self._cfg.segment_iters
-        if seg is None:
-            seg = 8 if jax.default_backend() == "tpu" else 0
-        return seg
-
-    def _solve_segmented(self, state: IPMState, seg: int):
+    def _solve_segmented(self, state: IPMState):
         """Host-driven segmented fused solve: per-phase specs feed the
         shared driver (core.drive_phase_plan), which bounds single
         device-program runtime under execution watchdogs."""
@@ -507,7 +501,7 @@ class DenseJaxBackend(SolverBackend):
 
         def make_phase(spec):
             params, fdt, refine, pallas, Af, window, patience = spec
-            rate = 2e12 if fdt == "float32" else 2.5e11  # conservative
+            rate = core.SEG_RATE_F32 if fdt == "float32" else core.SEG_RATE_F64
 
             def make_run_seg(bound):
                 mi = jnp.asarray(bound, jnp.int32)
@@ -532,9 +526,8 @@ class DenseJaxBackend(SolverBackend):
         )
 
     def solve_full(self, state: IPMState):
-        seg = self._segment_iters()
-        if seg:
-            return self._solve_segmented(state, seg)
+        if core.use_segments(self._cfg.segment_iters, jax.default_backend()):
+            return self._solve_segmented(state)
         if self._two_phase:
             cfg = self._cfg
             self._phase_plan()  # materializes A32

@@ -163,6 +163,14 @@ def _parse(fh: TextIO, dense: Optional[bool]) -> LPProblem:
                 col_names.append(cname)
             if in_integer:
                 integer_cols.add(j)
+            if len(fields) % 2 != 1:
+                # col row val [row val]: an even token count means a pair is
+                # incomplete — fail with the actual line, not a downstream
+                # float-conversion error on a shifted token.
+                raise ValueError(
+                    f"COLUMNS line has {len(fields)} fields (expected an odd "
+                    f"count: column name + row/value pairs): {line!r}"
+                )
             for k in range(1, len(fields) - 1, 2):
                 rname, val = fields[k], float(fields[k + 1])
                 if rname == obj_row:
@@ -334,8 +342,14 @@ def write_mps(p: LPProblem, path: Union[str, os.PathLike]) -> None:
     while obj_name in rn:
         obj_name = "_" + obj_name  # avoid colliding with a constraint row
 
+    # LPProblem stores c/c0 minimized; the FILE carries the original sense
+    # (reader negates back under OBJSENSE MAX), so emit -c for maximize.
+    obj_sign = -1.0 if p.maximize else 1.0
+
     with open(os.fspath(path), "w") as f:
         f.write(f"NAME          {p.name}\n")
+        if p.maximize:
+            f.write("OBJSENSE\n    MAX\n")
         f.write("ROWS\n")
         f.write(f" N  {obj_name}\n")
         rtypes = []
@@ -357,12 +371,12 @@ def write_mps(p: LPProblem, path: Union[str, os.PathLike]) -> None:
             if p.c[j] != 0.0 or sl.start == sl.stop:
                 # Always declare the column, even if it only appears via an
                 # explicit 0 objective entry (else it vanishes on re-read).
-                f.write(f"    {cn[j]}  {obj_name}  {p.c[j]:.17g}\n")
+                f.write(f"    {cn[j]}  {obj_name}  {obj_sign * p.c[j]:.17g}\n")
             for i, v in zip(A.indices[sl], A.data[sl]):
                 f.write(f"    {cn[j]}  {rn[i]}  {v:.17g}\n")
         f.write("RHS\n")
         if p.c0 != 0.0:
-            f.write(f"    RHS1  {obj_name}  {-p.c0:.17g}\n")
+            f.write(f"    RHS1  {obj_name}  {-(obj_sign * p.c0):.17g}\n")
         for i in range(m):
             rt = rtypes[i]
             b = p.rub[i] if rt == "L" else p.rlb[i]

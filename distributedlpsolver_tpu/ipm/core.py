@@ -664,6 +664,21 @@ def drive_phase_plan(phases, state, reg0, max_iter, buf_cap, dtype):
 # program past the execution watchdog before adaptation gets a data point.
 SEG_OPEN_CAP = 32
 
+# Conservative effective rates for watchdog seeding (ONE definition for
+# every backend): f32 paths ride the MXU; f64 is software-emulated on TPU.
+SEG_RATE_F32 = 2e12
+SEG_RATE_F64 = 2.5e11
+
+
+def use_segments(seg_cfg, platform: str) -> bool:
+    """Whether a backend should host-segment its fused loop: explicit
+    ``segment_iters=0`` disables, any positive value enables, and auto
+    (None) enables exactly on TPU — where tunneled execution watchdogs
+    make unbounded device programs unsafe."""
+    if seg_cfg is None:
+        return platform == "tpu"
+    return seg_cfg > 0
+
 
 def seg_open(seg_cfg, est_iter_seconds, target_s: float = 15.0) -> int:
     """Opening segment length: the FLOP-estimated iteration count toward

@@ -151,3 +151,66 @@ def test_fused_loop_matches_host_loop():
     assert rf.objective == rl.objective
     assert len(rf.history) == rf.iterations
     assert rf.history[-1].rel_gap <= 1e-8
+
+
+def test_drive_phase_plan_status_mapping():
+    """The shared multi-phase segment driver must terminate with the same
+    status semantics as the fused loop: OPTIMAL passes through, RUNNING at
+    the budget maps to ITERATION_LIMIT."""
+    import jax.numpy as jnp
+
+    from distributedlpsolver_tpu.ipm import core
+
+    calls = []
+
+    def make_run_seg(bound):
+        def run_seg(carry, stop):
+            st, it, reg, bad, status, buf, best, since = carry
+            calls.append((int(it), stop))
+            new_it = jnp.minimum(jnp.asarray(stop, jnp.int32), bound)
+            # pretend we converge at iteration >= 5
+            new_status = jnp.where(
+                new_it >= 5, core.STATUS_OPTIMAL, core.STATUS_RUNNING
+            )
+            carry = (st, new_it, reg, bad, new_status, buf, best, since)
+            return carry, core.pack_segment_meta(carry)
+
+        return run_seg
+
+    state = jnp.zeros(3)
+    reg0 = jnp.asarray(1e-10, jnp.float64)
+    buf_cap = 8
+    phases = [(make_run_seg, 0, 0.0, 2)]
+    st, it, status, buf = core.drive_phase_plan(
+        phases, state, reg0, 20, buf_cap, jnp.float64
+    )
+    assert int(status) == core.STATUS_OPTIMAL
+    assert it >= 5
+    # never-converging phase hits the budget -> MAXITER
+    def make_run_seg2(bound):
+        def run_seg(carry, stop):
+            st, it, reg, bad, status, buf, best, since = carry
+            carry = (
+                st, jnp.asarray(stop, jnp.int32), reg, bad,
+                jnp.asarray(core.STATUS_RUNNING, jnp.int32), buf, best, since,
+            )
+            return carry, core.pack_segment_meta(carry)
+
+        return run_seg
+
+    st, it, status, buf = core.drive_phase_plan(
+        [(make_run_seg2, 0, 0.0, 4)], state, reg0, 12, buf_cap, jnp.float64
+    )
+    assert int(status) == core.STATUS_MAXITER and it == 12
+
+
+def test_seg_open_caps():
+    from distributedlpsolver_tpu.ipm import core
+
+    # auto mode: tiny per-iteration estimate caps at SEG_OPEN_CAP
+    assert core.seg_open(None, 1e-6) == core.SEG_OPEN_CAP
+    # big per-iteration estimate: few iterations per segment
+    assert core.seg_open(None, 7.5) == 2
+    # explicit segment_iters is a hard cap
+    assert core.seg_open(8, 1e-6) == 8
+    assert core.seg_open(8, 7.5) == 2

