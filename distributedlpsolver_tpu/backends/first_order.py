@@ -225,7 +225,20 @@ class FirstOrderBackend(SolverBackend):
 
     def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
         self._cfg = config
+        # Working precision. PDHG needs no f64 operands at the accuracy it
+        # targets, and on TPU an emulated-f64 GEMV materializes ~8
+        # full-size f32 component copies of A (observed: a 15 GB temp for
+        # ONE 10k×50k matvec — OOM where the f32 operand is 1.9 GB). Under
+        # the default "auto" schedule on TPU, run everything in f32 as
+        # long as the tolerance is above f32's ~1e-6 noise floor; an
+        # explicit factor_dtype or a tighter tol keeps full precision.
         dtype = jnp.dtype(config.dtype)
+        if config.factor_dtype == "float32" or (
+            config.factor_dtype == "auto"
+            and jax.default_backend() == "tpu"
+            and config.tol >= 1e-6
+        ):
+            dtype = jnp.dtype(jnp.float32)
         self._dtype = dtype
         self._n_pad = 0
         self._col_sharding = None
