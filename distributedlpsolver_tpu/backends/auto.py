@@ -13,7 +13,7 @@ choice, so this is an addition, not a parity item).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 
@@ -31,13 +31,18 @@ from distributedlpsolver_tpu.models.problem import InteriorForm
 _SMALL_ENTRIES = 200_000
 
 
-def choose_backend_name(inf: InteriorForm, platform: str, detect: bool = False) -> str:
-    """Pick a backend for ``inf``. With ``detect`` (the AutoBackend path),
-    hint-less sparse problems get a block-angular detection pass
-    (models/structure.py) and, on success, the hint is attached to ``inf``
-    so the Schur backend can consume it."""
+def choose_backend_name(
+    inf: InteriorForm, platform: str, detect: bool = False
+) -> Tuple[str, Optional[dict]]:
+    """Pick a backend for ``inf``; returns ``(name, hint)``.
+
+    With ``detect`` (the AutoBackend path), hint-less sparse problems get
+    a block-angular detection pass (models/structure.py); a successful
+    detection is RETURNED as the hint rather than attached to ``inf`` —
+    this function is pure so callers can use it to inspect routing without
+    mutating the problem object (AutoBackend.setup attaches the hint)."""
     if platform == "cpu":
-        return "cpu-native"
+        return "cpu-native", None
     # Any accelerator (tpu/gpu/...): tiny problems still go to the CPU —
     # device dispatch dominates them — everything else runs the JAX path
     # ("tpu" is the registry name of the accelerated dense backend on
@@ -45,10 +50,10 @@ def choose_backend_name(inf: InteriorForm, platform: str, detect: bool = False) 
     # explicit Schur backend.
     m, n = inf.m, inf.n
     if m * n <= _SMALL_ENTRIES:
-        return "cpu-native"
+        return "cpu-native", None
     K = int((inf.block_structure or {}).get("num_blocks", 0))
     if K >= 2:
-        return "block"
+        return "block", None
     # Large genuinely-sparse problems without block structure must not hit
     # the dense path — its setup densifies A (a Mittelmann-scale LP would
     # be a multi-terabyte allocation). Recoverable block-angular structure
@@ -73,10 +78,9 @@ def choose_backend_name(inf: InteriorForm, platform: str, detect: bool = False) 
                 if hint is not None and (
                     estimate_block_tensor_entries(inf.A, hint) <= 1 << 28
                 ):
-                    inf.block_structure = hint
-                    return "block"
-            return "cpu-sparse"
-    return "tpu"
+                    return "block", hint
+            return "cpu-sparse", None
+    return "tpu", None
 
 
 @register_backend("auto")
@@ -87,7 +91,11 @@ class AutoBackend(SolverBackend):
         self._inner: SolverBackend | None = None
 
     def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
-        name = choose_backend_name(inf, jax.default_backend(), detect=True)
+        name, hint = choose_backend_name(
+            inf, jax.default_backend(), detect=True
+        )
+        if hint is not None:
+            inf.block_structure = hint
         self._inner = get_backend(name)
         self.name = f"auto({name})"
         self._inner.setup(inf, config)

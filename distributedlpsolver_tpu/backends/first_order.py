@@ -108,12 +108,17 @@ def _err_of(matvec, rmatvec, data, x, y):
 
 @functools.partial(jax.jit, static_argnames=("check_every", "restart_len"))
 def _pdhg_solve(
-    A, AT, data, x0, y0, eta, omega0, max_iter, tol,
+    A, AT, data, x0, y0, eta, omega0, err_restart0, max_iter, tol,
     check_every=40, restart_len=2000, restart_beta=0.5,
 ):
     """Fused restarted-PDHG loop. ``A``/``AT`` are dense arrays or BCOO
     pytrees — both trace as ordinary jit operands, so one compiled program
-    serves every problem of the same shape/sparsity pattern."""
+    serves every problem of the same shape/sparsity pattern.
+
+    ``omega0``/``err_restart0`` make the loop resumable: a caller driving
+    bounded bursts feeds back the returned ``(omega, err_restart)`` so the
+    adaptive primal weight and restart baseline survive burst boundaries
+    (a fresh start passes ``omega0=1, err_restart0=inf``)."""
     matvec = lambda v: A @ v
     rmatvec = lambda v: AT @ v
     dtype = x0.dtype
@@ -126,12 +131,13 @@ def _pdhg_solve(
         y_new = y + sigma * (data.b - matvec(2.0 * x_new - x))
         return x_new, y_new
 
+    err0 = _err_of(matvec, rmatvec, data, x0, y0)
     st0 = PDHGState(
         x=x0, y=y0,
         x_sum=jnp.zeros_like(x0), y_sum=jnp.zeros_like(y0),
         n_avg=jnp.asarray(0.0, dtype),
         x_restart=x0, y_restart=y0,
-        err_restart=_err_of(matvec, rmatvec, data, x0, y0),
+        err_restart=jnp.minimum(jnp.asarray(err_restart0, dtype), err0),
         omega=jnp.asarray(omega0, dtype),
         it_cycle=jnp.asarray(0, jnp.int32),
     )
@@ -207,7 +213,7 @@ def _pdhg_solve(
     use_avg = err_avg < err_cur
     x_fin = jnp.where(use_avg, x_avg, st.x)
     y_fin = jnp.where(use_avg, y_avg, st.y)
-    return x_fin, y_fin, it, jnp.minimum(err_avg, err_cur)
+    return x_fin, y_fin, it, jnp.minimum(err_avg, err_cur), st.omega, st.err_restart
 
 
 @register_backend("pdlp", "first-order", "pdhg")
@@ -333,6 +339,30 @@ class FirstOrderBackend(SolverBackend):
         )
         self._eta = float(0.9 / max(float(nrm), 1e-12))
         self._it_done = 0
+        self._reset_adaptive()
+
+    def _reset_adaptive(self) -> None:
+        # Adaptive PDHG state persisted ACROSS bursts (iterate calls and
+        # solve_full segments): the learned primal weight and the restart
+        # baseline. Discarding these at every burst boundary makes the
+        # non-fused driver path converge measurably slower than one fused
+        # loop on the same budget (round-1 advisor finding).
+        self._omega = 1.0
+        self._err_restart = float("inf")
+
+    def _pdhg_iter_seconds(self) -> float:
+        """Conservative per-inner-iteration time estimate for watchdog
+        segmentation. PDHG is two matvec passes (+ periodic KKT checks ~
+        two more per check_every block) — bandwidth-bound, not MXU-bound,
+        so the effective flop rate is far below core.SEG_RATE_F32.
+        Measured anchor: 167 it/s at 10000x50000 dense f32 (BASELINE.md)
+        -> ~3.3e11 effective flops/s on 4mn flops/iter; seed at 2e11.
+        Sparse BCOO SpMV gathers/scatters instead of riding the MXU —
+        seed an order of magnitude lower per nonzero."""
+        if self._sparse:
+            return 4.0 * float(self._A.nse) / 2e10
+        m, n = self._A.shape
+        return 4.0 * float(m) * float(n) / 2e11
 
     def starting_point(self) -> IPMState:
         n = self._data.c.shape[0]
@@ -352,15 +382,20 @@ class FirstOrderBackend(SolverBackend):
 
     def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
         # One driver "iteration" = a bounded PDHG burst; stats are true KKT
-        # measures so the host convergence test stays meaningful.
-        x, y, it, err = _pdhg_solve(
+        # measures so the host convergence test stays meaningful. The
+        # adaptive primal weight and restart baseline persist across
+        # bursts (self._omega / self._err_restart).
+        x, y, it, err, omega, err_restart = _pdhg_solve(
             self._A, self._AT, self._data,
             state.x, state.y,
             jnp.asarray(self._eta, self._dtype),
-            jnp.asarray(1.0, self._dtype),
+            jnp.asarray(self._omega, self._dtype),
+            jnp.asarray(self._err_restart, self._dtype),
             jnp.asarray(400, jnp.int32),
             jnp.asarray(self._cfg.tol, self._dtype),
         )
+        self._omega = float(omega)
+        self._err_restart = float(err_restart)
         pinf, dinf, gap, pobj, dobj = _kkt_error(
             self._matvec, self._rmatvec, self._data, x, y
         )
@@ -377,17 +412,58 @@ class FirstOrderBackend(SolverBackend):
 
     def solve_full(self, state: IPMState):
         cfg = self._cfg
+        import time as _time
+
         # PDHG counts iterations in the thousands; interpret the config's
         # (IPM-scaled) max_iter as bursts of 400 inner steps.
-        max_inner = jnp.asarray(cfg.max_iter * 400, jnp.int32)
-        x, y, it, err = _pdhg_solve(
-            self._A, self._AT, self._data,
-            state.x, state.y,
-            jnp.asarray(self._eta, self._dtype),
-            jnp.asarray(1.0, self._dtype),
-            max_inner,
-            jnp.asarray(cfg.tol, self._dtype),
-        )
+        max_inner = int(cfg.max_iter) * 400
+        eta = jnp.asarray(self._eta, self._dtype)
+        tol = jnp.asarray(cfg.tol, self._dtype)
+        x, y = state.x, state.y
+        omega = jnp.asarray(self._omega, self._dtype)
+        err_restart = jnp.asarray(self._err_restart, self._dtype)
+        if core.use_segments(cfg.segment_iters, jax.default_backend()):
+            # Host-segmented bursts: one unbounded lax.while_loop at, say,
+            # 57 s for the flagship config sits right at the tunneled-TPU
+            # execution watchdog (~60 s) — a slightly harder problem gets
+            # the run killed instead of returning ITERATION_LIMIT. Carry
+            # (x, y, omega, err_restart) across bounded bursts instead;
+            # burst length is seeded from the bandwidth estimate and then
+            # adapted to the measured rate, mirroring core.drive_segments.
+            if cfg.segment_iters is not None:
+                burst = max(400, int(cfg.segment_iters) * 400)
+            else:
+                est = self._pdhg_iter_seconds()
+                burst = max(400, min(40000, int(15.0 / max(est, 1e-9))))
+            it_total, err, first = 0, float("inf"), True
+            while it_total < max_inner:
+                this = min(burst, max_inner - it_total)
+                t0 = _time.perf_counter()
+                x, y, it_b, err_b, omega, err_restart = _pdhg_solve(
+                    self._A, self._AT, self._data, x, y, eta,
+                    omega, err_restart,
+                    jnp.asarray(this, jnp.int32), tol,
+                )
+                err_b.block_until_ready()
+                dt = _time.perf_counter() - t0
+                it_b, err = int(it_b), float(err_b)
+                it_total += it_b
+                if err <= float(cfg.tol) or it_b == 0:
+                    break
+                if not first:  # first burst's wall time includes compile
+                    burst = max(
+                        400, min(200000, int(burst * 15.0 / max(dt, 1e-3)))
+                    )
+                first = False
+            it = jnp.asarray(it_total, jnp.int32)
+        else:
+            x, y, it, err, omega, err_restart = _pdhg_solve(
+                self._A, self._AT, self._data, x, y, eta,
+                omega, err_restart,
+                jnp.asarray(max_inner, jnp.int32), tol,
+            )
+        self._omega = float(omega)
+        self._err_restart = float(err_restart)
         pinf, dinf, gap, pobj, dobj = _kkt_error(
             self._matvec, self._rmatvec, self._data, x, y
         )
@@ -417,6 +493,8 @@ class FirstOrderBackend(SolverBackend):
         )
 
     def from_host(self, state: IPMState) -> IPMState:
+        # A restored iterate invalidates the burst-adaptive baselines.
+        self._reset_adaptive()
         x, y, s, w, z = (np.asarray(v, dtype=self._dtype) for v in state)
         if self._n_pad:
             x = np.concatenate([x, np.zeros(self._n_pad, dtype=self._dtype)])

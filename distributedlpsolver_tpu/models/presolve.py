@@ -339,9 +339,13 @@ def presolve(
                 # Cost decides the optimal value; an infinite optimal bound
                 # means the problem is unbounded *if* the rest is feasible —
                 # leave the column live so the IPM settles that question.
-                if c[j] > feas_tol:
+                # The costless branch requires c_j == 0 EXACTLY: a
+                # tiny-but-real cost with wide bounds contributes up to
+                # |c_j|*(ub-lb) objective error if fixed at an arbitrary
+                # feasible value instead of its cost-optimal bound.
+                if c[j] > 0.0:
                     v = lb[j]
-                elif c[j] < -feas_tol:
+                elif c[j] < 0.0:
                     v = ub[j]
                 else:  # costless: any feasible value (finite by lb<=ub)
                     v = min(max(0.0, lb[j]), ub[j])

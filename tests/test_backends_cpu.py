@@ -132,10 +132,10 @@ def test_auto_backend_picks_by_size_and_structure():
     tiny = to_interior_form(random_general_lp(27, 51, seed=0))
     big = to_interior_form(random_dense_lp(600, 1200, seed=0))
     blocky = to_interior_form(block_angular_lp(8, 96, 256, 64, seed=0, sparse=False))
-    assert choose_backend_name(tiny, "tpu") == "cpu-native"
-    assert choose_backend_name(big, "tpu") == "tpu"
-    assert choose_backend_name(blocky, "tpu") == "block"
-    assert choose_backend_name(big, "cpu") == "cpu-native"
+    assert choose_backend_name(tiny, "tpu") == ("cpu-native", None)
+    assert choose_backend_name(big, "tpu") == ("tpu", None)
+    assert choose_backend_name(blocky, "tpu") == ("block", None)
+    assert choose_backend_name(big, "cpu") == ("cpu-native", None)
 
     r = solve(random_general_lp(12, 30, seed=4), backend="auto")
     assert r.status == Status.OPTIMAL

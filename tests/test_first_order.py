@@ -75,3 +75,16 @@ def test_mesh_sharded_matches_single_device():
         r_one.objective, abs=1e-4 * (1 + abs(r_one.objective))
     )
     assert r_mesh.x.shape == (p.n,)
+
+
+def test_segmented_bursts_match_fused():
+    # Host-segmented solve_full (watchdog guard on tunneled TPUs): bursts
+    # of segment_iters*400 inner steps carrying (x, y, omega, err_restart)
+    # must converge to the same objective as the single fused loop.
+    p = random_general_lp(30, 60, seed=11)
+    r_seg = solve(p, backend="pdlp", tol=1e-6, max_iter=100, segment_iters=1)
+    r_fused = solve(p, backend="pdlp", tol=1e-6, max_iter=100, segment_iters=0)
+    assert r_seg.status == Status.OPTIMAL
+    assert r_seg.objective == pytest.approx(
+        r_fused.objective, abs=1e-4 * (1 + abs(r_fused.objective))
+    )

@@ -145,17 +145,19 @@ class TestGeneralizedBackend:
 
 
 class TestAutoDetectRouting:
-    def test_auto_attaches_hint_and_routes_block(self):
+    def test_auto_returns_hint_and_routes_block(self):
         from distributedlpsolver_tpu.backends.auto import choose_backend_name
         from distributedlpsolver_tpu.models.problem import to_interior_form
 
         p = block_angular_lp(8, 48, 96, 16, seed=1, sparse=True)
         inf = to_interior_form(_strip_hint(p))
         assert inf.m * inf.n > 200_000  # above the small-problem cutoff
-        name = choose_backend_name(inf, "tpu", detect=True)
+        name, hint = choose_backend_name(inf, "tpu", detect=True)
         assert name == "block"
-        assert inf.block_structure is not None
-        assert inf.block_structure["num_blocks"] >= 2
+        # Pure: the hint is returned, NOT attached to the problem object.
+        assert inf.block_structure is None
+        assert hint is not None
+        assert hint["num_blocks"] >= 2
 
     def test_unstructured_sparse_routes_cpu_sparse(self):
         rng = np.random.default_rng(2)
@@ -173,8 +175,9 @@ class TestAutoDetectRouting:
             col_kind=np.zeros(900, dtype=np.int8), col_orig=np.arange(900),
             col_shift=np.zeros(900), col_sign=np.ones(900),
         )
-        name = choose_backend_name(inf, "tpu", detect=True)
+        name, hint = choose_backend_name(inf, "tpu", detect=True)
         assert name == "cpu-sparse"
+        assert hint is None
 
 
 class TestTensorEstimate:
