@@ -278,31 +278,95 @@ def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout, reg
     )
 
 
-@functools.partial(jax.jit, static_argnames=("lay", "params"))
-def _block_step(tensors, lay, data, state, reg, params):
-    ops = _block_ops(tensors, lay, reg, None)
+def _block_diag_m(t: BlockTensors, lay: BlockLayout, d):
+    """diag(A·diag(d)·Aᵀ) in full precision — the consistent diagonal for
+    the PCG operator's regularization term (mirrors the dense backend's
+    ``reg·diag(M)``)."""
+    K, mb, nb, link, n0, n, m = lay
+    dB = jnp.concatenate([d, jnp.zeros(1, d.dtype)])[t.col_idx]  # (K, nb)
+    diag_blocks = jnp.einsum("kmn,kn->km", t.B_all * t.B_all, dB)
+    diag_link = jnp.einsum("kln,kn->l", t.L_all * t.L_all, dB)
+    if n0:
+        diag_link = diag_link + (t.A0 * t.A0) @ d[t.border_idx]
+    out = jnp.zeros(m + 1, dtype=d.dtype).at[t.row_idx].add(diag_blocks)
+    return out.at[t.link_idx].add(diag_link)[:m]
+
+
+def _block_pcg_ops(t64, t32, lay, reg, cg_tol, cg_iters):
+    """PCG LinOps for the arrow structure: the f32 Schur factorization
+    (per-block Choleskys + linking-system Cholesky, all MXU work) is only
+    a PRECONDITIONER; accuracy comes from CG whose operator applies
+    ``A·diag(d)·Aᵀ (+reg·diag)`` matrix-free through the full-precision
+    tensors — einsums linear in the stored entries, so no emulated-f64
+    O(K·mb²·nb) assembly or O(link²·K·nb) linking-system work ever runs.
+    Same design as dense._pcg_ops; shares core.pcg_solve."""
+    base = _block_ops(t64, lay, reg, None)
+    f32 = jnp.float32
+    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None)
+
+    def factorize(d):
+        factors32 = ops32.factorize(d.astype(f32))
+        regd = jnp.asarray(reg, d.dtype) * _block_diag_m(t64, lay, d)
+        return factors32, d, regd
+
+    def solve(factors, rhs):
+        factors32, d, regd = factors
+
+        def op(y):
+            return base.matvec(d * base.rmatvec(y)) + regd * y
+
+        def prec(r):
+            return ops32.solve(factors32, r.astype(f32)).astype(rhs.dtype)
+
+        return core.pcg_solve(op, prec, rhs, cg_tol, cg_iters)
+
+    return core.LinOps(
+        xp=jnp,
+        matvec=base.matvec,
+        rmatvec=base.rmatvec,
+        factorize=factorize,
+        solve=solve,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("lay", "params", "cg_iters", "cg_tol")
+)
+def _block_step(tensors, lay, data, state, reg, params, tensors32=None,
+                cg_iters=0, cg_tol=0.0):
+    if cg_iters > 0:
+        ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+    else:
+        ops = _block_ops(tensors, lay, reg, None)
     return core.mehrotra_step(ops, data, params, state)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("lay", "params", "buf_cap", "stall_window", "patience", "mixed"),
+    static_argnames=(
+        "lay", "params", "buf_cap", "stall_window", "patience", "mode",
+        "cg_iters", "cg_tol",
+    ),
 )
 def _block_segment(
     tensors, tensors32, lay, data, carry, it_stop, max_iter, max_refactor,
-    reg_grow, params, buf_cap, stall_window=0, patience=0.0, mixed=False,
+    reg_grow, params, buf_cap, stall_window=0, patience=0.0, mode="f64",
+    cg_iters=0, cg_tol=0.0,
 ):
     """One bounded continuation of the fused Schur loop (host segmentation
     against the device execution watchdog — see core.drive_segments and
-    dense._dense_segment). ``mixed`` selects the f32-factorization phase-1
-    ops; ``tensors32`` may be None when not mixed."""
+    dense._dense_segment). ``mode`` selects the per-step ops: "f64"
+    (direct full precision), "mixed" (f32 factorizations, phase 1), or
+    "pcg" (f32 preconditioner + full-precision matrix-free CG);
+    ``tensors32`` may be None only for "f64"."""
 
     def step(state, reg):
-        ops = (
-            _block_ops_mixed(tensors, tensors32, lay, reg)
-            if mixed
-            else _block_ops(tensors, lay, reg, None)
-        )
+        if mode == "mixed":
+            ops = _block_ops_mixed(tensors, tensors32, lay, reg)
+        elif mode == "pcg":
+            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+        else:
+            ops = _block_ops(tensors, lay, reg, None)
         return core.mehrotra_step(ops, data, params, state)
 
     out = core.fused_solve(
@@ -314,24 +378,34 @@ def _block_segment(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lay", "params", "params_p1", "buf_cap", "stall_window")
+    jax.jit,
+    static_argnames=(
+        "lay", "params", "params_p1", "buf_cap", "stall_window", "cg_iters",
+        "cg_tol",
+    ),
 )
 def _block_solve_two_phase(
     tensors, tensors32, lay, data, state0, reg0, params, params_p1,
     max_iter, max_refactor, reg_grow, buf_cap, stall_window,
+    cg_iters=0, cg_tol=0.0,
 ):
     """Mixed-precision fused Schur solve: f32 per-block factorizations and
-    linking-system Cholesky down to the handoff tolerance, then f64
-    warm-started to full tolerance — one compiled program, shared stats
-    buffer and global iteration count (mirrors dense._dense_solve_two_phase,
-    including the provisional-verdict reset at the phase boundary)."""
+    linking-system Cholesky down to the handoff tolerance, then the
+    full-accuracy phase warm-started from the same iterate — f64 direct,
+    or (cg_iters > 0) the f32-preconditioned matrix-free PCG mode — one
+    compiled program, shared stats buffer and global iteration count
+    (mirrors dense._dense_solve_two_phase, including the
+    provisional-verdict reset at the phase boundary)."""
 
     def step32(state, reg):
         ops = _block_ops_mixed(tensors, tensors32, lay, reg)
         return core.mehrotra_step(ops, data, params_p1, state)
 
     def step64(state, reg):
-        ops = _block_ops(tensors, lay, reg, None)
+        if cg_iters > 0:
+            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+        else:
+            ops = _block_ops(tensors, lay, reg, None)
         return core.mehrotra_step(ops, data, params, state)
 
     st1, it1, status1, buf = core.fused_solve(
@@ -347,25 +421,36 @@ def _block_solve_two_phase(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("lay", "params"))
-def _block_start(tensors, lay, data, reg, params):
-    ops = _block_ops(tensors, lay, reg, None)
+@functools.partial(
+    jax.jit, static_argnames=("lay", "params", "cg_iters", "cg_tol")
+)
+def _block_start(tensors, lay, data, reg, params, tensors32=None,
+                 cg_iters=0, cg_tol=0.0):
+    if cg_iters > 0:
+        ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+    else:
+        ops = _block_ops(tensors, lay, reg, None)
     return core.starting_point(ops, data, params)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lay", "params", "buf_cap", "stall_window")
+    jax.jit,
+    static_argnames=("lay", "params", "buf_cap", "stall_window", "cg_iters",
+                     "cg_tol"),
 )
 def _block_solve_full(
     tensors, lay, data, state0, reg0, params, max_iter, max_refactor, reg_grow,
-    buf_cap, stall_window=0,
+    buf_cap, stall_window=0, tensors32=None, cg_iters=0, cg_tol=0.0,
 ):
     # max_iter / max_refactor / reg_grow are traced — no recompile across
     # iteration-limit configs (see dense._dense_solve_full). Stall
     # semantics match the segmented path (window 2·w, near-tol patience),
     # so termination status cannot depend on whether segmentation is on.
     def step(state, reg):
-        ops = _block_ops(tensors, lay, reg, None)
+        if cg_iters > 0:
+            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+        else:
+            ops = _block_ops(tensors, lay, reg, None)
         return core.mehrotra_step(ops, data, params, state)
 
     return core.fused_solve(
@@ -424,19 +509,46 @@ class BlockAngularBackend(SolverBackend):
         # must not pay the +50% HBM for a copy it never reads.
         self._two_phase = config.two_phase_enabled(jax.default_backend())
         self._tensors32 = None
+        # PCG full-accuracy mode (config.solve_mode, mirrors the dense
+        # backend): replaces the emulated-f64 Schur assembly/factorization
+        # phase with f32 preconditioning + full-precision matrix-free CG.
+        # Auto-on where the f64 einsums are the bottleneck: the FLOP
+        # estimate crossing ~0.8 s/iteration of emulated-f64 work.
+        K, mb, nb, link, n0, n, m = self._lay
+        self._f64_flops = K * (2.0 * mb * mb * nb + mb**3 / 3.0) + (
+            2.0 * link * link * (K * nb + n0) + link**3 / 3.0
+        )
+        if config.solve_mode == "pcg":
+            self._pcg = True
+        elif config.solve_mode is None:
+            self._pcg = (
+                self._two_phase and self._f64_flops >= 2e11
+            )
+        else:
+            self._pcg = False
+        self._cg_iters = config.cg_iters if self._pcg else 0
+        self._cg_tol = config.cg_tol if self._pcg else 0.0
+
+    def _point_args(self):
+        """(tensors32, cg_iters, cg_tol) for the per-call entry points."""
+        if self._pcg:
+            return self._get_tensors32(), self._cg_iters, self._cg_tol
+        return None, 0, 0.0
 
     def starting_point(self) -> IPMState:
+        t32, cgi, cgt = self._point_args()
         st = _block_start(
             self._tensors, self._lay, self._data,
-            jnp.asarray(self._reg, self._dtype), self._params,
+            jnp.asarray(self._reg, self._dtype), self._params, t32, cgi, cgt,
         )
         jax.block_until_ready(st)
         return st
 
     def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
+        t32, cgi, cgt = self._point_args()
         return _block_step(
             self._tensors, self._lay, self._data, state,
-            jnp.asarray(self._reg, self._dtype), self._params,
+            jnp.asarray(self._reg, self._dtype), self._params, t32, cgi, cgt,
         )
 
     def bump_regularization(self) -> bool:
@@ -465,25 +577,32 @@ class BlockAngularBackend(SolverBackend):
         buf_cap = core.buffer_cap(n_phases * cfg.max_iter)
         mr = jnp.asarray(cfg.max_refactor, jnp.int32)
         rg = jnp.asarray(cfg.reg_grow, dtype)
-        K, mb, nb, link, n0, n, m = self._lay
         # Per-iteration FLOP estimate: per-block normal equations and
-        # Cholesky plus the linking-system dense work.
-        flops = K * (2.0 * mb * mb * nb + mb**3 / 3.0) + (
-            2.0 * link * link * (K * nb + n0) + link**3 / 3.0
-        )
+        # Cholesky plus the linking-system dense work (setup-computed).
+        flops = self._f64_flops
         w = cfg.stall_window
         patience = 1e3 * cfg.tol
+        full_mode = "pcg" if self._pcg else "f64"
+        full_t32 = self._get_tensors32() if self._pcg else None
         if self._two_phase:
             plan = [
-                (cfg.phase1_params(), True, self._get_tensors32(), w, 0.0),
-                (self._params, False, None, 2 * w if w else 0, patience),
+                (cfg.phase1_params(), "mixed", self._get_tensors32(), w, 0.0),
+                (self._params, full_mode, full_t32, 2 * w if w else 0,
+                 patience),
             ]
         else:
-            plan = [(self._params, False, None, 2 * w if w else 0, patience)]
+            plan = [
+                (self._params, full_mode, full_t32, 2 * w if w else 0,
+                 patience)
+            ]
 
         def make_phase(spec):
-            params, mixed, t32, window, patience_now = spec
-            rate = core.SEG_RATE_F32 if mixed else core.SEG_RATE_F64
+            params, mode, t32, window, patience_now = spec
+            rate = (
+                core.SEG_RATE_F32 if mode != "f64" else core.SEG_RATE_F64
+            )
+            cgi = self._cg_iters if mode == "pcg" else 0
+            cgt = self._cg_tol if mode == "pcg" else 0.0
 
             def make_run_seg(bound):
                 mi = jnp.asarray(bound, jnp.int32)
@@ -492,15 +611,20 @@ class BlockAngularBackend(SolverBackend):
                     return _block_segment(
                         self._tensors, t32, self._lay, self._data, c,
                         jnp.asarray(stop, jnp.int32), mi, mr, rg, params,
-                        buf_cap, window, patience_now, mixed,
+                        buf_cap, window, patience_now, mode, cgi, cgt,
                     )
 
                 return run_seg
 
-            return (
-                make_run_seg, window, patience_now,
-                core.seg_open(cfg.segment_iters, flops / rate),
+            # PCG phases: the worst-case CG sweeps dwarf the FLOP model
+            # and a watchdog overrun is fatal — open with ONE iteration
+            # and let measured-rate adaptation size the rest (same rule
+            # as the dense backend).
+            seg0 = (
+                1 if mode == "pcg"
+                else core.seg_open(cfg.segment_iters, flops / rate)
             )
+            return (make_run_seg, window, patience_now, seg0)
 
         return core.drive_phase_plan(
             [make_phase(s) for s in plan],
@@ -510,6 +634,26 @@ class BlockAngularBackend(SolverBackend):
     def solve_full(self, state: IPMState):
         if core.use_segments(self._cfg.segment_iters, jax.default_backend()):
             return self._solve_segmented(state)
+        if self._pcg and not self._two_phase:
+            # Forced PCG without a phase schedule: ONE full-tol PCG phase
+            # (same plan the segmented path builds for this config, and
+            # the same shape as dense's single-phase PCG branch).
+            return _block_solve_full(
+                self._tensors,
+                self._lay,
+                self._data,
+                state,
+                jnp.asarray(self._reg, self._dtype),
+                self._params,
+                jnp.asarray(self._cfg.max_iter, jnp.int32),
+                jnp.asarray(self._cfg.max_refactor, jnp.int32),
+                jnp.asarray(self._cfg.reg_grow, self._dtype),
+                core.buffer_cap(self._cfg.max_iter),
+                2 * self._cfg.stall_window if self._cfg.stall_window else 0,
+                self._get_tensors32(),
+                self._cg_iters,
+                self._cg_tol,
+            )
         if self._two_phase:
             return _block_solve_two_phase(
                 self._tensors,
@@ -525,6 +669,8 @@ class BlockAngularBackend(SolverBackend):
                 jnp.asarray(self._cfg.reg_grow, self._dtype),
                 core.buffer_cap(2 * self._cfg.max_iter),
                 self._cfg.stall_window,
+                self._cg_iters,
+                self._cg_tol,
             )
         return _block_solve_full(
             self._tensors,

@@ -117,6 +117,61 @@ def _rmatvec_chunked(A, y):
     return acc
 
 
+def _pcg_ops(A, factor_dtype, use_pallas, Af, cg_tol, cg_iters):
+    """factorize/solve closures for the mixed-precision PCG mode.
+
+    The factorization builds only a PRECONDITIONER: f32 assembly (Pallas
+    kernel or plain MXU GEMM on the precast copy) + f32 Cholesky + an
+    explicit triangular inverse, so each preconditioner application is two
+    f32 GEMVs instead of two sequential triangular solves (TPUs pipeline
+    GEMVs; single-rhs TRSV serializes). Accuracy comes from the CG loop,
+    whose operator applies the TRUE f64 ``A·diag(d)·Aᵀ (+reg·diag)``
+    matrix-free via the chunked GEMVs — no f64 O(m²n) assembly and no f64
+    O(m³) Cholesky ever runs, which is what makes the reference-scale
+    10k×50k config (BASELINE.json:9) tractable on emulated-f64 hardware.
+    """
+    m = A.shape[0]
+
+    def factorize(d, reg):
+        df = d.astype(factor_dtype)
+        if use_pallas:
+            from distributedlpsolver_tpu.ops import normal_eq_pallas
+
+            M = normal_eq_pallas(Af, df, out_m=m)
+        else:
+            M = (Af * df[None, :]) @ Af.T
+        diagM = jnp.diagonal(M)
+        # Jacobi (unit-diagonal) symmetric scaling before the f32
+        # factorization: late-IPM diagonals span ~10 orders, and an f32
+        # Cholesky at that spread loses its small pivots' relative
+        # accuracy — which is the preconditioner floor CG then has to
+        # grind through. In the scaled space the relative diagonal
+        # regularization becomes + reg·I exactly.
+        s = jax.lax.rsqrt(jnp.maximum(diagM, jnp.finfo(factor_dtype).tiny))
+        Ms = M * s[:, None] * s[None, :]
+        Ms = Ms + jnp.asarray(reg, M.dtype) * jnp.eye(m, dtype=M.dtype)
+        L = jnp.linalg.cholesky(Ms)
+        Linv = jax.scipy.linalg.solve_triangular(
+            L, jnp.eye(m, dtype=L.dtype), lower=True
+        )
+        return Linv, s, diagM.astype(A.dtype), d, jnp.asarray(reg, A.dtype)
+
+    def solve(factors, rhs):
+        Linv, s, diagM, d, reg = factors
+        regd = reg * diagM
+
+        def op(v):
+            return _matvec_chunked(A, d * _rmatvec_chunked(A, v)) + regd * v
+
+        def prec(r):
+            rs = s * r.astype(factor_dtype)
+            return (s * (Linv.T @ (Linv @ rs))).astype(rhs.dtype)
+
+        return core.pcg_solve(op, prec, rhs, cg_tol, cg_iters)
+
+    return factorize, solve
+
+
 def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
     """Build factorize/solve closures over a (traced) matrix ``A``.
 
@@ -172,8 +227,18 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
     return factorize, solve
 
 
-def _make_ops(A, reg, factor_dtype, refine_steps, use_pallas=False, Af=None):
-    factorize, solve = _cholesky_ops(A, factor_dtype, refine_steps, use_pallas, Af)
+def _make_ops(
+    A, reg, factor_dtype, refine_steps, use_pallas=False, Af=None,
+    cg_iters=0, cg_tol=0.0,
+):
+    if cg_iters > 0:
+        factorize, solve = _pcg_ops(
+            A, factor_dtype, use_pallas, Af, cg_tol, cg_iters
+        )
+    else:
+        factorize, solve = _cholesky_ops(
+            A, factor_dtype, refine_steps, use_pallas, Af
+        )
     return core.LinOps(
         xp=jnp,
         matvec=lambda v: _matvec_chunked(A, v),
@@ -184,22 +249,38 @@ def _make_ops(A, reg, factor_dtype, refine_steps, use_pallas=False, Af=None):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("params", "factor_dtype", "refine_steps", "use_pallas")
+    jax.jit,
+    static_argnames=(
+        "params", "factor_dtype", "refine_steps", "use_pallas", "cg_iters",
+        "cg_tol",
+    ),
 )
 def _dense_step(
-    A, data, state, reg, params, factor_dtype, refine_steps, use_pallas=False, Af=None
+    A, data, state, reg, params, factor_dtype, refine_steps, use_pallas=False,
+    Af=None, cg_iters=0, cg_tol=0.0,
 ):
-    ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
+    ops = _make_ops(
+        A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af,
+        cg_iters, cg_tol,
+    )
     return core.mehrotra_step(ops, data, params, state)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("params", "factor_dtype", "refine_steps", "use_pallas")
+    jax.jit,
+    static_argnames=(
+        "params", "factor_dtype", "refine_steps", "use_pallas", "cg_iters",
+        "cg_tol",
+    ),
 )
 def _dense_start(
-    A, data, reg, params, factor_dtype, refine_steps, use_pallas=False, Af=None
+    A, data, reg, params, factor_dtype, refine_steps, use_pallas=False,
+    Af=None, cg_iters=0, cg_tol=0.0,
 ):
-    ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
+    ops = _make_ops(
+        A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af,
+        cg_iters, cg_tol,
+    )
     return core.starting_point(ops, data, params)
 
 
@@ -207,18 +288,21 @@ def _dense_start(
     jax.jit,
     static_argnames=(
         "params", "factor_dtype", "refine_steps", "buf_cap", "use_pallas",
-        "stall_window",
+        "stall_window", "cg_iters", "cg_tol",
     ),
 )
 def _dense_solve_full(
     A, data, state0, reg0, params, factor_dtype, refine_steps, max_iter, max_refactor, reg_grow,
-    buf_cap, use_pallas=False, Af=None, stall_window=0,
+    buf_cap, use_pallas=False, Af=None, stall_window=0, cg_iters=0, cg_tol=0.0,
 ):
     # max_iter / max_refactor / reg_grow are traced scalars: one compiled
     # executable serves every iteration-limit config (only the bucketed
     # buf_cap is a jit key), so warm-up runs share the timed run's compile.
     def step(state, reg):
-        ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
+        ops = _make_ops(
+            A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af,
+            cg_iters, cg_tol,
+        )
         return core.mehrotra_step(ops, data, params, state)
 
     return core.fused_solve(
@@ -231,13 +315,13 @@ def _dense_solve_full(
     jax.jit,
     static_argnames=(
         "params", "factor_dtype", "refine_steps", "buf_cap", "use_pallas",
-        "stall_window", "patience",
+        "stall_window", "patience", "cg_iters", "cg_tol",
     ),
 )
 def _dense_segment(
     A, data, carry, it_stop, max_iter, max_refactor, reg_grow,
     params, factor_dtype, refine_steps, buf_cap, use_pallas=False, Af=None,
-    stall_window=0, patience=0.0,
+    stall_window=0, patience=0.0, cg_iters=0, cg_tol=0.0,
 ):
     """One bounded continuation of the fused loop (host segmentation —
     see core.drive_segments). ``carry`` is the raw fused_solve carry;
@@ -245,7 +329,10 @@ def _dense_segment(
     per-phase budget)."""
 
     def step(state, reg):
-        ops = _make_ops(A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af)
+        ops = _make_ops(
+            A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af,
+            cg_iters, cg_tol,
+        )
         return core.mehrotra_step(ops, data, params, state)
 
     out = core.fused_solve(
@@ -259,12 +346,14 @@ def _dense_segment(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "params", "params_p1", "refine_steps", "buf_cap", "pallas_p1", "stall_window"
+        "params", "params_p1", "refine_steps", "buf_cap", "pallas_p1",
+        "stall_window", "cg_iters", "cg_tol",
     ),
 )
 def _dense_solve_two_phase(
     A, A32, data, state0, reg0, params, params_p1, max_iter, max_refactor,
     reg_grow, buf_cap, refine_steps, pallas_p1, stall_window,
+    cg_iters=0, cg_tol=0.0,
 ):
     """Mixed-precision fused solve: f32 factorizations (MXU-native) down to
     the handoff tolerance, then f64 warm-started from the same iterate —
@@ -288,7 +377,13 @@ def _dense_solve_two_phase(
         return core.mehrotra_step(ops, data, params_p1, state)
 
     def step64(state, reg):
-        ops = _make_ops(A, reg, A.dtype, refine_steps, False, None)
+        # Full-accuracy phase: either a true-f64 direct factorization, or
+        # (cg_iters > 0) the f32-preconditioned matrix-free PCG mode —
+        # same f64 iterate math, no f64 assembly/Cholesky.
+        if cg_iters > 0:
+            ops = _make_ops(A, reg, f32, 0, pallas_p1, A32, cg_iters, cg_tol)
+        else:
+            ops = _make_ops(A, reg, A.dtype, refine_steps, False, None)
         return core.mehrotra_step(ops, data, params, state)
 
     st1, it1, status1, buf = core.fused_solve(
@@ -420,32 +515,86 @@ class DenseJaxBackend(SolverBackend):
             and config.use_pallas is not False
         )
         self._A32 = None
+        # PCG full-accuracy mode (config.solve_mode): replaces the f64
+        # phase 2 / f64 host-driver steps with f32-preconditioned
+        # matrix-free CG. Single-device only (the chunked dynamic-slice
+        # GEMVs don't shard); auto-on for large two-phase TPU problems
+        # where emulated-f64 assembly/Cholesky is the bottleneck.
+        if config.solve_mode == "pcg":
+            self._pcg = mat_s is None
+        elif config.solve_mode is None:
+            self._pcg = two_phase and mat_s is None and m * n >= (1 << 24)
+        else:
+            self._pcg = False
+        self._cg_iters = config.cg_iters if self._pcg else 0
+        self._cg_tol = config.cg_tol if self._pcg else 0.0
+
+    def _ensure_A32(self):
+        """The f32 (optionally Pallas-padded) copy of A, materialized
+        lazily — the pure-f64 host-driver path never reads it."""
+        if self._A32 is None:
+            if self._pallas_p1:
+                from distributedlpsolver_tpu.ops import pad_for_pallas
+
+                self._A32 = pad_for_pallas(self._A.astype(jnp.float32))
+            else:
+                self._A32 = self._A.astype(jnp.float32)
+        return self._A32
+
+    def _point_spec(self):
+        """(factor_dtype_name, refine, use_pallas, Af, cg_iters, cg_tol)
+        for the per-call entry points (starting_point / iterate).
+
+        PCG mode uses the f32-preconditioner + f64-CG ops everywhere. A
+        two-phase schedule computes the STARTING POINT with the f32 direct
+        factorization too — it is a heuristic, and the f64 assembly +
+        Cholesky it would otherwise pay is exactly the emulated-f64 cost
+        the schedule exists to avoid (at 10k×50k it alone blows the
+        warm-up budget); iterate() keeps full f64 in that mode because the
+        host-driven loop has no second phase to repair f32 error.
+        """
+        if self._pcg:
+            return ("float32", 0, self._pallas_p1, self._ensure_A32(),
+                    self._cg_iters, self._cg_tol)
+        return (self._factor_dtype_name, self._refine, self._use_pallas,
+                self._Af, 0, 0.0)
+
+    def _start_spec(self):
+        if self._two_phase and not self._pcg:
+            return ("float32", 0, self._pallas_p1, self._ensure_A32(), 0, 0.0)
+        return self._point_spec()
 
     def starting_point(self) -> IPMState:
+        fdt, refine, pallas, Af, cgi, cgt = self._start_spec()
         state = _dense_start(
             self._A,
             self._data,
             jnp.asarray(self._reg, self._dtype),
             self._params,
-            self._factor_dtype_name,
-            self._refine,
-            self._use_pallas,
-            self._Af,
+            fdt,
+            refine,
+            pallas,
+            Af,
+            cgi,
+            cgt,
         )
         jax.block_until_ready(state)
         return state
 
     def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
+        fdt, refine, pallas, Af, cgi, cgt = self._point_spec()
         return _dense_step(
             self._A,
             self._data,
             state,
             jnp.asarray(self._reg, self._dtype),
             self._params,
-            self._factor_dtype_name,
-            self._refine,
-            self._use_pallas,
-            self._Af,
+            fdt,
+            refine,
+            pallas,
+            Af,
+            cgi,
+            cgt,
         )
 
     def bump_regularization(self) -> bool:
@@ -457,30 +606,39 @@ class DenseJaxBackend(SolverBackend):
     def _phase_plan(self):
         """Per-phase execution specs for the fused solve: (params,
         factor_dtype_name, refine_steps, use_pallas, Af, stall_window,
-        stall_patience_floor)."""
+        stall_patience_floor, cg_iters, cg_tol)."""
         cfg = self._cfg
         patience = 1e3 * cfg.tol  # near-tol plateaus deserve patience
         w = cfg.stall_window
+        if self._pcg and not self._two_phase:
+            # Forced PCG without a phase schedule: one full-tol PCG phase.
+            fdt, refine, pallas, Af, cgi, cgt = self._point_spec()
+            return [
+                (self._params, fdt, refine, pallas, Af, 2 * w if w else 0,
+                 patience, cgi, cgt)
+            ]
         if not self._two_phase:
             # Final (only) phase gets the same stall semantics as the
             # two-phase finish and the batched backend: window 2·w with
             # the near-tol patience floor.
             return [
                 (self._params, self._factor_dtype_name, self._refine,
-                 self._use_pallas, self._Af, 2 * w if w else 0, patience)
+                 self._use_pallas, self._Af, 2 * w if w else 0, patience,
+                 0, 0.0)
             ]
-        if self._A32 is None:
-            if self._pallas_p1:
-                from distributedlpsolver_tpu.ops import pad_for_pallas
-
-                self._A32 = pad_for_pallas(self._A.astype(jnp.float32))
-            else:  # plain-XLA f32 assembly (pallas opted out/unsupported)
-                self._A32 = self._A.astype(jnp.float32)
+        A32 = self._ensure_A32()
         params_p1 = cfg.phase1_params()
+        if self._pcg:
+            # Phase 2 = f32-preconditioned matrix-free PCG at full tol.
+            phase2 = (self._params, "float32", 0, self._pallas_p1, A32,
+                      2 * w if w else 0, patience, self._cg_iters,
+                      self._cg_tol)
+        else:
+            phase2 = (self._params, self._dtype.name, self._refine, False,
+                      None, 2 * w if w else 0, patience, 0, 0.0)
         return [
-            (params_p1, "float32", 0, self._pallas_p1, self._A32, w, 0.0),
-            (self._params, self._dtype.name, self._refine, False, None,
-             2 * w if w else 0, patience),
+            (params_p1, "float32", 0, self._pallas_p1, A32, w, 0.0, 0, 0.0),
+            phase2,
         ]
 
     def _solve_segmented(self, state: IPMState):
@@ -500,8 +658,10 @@ class DenseJaxBackend(SolverBackend):
         flops = 2.0 * m * m * n + m**3 / 3.0  # per-iteration FLOP estimate
 
         def make_phase(spec):
-            params, fdt, refine, pallas, Af, window, patience = spec
+            (params, fdt, refine, pallas, Af, window, patience, cgi,
+             cgt) = spec
             rate = core.SEG_RATE_F32 if fdt == "float32" else core.SEG_RATE_F64
+            est = flops / rate
 
             def make_run_seg(bound):
                 mi = jnp.asarray(bound, jnp.int32)
@@ -510,15 +670,20 @@ class DenseJaxBackend(SolverBackend):
                     return _dense_segment(
                         self._A, self._data, c, jnp.asarray(stop, jnp.int32),
                         mi, mr, rg, params, fdt, refine, buf_cap, pallas, Af,
-                        window, patience,
+                        window, patience, cgi, cgt,
                     )
 
                 return run_seg
 
-            return (
-                make_run_seg, window, patience,
-                core.seg_open(cfg.segment_iters, flops / rate),
-            )
+            # A PCG phase's true per-iteration cost is dominated by the
+            # worst-case CG sweeps (up to 6 solves × cg_iters matrix-free
+            # operator applications), which the FLOP model above cannot
+            # see — and a watchdog overrun mid-phase is fatal, not slow
+            # (observed: a 32-iteration opening PCG segment crashed the
+            # tunneled worker). Open with ONE iteration and let the
+            # measured-rate adaptation in drive_segments size the rest.
+            seg0 = 1 if cgi else core.seg_open(cfg.segment_iters, est)
+            return (make_run_seg, window, patience, seg0)
 
         return core.drive_phase_plan(
             [make_phase(s) for s in self._phase_plan()],
@@ -530,7 +695,7 @@ class DenseJaxBackend(SolverBackend):
             return self._solve_segmented(state)
         if self._two_phase:
             cfg = self._cfg
-            self._phase_plan()  # materializes A32
+            self._ensure_A32()
             params_p1 = cfg.replace(
                 tol=max(cfg.tol, cfg.phase1_tol)
             ).step_params()
@@ -549,6 +714,30 @@ class DenseJaxBackend(SolverBackend):
                 self._refine,
                 self._pallas_p1,
                 self._cfg.stall_window,
+                self._cg_iters,
+                self._cg_tol,
+            )
+        if self._pcg:
+            # Forced PCG without a two-phase schedule (e.g. CPU tests):
+            # one full-tol PCG phase through the single-phase fused loop.
+            fdt, refine, pallas, Af, cgi, cgt = self._point_spec()
+            return _dense_solve_full(
+                self._A,
+                self._data,
+                state,
+                jnp.asarray(self._reg, self._dtype),
+                self._params,
+                fdt,
+                refine,
+                jnp.asarray(self._cfg.max_iter, jnp.int32),
+                jnp.asarray(self._cfg.max_refactor, jnp.int32),
+                jnp.asarray(self._cfg.reg_grow, self._dtype),
+                core.buffer_cap(self._cfg.max_iter),
+                pallas,
+                Af,
+                2 * self._cfg.stall_window if self._cfg.stall_window else 0,
+                cgi,
+                cgt,
             )
         return _dense_solve_full(
             self._A,

@@ -53,6 +53,26 @@ class SolverConfig:
     # factor_dtype and refine_steps == 0.
     use_pallas: Optional[bool] = None
     refine_steps: int = 0  # normal-equations-level refinement sweeps per solve
+    # Full-accuracy solve mode of the dense TPU path. "direct" = the f64
+    # factorization phase 2; "pcg" = f32-Cholesky-preconditioned conjugate
+    # gradient whose operator applies A·diag(d)·Aᵀ matrix-free in f64 (two
+    # chunked GEMVs per CG step) — no f64 assembly or Cholesky ever runs,
+    # which is what makes reference-scale dense (10k×50k, BASELINE.json:9)
+    # tractable on emulated-f64 hardware. None = auto: "pcg" on
+    # single-device TPU two-phase placement above ~16M matrix entries.
+    solve_mode: Optional[str] = None
+    cg_iters: int = 100  # PCG iteration cap per Newton solve
+    cg_tol: float = 1e-11  # PCG relative-residual target
+
+    def __post_init__(self):
+        if self.solve_mode not in (None, "direct", "pcg"):
+            # A typo ("PCG", "cg") silently selecting the direct path
+            # would re-enable the emulated-f64 work the mode exists to
+            # avoid — reject it here like the use_pallas checks do.
+            raise ValueError(
+                f"solve_mode must be None, 'direct', or 'pcg'; "
+                f"got {self.solve_mode!r}"
+            )
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
     # Ruiz-equilibrate the interior form before solving (presolve scaling;
     # convergence is then tested in the scaled space, standard practice).

@@ -189,6 +189,53 @@ def _centrality_backoff(xp, state, hub, dirs, ap_max, ad_max, ncomp, gamma):
     return aps[idx], ads[idx]
 
 
+def pcg_solve(op, prec, rhs, tol, max_iter):
+    """Preconditioned conjugate gradient, fully on-device (jax only).
+
+    Shared by the PCG solve modes of the dense and block backends: ``op``
+    is the full-precision matrix-free normal-equations operator, ``prec``
+    the (typically f32-factorization-based) preconditioner. Terminates at
+    relative residual ``tol`` or ``max_iter`` iterations.
+
+    A broken preconditioner (f32 Cholesky breakdown → NaN factor) makes
+    the loop exit on its non-finite guard with x still at the FINITE zero
+    initial guess; returning that silently would feed a zero direction to
+    the step and bypass the driver's bad-step → regularization-escalation
+    recovery (observed at 2048×10240). The failure is propagated as NaN
+    exactly like a direct Cholesky solve would.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    norm0 = jnp.linalg.norm(rhs)
+    thresh = tol * norm0
+
+    x0 = jnp.zeros_like(rhs)
+    z0 = prec(rhs)
+    carry0 = (x0, rhs, z0, rhs @ z0, jnp.asarray(0, jnp.int32))
+
+    def cond(carry):
+        x, r, p, rz, it = carry
+        return (it < max_iter) & (jnp.linalg.norm(r) > thresh) & jnp.isfinite(rz)
+
+    def body(carry):
+        x, r, p, rz, it = carry
+        Ap = op(p)
+        denom = p @ Ap
+        alpha = rz / jnp.where(denom != 0, denom, 1.0)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        z = prec(r)
+        rz_new = r @ z
+        beta = rz_new / jnp.where(rz != 0, rz, 1.0)
+        p = z + beta * p
+        return (x, r, p, rz_new, it + 1)
+
+    x, r, p, rz, it = jax.lax.while_loop(cond, body, carry0)
+    bad = ~(jnp.isfinite(rz) & jnp.all(jnp.isfinite(x)))
+    return jnp.where(bad, jnp.asarray(jnp.nan, x.dtype), x)
+
+
 def residual_norms(ops: LinOps, data: ProblemData, state: IPMState):
     """Relative primal/dual infeasibility, gap, and objectives of a state."""
     xp = ops.xp

@@ -1,0 +1,49 @@
+"""Properly-timed primitives at reference scale (inputs varied per rep to
+defeat any remote execution caching)."""
+import sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+from distributedlpsolver_tpu.backends import dense as D
+from distributedlpsolver_tpu.ops import normal_eq_pallas, pad_for_pallas
+
+m, n = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 else (10000, 50000)
+rng = np.random.default_rng(0)
+print(f"shape {m}x{n}", flush=True)
+
+# Build an SPD M once on device from a thin factor (avoid 800MB host xfer)
+B64 = jnp.asarray(rng.standard_normal((m, 2048)) / 45.0, dtype=jnp.float64)
+mk = jax.jit(lambda B, eps: B @ B.T + (1.0 + eps) * jnp.eye(m, dtype=B.dtype))
+rhs = jnp.asarray(rng.standard_normal(m), dtype=jnp.float64)
+
+def tme(label, fn, argf, reps=3):
+    t0 = time.perf_counter(); r = jax.block_until_ready(fn(*argf(0))); t1 = time.perf_counter()
+    ts = []
+    for i in range(1, reps + 1):
+        t2 = time.perf_counter(); r = jax.block_until_ready(fn(*argf(i))); ts.append(time.perf_counter() - t2)
+    print(f"{label}: compile+first={t1-t0:.1f}s steady={min(ts):.3f}s", flush=True)
+    return r
+
+M = jax.block_until_ready(mk(B64, 0.0))
+chol = jax.jit(jnp.linalg.cholesky)
+L64 = tme("f64 cholesky m=%d" % m, chol, lambda i: (mk(B64, 1e-7 * i),))
+cs = jax.jit(lambda L, r: jax.scipy.linalg.cho_solve((L, True), r))
+tme("f64 cho_solve 1rhs", cs, lambda i: (L64, rhs + i), reps=3)
+
+chol32 = jax.jit(lambda M: jnp.linalg.cholesky(M.astype(jnp.float32)))
+L32 = tme("f32 cholesky", chol32, lambda i: (mk(B64, 1e-7 * i),))
+cs32 = jax.jit(lambda L, r: jax.scipy.linalg.cho_solve((L, True), r.astype(jnp.float32)))
+tme("f32 cho_solve 1rhs", cs32, lambda i: (L32, rhs + i), reps=3)
+del M
+
+# assembly pieces at m x n
+A64 = jnp.asarray(rng.standard_normal((m, n)) / np.sqrt(n), dtype=jnp.float64)
+Af = pad_for_pallas(A64.astype(jnp.float32))
+d64 = jnp.asarray(10.0 ** rng.uniform(-5, 5, size=n), dtype=jnp.float64)
+pasm = jax.jit(lambda Af, d: normal_eq_pallas(Af, d.astype(jnp.float32), out_m=m))
+tme("pallas f32 assembly", pasm, lambda i: (Af, d64 + i))
+gemv = jax.jit(lambda v: D._matvec_chunked(A64, d64 * D._rmatvec_chunked(A64, v)))
+tme("f64 chunked GEMV pair", gemv, lambda i: (rhs + i,), reps=5)
+asm64 = jax.jit(lambda d: D._normal_eq_chunked(A64, d))
+tme("f64 chunked assembly", asm64, lambda i: (d64 + i,), reps=1)
+print("PROBE DONE", flush=True)

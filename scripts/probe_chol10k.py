@@ -1,0 +1,34 @@
+"""Time emulated-f64 Cholesky + triangular solves at m=10000 on the TPU,
+plus the Kahan-candidate costs: this number decides the phase-2 design
+for the 10k x 50k reference config."""
+import sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+
+m = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+rng = np.random.default_rng(0)
+# SPD with spread ~1e10
+B = rng.standard_normal((m, m + 64)) / np.sqrt(m)
+d = 10.0 ** rng.uniform(-5, 5, size=m + 64)
+M = (B * d) @ B.T + 1e-6 * np.eye(m)
+M64 = jnp.asarray(M, dtype=jnp.float64)
+rhs = jnp.asarray(rng.standard_normal(m), dtype=jnp.float64)
+
+def tme(label, fn, *args, reps=2):
+    t0 = time.perf_counter(); r = jax.block_until_ready(fn(*args)); t1 = time.perf_counter()
+    ts = []
+    for _ in range(reps):
+        t2 = time.perf_counter(); r = jax.block_until_ready(fn(*args)); ts.append(time.perf_counter()-t2)
+    print(f"{label}: compile+first={t1-t0:.1f}s steady={min(ts):.3f}s", flush=True)
+    return r
+
+chol = jax.jit(jnp.linalg.cholesky)
+L = tme("f64 cholesky", chol, M64)
+cs = jax.jit(lambda L, r: jax.scipy.linalg.cho_solve((L, True), r))
+tme("f64 cho_solve 1 rhs", cs, L, rhs, reps=3)
+chol32 = jax.jit(lambda M: jnp.linalg.cholesky(M.astype(jnp.float32)))
+L32 = tme("f32 cholesky (from f64 M)", chol32, M64)
+tri = jax.jit(lambda L: jax.scipy.linalg.solve_triangular(L, jnp.eye(L.shape[0], dtype=L.dtype), lower=True))
+tme("f32 triangular inverse", tri, L32)
+print("PROBE DONE", flush=True)

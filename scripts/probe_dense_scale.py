@@ -1,0 +1,70 @@
+"""Measure the primitive costs that decide the 10k x 50k dense design.
+
+Run on the real TPU. Times (compile separated from steady-state):
+  1. f32 Pallas normal-eq assembly at the probe shape
+  2. f32 Cholesky + explicit triangular inverse at m
+  3. f64 chunked GEMV pair (the PCG engine cost)
+  4. f32-assembly error vs f64 chunked assembly (preconditioner quality)
+  5. f32 triangular-solve (cho_solve) single-rhs latency, for comparison
+"""
+import sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+from distributedlpsolver_tpu.backends import dense as D
+from distributedlpsolver_tpu.ops import normal_eq_pallas, pad_for_pallas, supports_pallas
+
+shape = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 else (4096, 20480)
+m, n = shape
+print(f"probe shape m={m} n={n}; devices={jax.devices()}", flush=True)
+
+rng = np.random.default_rng(0)
+A64 = jnp.asarray(rng.standard_normal((m, n)) / np.sqrt(n), dtype=jnp.float64)
+d64 = jnp.asarray(rng.uniform(1e-4, 1e4, size=n), dtype=jnp.float64)
+A32p = pad_for_pallas(A64.astype(jnp.float32))
+d32 = d64.astype(jnp.float32)
+
+def tme(label, fn, *args, reps=3):
+    t0 = time.perf_counter(); r = jax.block_until_ready(fn(*args)); t1 = time.perf_counter()
+    ts = []
+    for _ in range(reps):
+        t2 = time.perf_counter(); r = jax.block_until_ready(fn(*args)); ts.append(time.perf_counter() - t2)
+    print(f"{label}: compile+first={t1-t0:.2f}s steady={min(ts)*1e3:.1f}ms", flush=True)
+    return r
+
+# 1. f32 pallas assembly
+pallas_asm = jax.jit(lambda Af, d: normal_eq_pallas(Af, d, out_m=m))
+M32 = tme("pallas f32 assembly", pallas_asm, A32p, d32)
+M32 = M32 + jnp.diag(1e-8 * jnp.diagonal(M32))
+
+# 2. f32 cholesky; explicit inverse of L
+chol32 = jax.jit(jnp.linalg.cholesky)
+L32 = tme("f32 cholesky", chol32, M32)
+tri_inv = jax.jit(lambda L: jax.scipy.linalg.solve_triangular(L, jnp.eye(m, dtype=L.dtype), lower=True))
+Linv = tme("f32 triangular inverse", tri_inv, L32)
+
+# 3. f64 chunked GEMV pair: v -> A (d * (A^T y)) (the CG operator)
+def cg_op(y):
+    return D._matvec_chunked(A64, d64 * D._rmatvec_chunked(A64, y))
+y0 = jnp.asarray(rng.standard_normal(m), dtype=jnp.float64)
+op_j = jax.jit(cg_op)
+tme("f64 chunked GEMV pair (CG operator)", op_j, y0, reps=5)
+
+# precond apply via Linv GEMVs (f32)
+prec = jax.jit(lambda r: (Linv.T @ (Linv @ r.astype(jnp.float32))).astype(jnp.float64))
+tme("precond apply (2 f32 GEMV via Linv)", prec, y0, reps=5)
+
+# 5. cho_solve single rhs latency
+cs = jax.jit(lambda L, r: jax.scipy.linalg.cho_solve((L, True), r))
+tme("f32 cho_solve single rhs", cs, L32, y0.astype(jnp.float32), reps=5)
+
+# 4. f32 assembly error vs f64 chunked assembly (skip at huge shape)
+if m * n <= (1 << 27):
+    asm64 = jax.jit(lambda A, d: D._normal_eq_chunked(A, d))
+    M64 = tme("f64 chunked assembly", asm64, A64, d64, reps=1)
+    err = jnp.max(jnp.abs(M32.astype(jnp.float64) - jnp.diag(1e-8*jnp.diagonal(M32)).astype(jnp.float64) - M64)) 
+    rel = err / jnp.max(jnp.abs(M64))
+    dg = jnp.max(jnp.abs(jnp.diagonal(M32).astype(jnp.float64) / (1+1e-8) - jnp.diagonal(M64)) / jnp.abs(jnp.diagonal(M64)))
+    print(f"f32 vs f64 assembly: max abs err={float(err):.3e} rel={float(rel):.3e} diag rel={float(dg):.3e}", flush=True)
+print("PROBE DONE", flush=True)

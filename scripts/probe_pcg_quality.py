@@ -1,0 +1,41 @@
+"""Isolate the PCG solve quality: f32 precond + f64 matrix-free CG vs truth.
+
+For a synthetic IPM-like d with spread 10^s, measure the relative
+residual of one PCG solve at increasing spreads, on whatever platform
+jax picks (run with JAX_PLATFORMS=cpu for the oracle, default for TPU).
+"""
+import sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+from distributedlpsolver_tpu.backends import dense as D
+from distributedlpsolver_tpu.ops import normal_eq_pallas, pad_for_pallas, supports_pallas
+
+m, n = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 else (1024, 4096)
+rng = np.random.default_rng(0)
+A = jnp.asarray(rng.standard_normal((m, n)) / np.sqrt(n), dtype=jnp.float64)
+use_pallas = supports_pallas(jnp.float32)
+Af = pad_for_pallas(A.astype(jnp.float32)) if use_pallas else A.astype(jnp.float32)
+print(f"m={m} n={n} platform={jax.default_backend()} pallas={use_pallas}", flush=True)
+
+factorize, solve = D._pcg_ops(A, jnp.dtype(jnp.float32), use_pallas, Af, 1e-11, 200)
+rhs = jnp.asarray(rng.standard_normal(m), dtype=jnp.float64)
+
+@jax.jit
+def one(d, reg, rhs):
+    f = factorize(d, reg)
+    x = solve(f, rhs)
+    # true f64 residual of the returned solve
+    regd = reg * f[1]
+    r = rhs - (D._matvec_chunked(A, d * D._rmatvec_chunked(A, x)) + regd * x)
+    return x, jnp.linalg.norm(r) / jnp.linalg.norm(rhs)
+
+for spread in [2, 4, 6, 8, 10]:
+    logd = rng.uniform(-spread/2, spread/2, size=n)
+    d = jnp.asarray(10.0 ** logd, dtype=jnp.float64)
+    for reg in [1e-10, 1e-8]:
+        t0 = time.perf_counter()
+        x, rr = one(d, jnp.asarray(reg, jnp.float64), rhs)
+        rr = float(jax.block_until_ready(rr)); dt = time.perf_counter() - t0
+        print(f"spread=1e{spread} reg={reg:g}: relres={rr:.3e} ({dt:.1f}s)", flush=True)
+print("DONE", flush=True)

@@ -1,0 +1,21 @@
+"""TPU A/B: default two-phase (f64 phase 2) vs PCG phase 2 at a given shape."""
+import sys, time
+import numpy as np
+sys.path.insert(0, "/root/repo")
+from distributedlpsolver_tpu.ipm import solve
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+
+m, n = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 else (1024, 4096)
+modes = sys.argv[3].split(",") if len(sys.argv) > 3 else ["pcg", "direct"]
+p = random_dense_lp(m, n, seed=0)
+print(f"shape {m}x{n}", flush=True)
+for mode in modes:
+    t0 = time.perf_counter()
+    r = solve(p, backend="tpu", solve_mode=mode, max_iter=3)  # warm-up: compile
+    t_warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r = solve(p, backend="tpu", solve_mode=mode)
+    t = time.perf_counter() - t0
+    print(f"mode={mode}: {r.status.name} obj={r.objective:.6f} iters={r.iterations} "
+          f"gap={r.rel_gap:.2e} pinf={r.pinf:.2e} dinf={r.dinf:.2e} "
+          f"solve={r.solve_time:.2f}s total={t:.2f}s warmup={t_warm:.1f}s", flush=True)

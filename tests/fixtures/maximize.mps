@@ -1,0 +1,19 @@
+* Golden fixture: OBJSENSE section-body form + negative RHS on a G row.
+* Hand-derived optimum: A=3, B=1, objective 14.0 (maximized).
+NAME MAXI
+OBJSENSE
+    MAX
+ROWS
+ N  PROFIT
+ L  CAP
+ G  FLOOR
+COLUMNS
+    A  PROFIT  3.0  CAP  2.0
+    A  FLOOR  1.0
+    B  PROFIT  5.0  CAP  4.0
+RHS
+    R  CAP  10.0  FLOOR  -2.0
+BOUNDS
+ UP B1  A  3.0
+ UP B1  B  2.0
+ENDATA

@@ -1,0 +1,37 @@
+* Golden fixture: every classic MPS quirk in one file.
+* Hand-derived optimum: x = (-1.5, 1.5, 0.5, 1.5), objective 12.0
+* (see tests/test_fixtures.py for the derivation).
+NAME QUIRKS
+ROWS
+ N  COST
+ N  FREEROW
+ L  LIM1
+ G  LIM2
+ E  EQ1
+ E  EQ2
+COLUMNS
+    X1  COST  1.0  LIM1  1.0
+    X1  LIM2  1.0
+    X1  FREEROW  3.0
+    X2  COST  2.0  EQ1  1.0
+    X2  LIM1  1.0
+    X3  EQ1  1.0  EQ2  1.0
+    X3  COST  0.5
+    X3  COST  0.5
+    X4  EQ2  1.0  LIM2  1.0
+RHS
+    RHS1  COST  -10.0
+    RHS1  LIM1  4.0
+    RHS1  EQ1  2.0
+    RHS1  EQ2  3.0
+RANGES
+    RNG1  LIM1  4.0
+    RNG1  LIM2  3.0
+    RNG1  EQ1  1.5
+    RNG1  EQ2  -1.0
+BOUNDS
+ UP BND1  X1  -1.0
+ MI BND1  X2
+ UP BND1  X2  5.0
+ FX BND1  X4  1.5
+ENDATA

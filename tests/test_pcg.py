@@ -1,0 +1,142 @@
+"""Mixed-precision PCG solve mode of the dense backend.
+
+The mode replaces the f64 direct factorization with an f32-Cholesky
+preconditioner + matrix-free CG whose operator applies A·diag(d)·Aᵀ in
+the iterate dtype (backends/dense.py:_pcg_ops). It exists for
+reference-scale dense problems (BASELINE.json:9) where emulated-f64
+assembly/Cholesky is intractable; these tests pin its algebra on CPU
+(where f64 is native) — full-tolerance agreement with HiGHS through the
+single-phase, two-phase, and segmented execution paths.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import solve
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import Status
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+from distributedlpsolver_tpu.models.problem import to_interior_form
+
+from tests.oracle import highs_on_general
+
+
+def _check_optimal(r, p):
+    assert r.status == Status.OPTIMAL
+    assert r.rel_gap <= 1e-8 and r.pinf <= 1e-8 and r.dinf <= 1e-8
+    ref = highs_on_general(p)
+    np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
+
+
+def test_pcg_single_phase_full_tol():
+    p = random_dense_lp(60, 180, seed=0)
+    from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+
+    be = DenseJaxBackend()
+    r = solve(p, backend=be, solve_mode="pcg")
+    assert be._pcg and not be._two_phase  # CPU platform: no phase schedule
+    _check_optimal(r, p)
+
+
+def test_pcg_as_phase2_of_two_phase(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+
+    p = random_dense_lp(40, 100, seed=1)
+    be = DenseJaxBackend()
+    r = solve(p, backend=be, solve_mode="pcg", use_pallas=False)
+    assert be._pcg and be._two_phase
+    _check_optimal(r, p)
+
+
+def test_pcg_segmented(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    p = random_dense_lp(40, 100, seed=2)
+    r = solve(p, backend="tpu", solve_mode="pcg", use_pallas=False,
+              segment_iters=2)
+    _check_optimal(r, p)
+
+
+def test_pcg_auto_resolution():
+    from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+    from distributedlpsolver_tpu.backends.sharded import ShardedJaxBackend
+
+    inf = to_interior_form(random_dense_lp(20, 50, seed=3))
+    be = DenseJaxBackend()
+    be.setup(inf, SolverConfig())
+    assert not be._pcg  # auto: small problem / CPU platform
+
+    # Sharded placement can't run the chunked matrix-free operator; a
+    # forced "pcg" must quietly fall back to the direct path.
+    bes = ShardedJaxBackend()
+    bes.setup(to_interior_form(random_dense_lp(24, 64, seed=4)),
+              SolverConfig(solve_mode="pcg"))
+    assert not bes._pcg
+
+
+def test_pcg_host_driver_path():
+    # fused_loop=False exercises starting_point + per-iteration iterate()
+    # through the PCG ops.
+    p = random_dense_lp(30, 90, seed=5)
+    r = solve(p, backend="tpu", solve_mode="pcg", fused_loop=False)
+    _check_optimal(r, p)
+
+
+class TestBlockPCG:
+    """PCG mode of the block-angular Schur backend (same design, arrow
+    structure: f32 block/linking factorization preconditioner +
+    full-precision matrix-free CG through the block tensors)."""
+
+    def test_block_pcg_matches_highs(self):
+        from distributedlpsolver_tpu.models.generators import block_angular_lp
+        from distributedlpsolver_tpu.backends.block_angular import (
+            BlockAngularBackend,
+        )
+
+        p = block_angular_lp(6, 24, 48, 12, seed=3, sparse=False)
+        be = BlockAngularBackend()
+        r = solve(p, backend=be, solve_mode="pcg", scale=False)
+        assert be._pcg
+        assert r.status == Status.OPTIMAL
+        assert r.rel_gap <= 1e-8
+        ref = highs_on_general(p)
+        np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
+
+    def test_block_pcg_segmented(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        from distributedlpsolver_tpu.models.generators import block_angular_lp
+
+        p = block_angular_lp(4, 16, 32, 8, seed=4, sparse=False)
+        r = solve(p, backend="block", solve_mode="pcg", scale=False,
+                  segment_iters=2)
+        assert r.status == Status.OPTIMAL
+        ref = highs_on_general(p)
+        np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
+
+    def test_block_pcg_on_mesh(self):
+        # The arrow-structure PCG is pure einsum + vector work, so it
+        # shards over the K axis like the direct path.
+        from distributedlpsolver_tpu.models.generators import block_angular_lp
+        from distributedlpsolver_tpu.backends.block_angular import (
+            BlockAngularBackend,
+        )
+        from distributedlpsolver_tpu.parallel import make_mesh
+
+        p = block_angular_lp(8, 12, 24, 8, seed=5, sparse=False)
+        mesh = make_mesh(devices=jax.devices()[:8])
+        r = solve(p, backend=BlockAngularBackend(mesh=mesh),
+                  solve_mode="pcg", scale=False)
+        assert r.status == Status.OPTIMAL
+        ref = highs_on_general(p)
+        np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
+
+    def test_block_pcg_host_driver(self):
+        from distributedlpsolver_tpu.models.generators import block_angular_lp
+
+        p = block_angular_lp(4, 16, 32, 8, seed=6, sparse=False)
+        r = solve(p, backend="block", solve_mode="pcg", scale=False,
+                  fused_loop=False)
+        assert r.status == Status.OPTIMAL
+        ref = highs_on_general(p)
+        np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
