@@ -31,10 +31,35 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _solve_timed(problem, backend: str, **cfg):
+def _solve_timed(problem, backend: str, _retries: int = 2, **cfg):
+    """solve() with retry on transient tunnel/runtime failures.
+
+    The tunneled accelerator occasionally drops a request
+    ("remote_compile: response body closed", worker restarts); the
+    persistent XLA compile cache makes a retry cheap, so long benches
+    should never sink on one transient (VERDICT.md round 1, item 9).
+    """
     from distributedlpsolver_tpu.ipm import solve
 
-    return solve(problem, backend=backend, **cfg)
+    last = None
+    for attempt in range(_retries + 1):
+        try:
+            return solve(problem, backend=backend, **cfg)
+        except Exception as e:  # jax runtime errors don't share one base
+            msg = str(e)
+            transient = any(
+                s in msg
+                for s in (
+                    "remote_compile", "UNAVAILABLE", "response body closed",
+                    "crashed or restarted", "DEADLINE_EXCEEDED",
+                )
+            )
+            if not transient or attempt == _retries:
+                raise
+            last = e
+            _log(f"  transient failure (attempt {attempt + 1}): {msg[:200]}")
+            time.sleep(5.0)
+    raise last  # unreachable
 
 
 def _headline_problem(args):
@@ -66,11 +91,14 @@ def _bench_one(problem, backend: str, baseline: str | None, **cfg):
     r = _solve_timed(problem, backend, **cfg)
     _log(f"  {backend}: " + r.summary())
     row = {
-        "backend": backend,
+        "backend": getattr(r, "backend", backend),
         "time_s": round(r.solve_time, 4),
         "iters": int(r.iterations),
         "iters_per_sec": round(r.iters_per_sec, 2),
         "status": r.status.value,
+        # Every row records the tolerance it was solved to — rows at a
+        # looser tol (e.g. first-order configs) must say so (VERDICT.md).
+        "tol": cfg.get("tol", 1e-8),
         "vs_baseline": 1.0,
     }
     if baseline and baseline in available_backends() and baseline != backend:
@@ -88,7 +116,15 @@ def _bench_one(problem, backend: str, baseline: str | None, **cfg):
 
 
 def _bench_batched(quick: bool):
-    """Config 5 (BASELINE.json:11): 1024 independent (128, 512) LPs."""
+    """Config 5 (BASELINE.json:11): 1024 independent (128, 512) LPs.
+
+    The baseline is the reference's natural shape for this config — one
+    LP at a time through the host/CPU path ("one LP per rank", looped).
+    Solving all 1024 serially would dominate the bench budget, so a
+    random subsample is measured and extrapolated (the problems are
+    i.i.d. draws from one generator, so the mean is unbiased); the row
+    records the sample size and per-problem mean alongside the estimate.
+    """
     from distributedlpsolver_tpu.backends.batched import solve_batched
     from distributedlpsolver_tpu.models.generators import random_batched_lp
 
@@ -100,15 +136,48 @@ def _bench_batched(quick: bool):
     dt = time.perf_counter() - t0
     ok = sum(1 for s in res.status if s.value == "optimal")
     _log(f"  batched: {B} LPs in {res.solve_time:.3f}s, {ok}/{B} optimal")
-    return {
+    row = {
         "backend": "batched(vmap)",
         "time_s": round(res.solve_time, 4),
         "problems": B,
         "problems_per_sec": round(B / max(res.solve_time, 1e-9), 1),
         "optimal": ok,
         "wall_s": round(dt, 4),
-        "vs_baseline": 1.0,
+        "tol": 1e-8,
+        # null until the baseline measurement actually succeeds — a
+        # fabricated neutral 1.0 would read as "measured, no speedup".
+        "vs_baseline": None,
     }
+    try:
+        sample = min(16, B) if quick else min(64, B)
+        rng = __import__("numpy").random.default_rng(7)
+        idx = rng.choice(B, size=sample, replace=False)
+        probs = [batch.problem(int(i)) for i in idx]
+        _solve_timed(probs[0], "cpu-native")  # warm any lazy init
+        t0 = time.perf_counter()
+        base_ok = 0
+        for p in probs:
+            rb = _solve_timed(p, "cpu-native")
+            base_ok += rb.status.value == "optimal"
+        t_sample = time.perf_counter() - t0
+        per = t_sample / sample
+        est = per * B
+        row.update(
+            baseline_backend="cpu-native (loop, one LP at a time)",
+            baseline_sample=sample,
+            baseline_sample_optimal=base_ok,
+            baseline_per_problem_s=round(per, 4),
+            baseline_time_est_s=round(est, 2),
+            vs_baseline=round(est / max(res.solve_time, 1e-9), 2),
+        )
+        _log(
+            f"  baseline cpu-native loop: {sample} sampled, "
+            f"{per:.3f}s/problem -> est {est:.1f}s for {B} "
+            f"({row['vs_baseline']}x)"
+        )
+    except Exception as e:  # baseline must never sink the bench
+        _log(f"  batched baseline failed: {e}")
+    return row
 
 
 def run_suite(args) -> list:
@@ -129,10 +198,14 @@ def run_suite(args) -> list:
         _log(json.dumps(row))
 
     # 1. afiro-class tiny dense (BASELINE.json:7) — 27x51, general form.
-    _log("[1/5] afiro-class dense 27x51")
+    # Measured through --backend auto: structure/size-aware dispatch is
+    # the production answer for a dispatch-bound tiny LP (a tunneled
+    # accelerator pays ~0.5 s where the CPU path takes ~10 ms); the row
+    # records which backend auto picked.
+    _log("[1/5] afiro-class dense 27x51 (auto dispatch)")
     add(
         "afiro-like general LP 27x51",
-        _bench_one(random_general_lp(27, 51, seed=0), accel, "cpu"),
+        _bench_one(random_general_lp(27, 51, seed=0), "auto", "cpu"),
     )
 
     # 2. pds-02/pds-10-class block-angular (BASELINE.json:8) — the

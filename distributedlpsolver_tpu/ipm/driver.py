@@ -241,6 +241,31 @@ def _finalize(
     host = be.to_host(state)
     if scaling is not None:
         host = scaling.unscale_state(host)
+    certificate = None
+    if status in (
+        Status.PRIMAL_INFEASIBLE,
+        Status.DUAL_INFEASIBLE,
+        Status.ITERATION_LIMIT,
+        Status.STALLED,
+        Status.NUMERICAL_ERROR,
+    ):
+        # Farkas-ray extraction (ipm/certificates.py): a passing
+        # certificate is a mathematical proof, so it may UPGRADE a
+        # heuristic/indeterminate status — never the other way around.
+        try:
+            from distributedlpsolver_tpu.ipm import certificates as _certs
+
+            certificate = _certs.extract_certificate(
+                inf, host, status.value
+            )
+        except Exception:  # certificates must never sink a solve
+            certificate = None
+        if certificate is not None and certificate.certified:
+            status = (
+                Status.PRIMAL_INFEASIBLE
+                if certificate.kind == "primal_infeasible"
+                else Status.DUAL_INFEASIBLE
+            )
     x_t = np.asarray(host.x, dtype=np.float64)
     obj_min = inf.objective(x_t)
     y = np.asarray(host.y, dtype=np.float64)
@@ -279,6 +304,7 @@ def _finalize(
         name=inf.name,
         y=y,
         s=s,
+        certificate=certificate,
     )
 
 

@@ -99,6 +99,11 @@ class IPMResult:
     # For a raw InteriorForm input they are the interior-form duals.
     y: Optional[np.ndarray] = None
     s: Optional[np.ndarray] = None
+    # Farkas certificate for non-optimal outcomes (ipm/certificates.py),
+    # stated in the solved interior-form space; None when no candidate
+    # ray was extractable. ``certificate.certified`` distinguishes a
+    # checkable proof from the divergence heuristic alone.
+    certificate: Optional[object] = None
 
     @property
     def iters_per_sec(self) -> float:

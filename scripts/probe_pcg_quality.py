@@ -25,8 +25,8 @@ rhs = jnp.asarray(rng.standard_normal(m), dtype=jnp.float64)
 def one(d, reg, rhs):
     f = factorize(d, reg)
     x = solve(f, rhs)
-    # true f64 residual of the returned solve
-    regd = reg * f[1]
+    # true f64 residual of the returned solve; f = (Linv, s, diagM, d, reg)
+    regd = reg * f[2]
     r = rhs - (D._matvec_chunked(A, d * D._rmatvec_chunked(A, x)) + regd * x)
     return x, jnp.linalg.norm(r) / jnp.linalg.norm(rhs)
 
