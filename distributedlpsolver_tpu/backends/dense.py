@@ -554,13 +554,17 @@ class DenseJaxBackend(SolverBackend):
         self._A32 = None
         # PCG full-accuracy mode (config.solve_mode): replaces the f64
         # phase 2 / f64 host-driver steps with f32-preconditioned
-        # matrix-free CG. Single-device only (the chunked dynamic-slice
-        # GEMVs don't shard); auto-on for large two-phase TPU problems
-        # where emulated-f64 assembly/Cholesky is the bottleneck.
+        # matrix-free CG, auto-on for large two-phase TPU problems where
+        # emulated-f64 assembly/Cholesky is the bottleneck.
+        # PCG is mesh-compatible: the chunked matrix-free operator and
+        # the replicated f32 preconditioner both compile under GSPMD, and
+        # dropping the f64 M/L halves the replicated per-device footprint
+        # (the first cut at VERDICT.md round 1 item 8; a fully distributed
+        # panel Cholesky remains future work).
         if config.solve_mode == "pcg":
-            self._pcg = mat_s is None
+            self._pcg = True
         elif config.solve_mode is None:
-            self._pcg = two_phase and mat_s is None and m * n >= (1 << 24)
+            self._pcg = two_phase and m * n >= (1 << 24)
         else:
             self._pcg = False
         self._cg_iters = config.cg_iters if self._pcg else 0
@@ -598,7 +602,8 @@ class DenseJaxBackend(SolverBackend):
 
     def _start_spec(self):
         if self._two_phase and not self._pcg:
-            return ("float32", 0, self._pallas_p1, self._ensure_A32(), 0, 0.0)
+            return ("float32", 0, self._pallas_p1, self._ensure_A32(), 0,
+                    0.0)
         return self._point_spec()
 
     def starting_point(self) -> IPMState:

@@ -63,16 +63,6 @@ class SolverConfig:
     solve_mode: Optional[str] = None
     cg_iters: int = 100  # PCG iteration cap per Newton solve
     cg_tol: float = 1e-11  # PCG relative-residual target
-
-    def __post_init__(self):
-        if self.solve_mode not in (None, "direct", "pcg"):
-            # A typo ("PCG", "cg") silently selecting the direct path
-            # would re-enable the emulated-f64 work the mode exists to
-            # avoid — reject it here like the use_pallas checks do.
-            raise ValueError(
-                f"solve_mode must be None, 'direct', or 'pcg'; "
-                f"got {self.solve_mode!r}"
-            )
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
     # Ruiz-equilibrate the interior form before solving (presolve scaling;
     # convergence is then tested in the scaled space, standard practice).
@@ -101,6 +91,16 @@ class SolverConfig:
     checkpoint_path: Optional[str] = None  # iterate checkpoint (SURVEY.md §5.4)
     checkpoint_every: int = 0  # 0 = disabled
     profile_dir: Optional[str] = None  # jax.profiler trace dir (SURVEY.md §5.1)
+
+    def __post_init__(self):
+        if self.solve_mode not in (None, "direct", "pcg"):
+            # A typo ("PCG", "cg") silently selecting the direct path
+            # would re-enable the emulated-f64 work the mode exists to
+            # avoid — reject it here like the use_pallas checks do.
+            raise ValueError(
+                f"solve_mode must be None, 'direct', or 'pcg'; "
+                f"got {self.solve_mode!r}"
+            )
 
     def replace(self, **kw) -> "SolverConfig":
         return dataclasses.replace(self, **kw)

@@ -233,6 +233,15 @@ def pcg_solve(op, prec, rhs, tol, max_iter):
 
     x, r, p, rz, it = jax.lax.while_loop(cond, body, carry0)
     bad = ~(jnp.isfinite(rz) & jnp.all(jnp.isfinite(x)))
+    # A cap-limited CG that did NOT meaningfully reduce the residual is a
+    # failed solve, not an approximate one: the resulting direction is
+    # noise with finite entries, and silently returning it poisons the
+    # iterate while μ keeps shrinking (observed: pinf freezes at 1e-2 and
+    # the divergence heuristic misfires). The failure line is 1e-3
+    # relative OR 10× the requested tol, whichever is looser — so a
+    # caller running with a deliberately loose cg_tol still gets its
+    # approximate directions, and only order-of-magnitude misses NaN.
+    bad = bad | (jnp.linalg.norm(r) > jnp.maximum(1e-3 * norm0, 10.0 * thresh))
     return jnp.where(bad, jnp.asarray(jnp.nan, x.dtype), x)
 
 

@@ -60,19 +60,78 @@ def test_pcg_segmented(monkeypatch):
 
 def test_pcg_auto_resolution():
     from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
-    from distributedlpsolver_tpu.backends.sharded import ShardedJaxBackend
 
     inf = to_interior_form(random_dense_lp(20, 50, seed=3))
     be = DenseJaxBackend()
     be.setup(inf, SolverConfig())
     assert not be._pcg  # auto: small problem / CPU platform
 
-    # Sharded placement can't run the chunked matrix-free operator; a
-    # forced "pcg" must quietly fall back to the direct path.
-    bes = ShardedJaxBackend()
-    bes.setup(to_interior_form(random_dense_lp(24, 64, seed=4)),
-              SolverConfig(solve_mode="pcg"))
-    assert not bes._pcg
+
+def test_pcg_sharded_on_mesh():
+    # PCG under GSPMD: the chunked matrix-free operator and the
+    # replicated f32 preconditioner compile over the mesh; dropping the
+    # f64 factorization halves the replicated per-device footprint
+    # (VERDICT.md round 1 item 8, first cut).
+    from distributedlpsolver_tpu.backends.sharded import ShardedJaxBackend
+    from distributedlpsolver_tpu.parallel import make_mesh
+
+    p = random_dense_lp(48, 128, seed=4)
+    be = ShardedJaxBackend(mesh=make_mesh(devices=jax.devices()[:8]))
+    r = solve(p, backend=be, solve_mode="pcg")
+    assert be._pcg
+    _check_optimal(r, p)
+
+
+def test_pcg_memory_analysis_beats_direct_f64():
+    # Compile-time per-device memory of one full-accuracy step at a
+    # mid-size shape: the PCG step (f32 preconditioner + matrix-free CG)
+    # must allocate less temp memory than the direct-f64 step it replaces
+    # (which materializes M and its Cholesky factor in f64). This is the
+    # documented memory crossover for the replicated-factorization relief.
+    import jax.numpy as jnp
+    from distributedlpsolver_tpu.backends import dense as D
+    from distributedlpsolver_tpu.ipm.config import SolverConfig as SC
+
+    m, n = 512, 1536
+    inf = to_interior_form(random_dense_lp(m, n, seed=5))
+    A = jnp.asarray(np.asarray(inf.A), dtype=jnp.float64)
+    from distributedlpsolver_tpu.ipm import core as C
+
+    data = C.make_problem_data(
+        jnp, jnp.asarray(inf.c), jnp.asarray(inf.b), jnp.asarray(inf.u),
+        jnp.float64,
+    )
+    params = SC().step_params()
+
+    from distributedlpsolver_tpu.ipm.state import IPMState
+
+    key_state = IPMState(
+        x=jnp.ones(inf.n), y=jnp.zeros(inf.m), s=jnp.ones(inf.n),
+        w=jnp.ones(inf.n), z=jnp.zeros(inf.n),
+    )
+    reg = jnp.asarray(1e-10, jnp.float64)
+
+    def mem(fn, *args, **kw):
+        lowered = jax.jit(
+            fn, static_argnames=tuple(kw.keys())
+        ).lower(*args, **kw)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    def direct_step(A, data, state, reg):
+        ops = D._make_ops(A, reg, jnp.dtype(jnp.float64), 0, False, None)
+        return C.mehrotra_step(ops, data, params, state)
+
+    A32 = A.astype(jnp.float32)
+
+    def pcg_step(A, A32, data, state, reg):
+        ops = D._make_ops(
+            A, reg, jnp.dtype(jnp.float32), 0, False, A32, 100, 1e-11
+        )
+        return C.mehrotra_step(ops, data, params, state)
+
+    m_direct = mem(direct_step, A, data, key_state, reg)
+    m_pcg = mem(pcg_step, A, A32, data, key_state, reg)
+    assert m_pcg < m_direct, (m_pcg, m_direct)
 
 
 def test_pcg_host_driver_path():
