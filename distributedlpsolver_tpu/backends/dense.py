@@ -75,17 +75,41 @@ def _normal_eq_chunked(A, d):
     return jax.lax.fori_loop(0, nblk, ibody, jnp.zeros((m, m), A.dtype))
 
 
+# Above this many entries, f64 GEMVs on TPU run as elementwise
+# multiply + reduction instead of a dot: XLA's emulated-f64 DOT lowering
+# has pathological compile times at large operands (observed: 271 s for
+# ONE 2048×10240 f64 GEMV; >90 min for the tiled 10000×50000 pair),
+# while elementwise double-double ops compile in seconds and fuse with
+# the reduce — the arithmetic is identical (exact f64 products, exact
+# f64 accumulation), only the lowering differs.
+_EW_F64_GEMV_ENTRIES = 1 << 24
+
+
+def _use_ew_f64(A) -> bool:
+    return (
+        A.dtype == jnp.float64
+        and A.shape[0] * A.shape[1] > _EW_F64_GEMV_ENTRIES
+        and jax.default_backend() == "tpu"
+    )
+
+
 def _matvec_chunked(A, v):
-    """``A @ v`` via row tiles (bounds emulated-f64 operand temps)."""
+    """``A @ v`` via row tiles (bounds emulated-f64 operand temps); the
+    per-tile contraction is a dot, or multiply+sum on the ew-f64 path."""
     m, n = A.shape
-    if m * n <= _CHUNK_ENTRIES:
+    ew = _use_ew_f64(A)
+    if not ew and m * n <= _CHUNK_ENTRIES:
         return A @ v
+    if ew:
+        contract = lambda Ai: jnp.sum(Ai * v[None, :], axis=1)
+    else:
+        contract = lambda Ai: Ai @ v
     tile = _tile_rows(m, n)
     nblk = -(-m // tile)
 
     def body(ib, out):
         i0 = ib * tile
-        blk = jax.lax.dynamic_slice_in_dim(A, i0, tile, 0) @ v
+        blk = contract(jax.lax.dynamic_slice_in_dim(A, i0, tile, 0))
         return jax.lax.dynamic_update_slice(out, blk, (i0,))
 
     return jax.lax.fori_loop(0, nblk, body, jnp.zeros((m,), A.dtype))
@@ -96,11 +120,17 @@ def _rmatvec_chunked(A, y):
 
     The clamped-slice trick is NOT safe for an accumulating loop (the last
     partial tile would double-count), so the ragged tail is handled as a
-    separate masked term.
+    separate term. The per-tile contraction is a dot, or multiply+sum on
+    the ew-f64 path.
     """
     m, n = A.shape
-    if m * n <= _CHUNK_ENTRIES:
+    ew = _use_ew_f64(A)
+    if not ew and m * n <= _CHUNK_ENTRIES:
         return A.T @ y
+    if ew:
+        contract = lambda Ai, yi: jnp.sum(Ai * yi[:, None], axis=0)
+    else:
+        contract = lambda Ai, yi: Ai.T @ yi
     tile = _tile_rows(m, n)
     nfull = m // tile
 
@@ -108,12 +138,12 @@ def _rmatvec_chunked(A, y):
         i0 = ib * tile
         Ai = jax.lax.dynamic_slice_in_dim(A, i0, tile, 0)
         yi = jax.lax.dynamic_slice_in_dim(y, i0, tile, 0)
-        return acc + Ai.T @ yi
+        return acc + contract(Ai, yi)
 
     acc = jax.lax.fori_loop(0, nfull, body, jnp.zeros((n,), A.dtype))
     rem = m - nfull * tile
     if rem:
-        acc = acc + A[nfull * tile :].T @ y[nfull * tile :]
+        acc = acc + contract(A[nfull * tile :], y[nfull * tile :])
     return acc
 
 
@@ -248,15 +278,34 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
         # Newton direction's primal-residual reduction.
         M = M + jnp.diag(jnp.asarray(reg, M.dtype) * jnp.diagonal(M))
         L = jnp.linalg.cholesky(M if M.dtype == factor_dtype else M.astype(factor_dtype))
+        if explicit_inv:
+            # Large-m f32 path on TPU: one paneled inverse per
+            # factorization turns every subsequent triangular solve into
+            # two GEMVs — XLA's single-rhs TRSV serializes badly at this
+            # scale, and each factorization serves ≥6 solves.
+            return _tri_inv_paneled(L), M
         return L, M
 
+    m_ = A.shape[0]
+    explicit_inv = (
+        jnp.dtype(factor_dtype) == jnp.dtype(jnp.float32)
+        and m_ >= 2048
+        and jax.default_backend() == "tpu"
+    )
+
+    def _apply_inv(factors, rhs32):
+        if explicit_inv:
+            Linv, _ = factors
+            return Linv.T @ (Linv @ rhs32)
+        L, _ = factors
+        return jax.scipy.linalg.cho_solve((L, True), rhs32)
+
     def solve(factors, rhs):
-        L, M = factors
-        lo = jax.scipy.linalg.cho_solve((L, True), rhs.astype(factor_dtype))
-        y = lo.astype(rhs.dtype)
+        y = _apply_inv(factors, rhs.astype(factor_dtype)).astype(rhs.dtype)
+        M = factors[1]
         for _ in range(refine_steps):
             r = rhs - _matvec_chunked(M, y)
-            y = y + jax.scipy.linalg.cho_solve((L, True), r.astype(factor_dtype)).astype(
+            y = y + _apply_inv(factors, r.astype(factor_dtype)).astype(
                 rhs.dtype
             )
         return y

@@ -580,10 +580,16 @@ def drive_segments(
     its active-problem count into the ``best_err`` slot and stops when the
     tail is small enough to hand to per-problem cleanup).
     """
+    import os as _os
     import time as _time
 
     import numpy as _np
 
+    # Progress trace for long runs; conventional 0/1 contract ("0",
+    # "false", "" all disable).
+    trace = _os.environ.get("TPULP_SEG_VERBOSE", "").lower() not in (
+        "", "0", "false", "no",
+    )
     carry = carry0
     seg = max(int(seg_init), 1)
     # Entry it/status are read from the packed meta the CALLER already has
@@ -601,6 +607,14 @@ def drive_segments(
         dt = _time.perf_counter() - t0
         it, status = int(meta[0]), int(meta[1])
         best_err, since = float(meta[2]), int(meta[3])
+        if trace:
+            import sys as _sys
+
+            print(
+                f"[seg] it={it} status={status} best_err={best_err:.3e} "
+                f"since={since} dt={dt:.1f}s seg={seg}",
+                file=_sys.stderr, flush=True,
+            )
         if (
             stall_window
             and since > stall_window
