@@ -202,6 +202,15 @@ def _pcg_ops(A, factor_dtype, use_pallas, Af, cg_tol, cg_iters):
     m = A.shape[0]
 
     def factorize(d, reg):
+        # Everything in this preconditioner build must run at true-f32
+        # matmul precision: the TPU DEFAULT lowers f32 matmuls (including
+        # the ones inside cholesky and the paneled TRSM) to bf16
+        # multiplies with ~1e-3 relative error — the Pallas kernel guards
+        # itself with Precision.HIGHEST, but the factorization wouldn't.
+        with jax.default_matmul_precision("highest"):
+            return _factorize_impl(d, reg)
+
+    def _factorize_impl(d, reg):
         df = d.astype(factor_dtype)
         if use_pallas:
             from distributedlpsolver_tpu.ops import normal_eq_pallas
@@ -220,8 +229,20 @@ def _pcg_ops(A, factor_dtype, use_pallas, Af, cg_tol, cg_iters):
         Ms = M * s[:, None] * s[None, :]
         Ms = Ms + jnp.asarray(reg, M.dtype) * jnp.eye(m, dtype=M.dtype)
         L = jnp.linalg.cholesky(Ms)
-        Linv = _tri_inv_paneled(L)
-        return Linv, s, diagM.astype(A.dtype), d, jnp.asarray(reg, A.dtype)
+        # The preconditioner APPLY must run in the iterate dtype: an f32
+        # apply injects ~1e-7 nonlinear rounding noise per call, which
+        # breaks plain CG's recurrences at late-IPM conditioning — the
+        # true residual stagnates around 1e-7 while the recurrence
+        # residual keeps "converging" (observed at 2048×10240: pinf
+        # frozen at 2.7e-7; raising the CG budget made it WORSE, classic
+        # stagnation drift). The FACTOR may be f32-accurate — cast it up
+        # once per factorization so the apply is an exact fixed linear
+        # operator and CG behaves like textbook PCG.
+        Linv = _tri_inv_paneled(L).astype(A.dtype)
+        return (
+            Linv, s.astype(A.dtype), diagM.astype(A.dtype), d,
+            jnp.asarray(reg, A.dtype),
+        )
 
     def solve(factors, rhs):
         Linv, s, diagM, d, reg = factors
@@ -231,12 +252,68 @@ def _pcg_ops(A, factor_dtype, use_pallas, Af, cg_tol, cg_iters):
             return _matvec_chunked(A, d * _rmatvec_chunked(A, v)) + regd * v
 
         def prec(r):
-            rs = s * r.astype(factor_dtype)
-            return (s * (Linv.T @ (Linv @ rs))).astype(rhs.dtype)
+            z = _matvec_chunked(Linv, s * r)
+            return s * _rmatvec_chunked(Linv, z)
 
         return core.pcg_solve(op, prec, rhs, cg_tol, cg_iters)
 
     return factorize, solve
+
+
+# ----------------------------------------------------------------------
+# Endgame phase (huge-m full-precision finish, host-driven).
+#
+# At reference scale (10k×50k) one full-precision iteration exceeds the
+# tunneled execution watchdog if run as a single device program, and the
+# f32-preconditioned PCG phase cannot finish the last ~1.5 orders of
+# magnitude (the f32 assembly carries no information about M's smallest
+# eigen-subspace once κ(M) > 1/ε_f32 — observed as a hard pinf floor at
+# ~3e-7). The endgame splits ONE Mehrotra iteration into bounded
+# dispatches — the tiled full-precision assembly, the factorization,
+# then the step with the factor injected — so no single device program
+# holds the whole iteration (VERDICT.md round 1 item 1: "segment at the
+# factorization level"). The assembly dispatch is the longest at ~40 s
+# estimated for 10k×50k; if a future shape pushes it past the watchdog,
+# split it into row-range pieces next.
+# ----------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _endgame_assemble(A, data, state, params):
+    """Full-precision M = A·diag(d)·Aᵀ with d derived from the state
+    exactly as mehrotra_step will. MUST go through the double-tiled
+    contraction: a plain emulated-f64 GEMM at reference scale asks XLA
+    for an 8×full-size f32 operand-split temp (observed: 15.07 GB for
+    one half-assembly — the round-1 OOM, reproduced)."""
+    d = core.scaling_d(state, data, params)
+    return _normal_eq_chunked(A, d)
+
+
+@jax.jit
+def _endgame_factor(M, reg):
+    M = M + jnp.diag(jnp.asarray(reg, M.dtype) * jnp.diagonal(M))
+    return jnp.linalg.cholesky(M)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _endgame_step(A, data, state, L, params):
+    """One Mehrotra step with the factorization INJECTED (computed by the
+    preceding dispatches); solves run through the full-precision factor."""
+
+    def factorize(d):
+        return L
+
+    def solve(Lf, rhs):
+        return jax.scipy.linalg.cho_solve((Lf, True), rhs)
+
+    ops = core.LinOps(
+        xp=jnp,
+        matvec=lambda v: _matvec_chunked(A, v),
+        rmatvec=lambda v: _rmatvec_chunked(A, v),
+        factorize=factorize,
+        solve=solve,
+    )
+    return core.mehrotra_step(ops, data, params, state)
 
 
 def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
@@ -613,7 +690,9 @@ class DenseJaxBackend(SolverBackend):
         if config.solve_mode == "pcg":
             self._pcg = True
         elif config.solve_mode is None:
-            self._pcg = two_phase and m * n >= (1 << 24)
+            # Auto: engage PCG only where the fused f64 finish gets heavy
+            # (the measured two-phase direct path wins below this).
+            self._pcg = two_phase and m * n >= (1 << 26)
         else:
             self._pcg = False
         self._cg_iters = config.cg_iters if self._pcg else 0
@@ -719,18 +798,117 @@ class DenseJaxBackend(SolverBackend):
             ]
         A32 = self._ensure_A32()
         params_p1 = cfg.phase1_params()
+        m, n = self._A.shape
         if self._pcg:
-            # Phase 2 = f32-preconditioned matrix-free PCG at full tol.
-            phase2 = (self._params, "float32", 0, self._pallas_p1, A32,
-                      2 * w if w else 0, patience, self._cg_iters,
-                      self._cg_tol)
-        else:
-            phase2 = (self._params, self._dtype.name, self._refine, False,
-                      None, 2 * w if w else 0, patience, 0, 0.0)
+            # Phase 2 = f32-preconditioned matrix-free PCG at full tol
+            # with NO stall patience: the f32-assembled preconditioner
+            # carries no information about M's smallest eigen-subspace
+            # once kappa(M) > 1/eps_f32, so PCG hits a hard floor around
+            # 1e-6..3e-7 (observed) — it must hand over at the stall, and
+            # a full-precision phase finishes: a fused f64 phase below
+            # the endgame threshold, the host-driven endgame above it.
+            phases = [
+                (params_p1, "float32", 0, self._pallas_p1, A32, w, 0.0,
+                 0, 0.0),
+                (self._params, "float32", 0, self._pallas_p1, A32, w, 0.0,
+                 self._cg_iters, self._cg_tol),
+            ]
+            if m * n < self._ENDGAME_ENTRIES:
+                phases.append(
+                    (self._params, self._dtype.name, self._refine, False,
+                     None, 2 * w if w else 0, patience, 0, 0.0)
+                )
+            return phases
+        phase2 = (self._params, self._dtype.name, self._refine, False,
+                  None, 2 * w if w else 0, patience, 0, 0.0)
         return [
             (params_p1, "float32", 0, self._pallas_p1, A32, w, 0.0, 0, 0.0),
             phase2,
         ]
+
+    # m·n above which the full-precision finish runs as the host-driven
+    # endgame (one iteration split across dispatches) instead of a fused
+    # f64 phase: a single fused iteration's assembly alone would exceed
+    # the execution watchdog.
+    _ENDGAME_ENTRIES = 1 << 28
+
+    def _endgame_loop(self, state: IPMState, it0: int, buf):
+        """Host-driven full-precision finish for huge m (see the endgame
+        program docstrings above). Returns (state, it, status, buf)."""
+        import time as _time
+
+        cfg = self._cfg
+        params = self._params
+        trace = core.seg_trace_enabled()
+        buf = np.asarray(buf)[:it0] if it0 else np.zeros((0, core.N_STAT))
+        rows = []
+        it = it0
+        status = core.STATUS_MAXITER
+        best = np.inf
+        since = 0
+        reg = max(self._reg, 1e-12)
+        budget = cfg.max_iter
+        refactor = 0
+        k = 0
+        while k < budget:
+            t0 = _time.perf_counter()
+            M = _endgame_assemble(self._A, self._data, state, params)
+            jax.block_until_ready(M)  # bound each dispatch's device time
+            L = _endgame_factor(M, jnp.asarray(reg, self._dtype))
+            jax.block_until_ready(L)
+            del M
+            new_state, stats = _endgame_step(
+                self._A, self._data, state, L, params,
+            )
+            bad = bool(stats.bad)
+            dt = _time.perf_counter() - t0
+            if bad:
+                refactor += 1
+                reg *= cfg.reg_grow
+                if trace:
+                    import sys as _sys
+
+                    print(
+                        f"[endgame] it={it} bad step, reg->{reg:.1e} "
+                        f"({dt:.1f}s)",
+                        file=_sys.stderr, flush=True,
+                    )
+                if refactor > cfg.max_refactor or reg > 1e-2:
+                    status = core.STATUS_NUMERR
+                    break
+                continue
+            refactor = 0
+            state = new_state
+            it += 1
+            k += 1
+            row = [
+                float(np.asarray(getattr(stats, f)))
+                for f in (
+                    "mu", "gap", "rel_gap", "pinf", "dinf", "pobj", "dobj",
+                    "alpha_p", "alpha_d", "sigma",
+                )
+            ]
+            rows.append(row)
+            err = max(row[2], row[3], row[4])  # rel_gap, pinf, dinf
+            if trace:
+                import sys as _sys
+
+                print(
+                    f"[endgame] it={it} err={err:.3e} ({dt:.1f}s)",
+                    file=_sys.stderr, flush=True,
+                )
+            if row[2] <= cfg.tol and row[3] <= cfg.tol and row[4] <= cfg.tol:
+                status = core.STATUS_OPTIMAL
+                break
+            if err < 0.9 * best:
+                best, since = err, 0
+            else:
+                since += 1
+                if cfg.stall_window and since > 2 * cfg.stall_window:
+                    status = core.STATUS_STALL
+                    break
+        buf = np.concatenate([buf, np.asarray(rows)]) if rows else buf
+        return state, it, jnp.asarray(status, jnp.int32), buf
 
     def _solve_segmented(self, state: IPMState):
         """Host-driven segmented fused solve: per-phase specs feed the
@@ -741,7 +919,7 @@ class DenseJaxBackend(SolverBackend):
         # Each phase gets its own max_iter budget (matching the batched
         # path), so a tiny-max_iter warm-up still reaches and compiles
         # every phase; the buffer covers the 2-phase worst case.
-        n_phases = 2 if self._two_phase else 1
+        n_phases = 1 + (1 if self._two_phase else 0) + (1 if self._pcg else 0)
         buf_cap = core.buffer_cap(n_phases * cfg.max_iter)
         mr = jnp.asarray(cfg.max_refactor, jnp.int32)
         rg = jnp.asarray(cfg.reg_grow, dtype)
@@ -776,10 +954,20 @@ class DenseJaxBackend(SolverBackend):
             seg0 = 1 if cgi else core.seg_open(cfg.segment_iters, est)
             return (make_run_seg, window, patience, seg0)
 
-        return core.drive_phase_plan(
+        st, it, status, buf = core.drive_phase_plan(
             [make_phase(s) for s in self._phase_plan()],
             state, jnp.asarray(self._reg, dtype), cfg.max_iter, buf_cap, dtype,
         )
+        m, n = self._A.shape
+        if (
+            self._pcg
+            and m * n >= self._ENDGAME_ENTRIES
+            and int(np.asarray(status))
+            in (core.STATUS_STALL, core.STATUS_MAXITER)
+        ):
+            st, it, status, buf = self._endgame_loop(st, int(np.asarray(it)),
+                                                    buf)
+        return st, it, status, buf
 
     def solve_full(self, state: IPMState):
         if core.use_segments(self._cfg.segment_iters, jax.default_backend()):

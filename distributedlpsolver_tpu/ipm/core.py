@@ -262,6 +262,18 @@ def residual_norms(ops: LinOps, data: ProblemData, state: IPMState):
     return pinf, dinf, gap, rel_gap, pobj, dobj, mu
 
 
+def scaling_d(state: IPMState, data: ProblemData, cfg: StepParams):
+    """The normal-equations diagonal ``d = 1/(s/x + z/w + reg_primal)``.
+
+    One definition shared by :func:`mehrotra_step` and backends that
+    precompute factorizations outside the step program (the dense
+    endgame phase splits one iteration across dispatches and must form
+    the SAME d the step will use)."""
+    x, y, s, w, z = state
+    dinv = s / x + data.hub * z / w + cfg.reg_primal
+    return 1.0 / dinv
+
+
 def mehrotra_step(
     ops: LinOps, data: ProblemData, cfg: StepParams, state: IPMState
 ):
@@ -282,8 +294,7 @@ def mehrotra_step(
     mu = (x @ s + (hub * w) @ z) / data.ncomp
 
     # Diagonal scaling and one factorization, shared by both solves.
-    dinv = s / x + hub * z / w + cfg.reg_primal
-    d = 1.0 / dinv
+    d = scaling_d(state, data, cfg)
     factors = ops.factorize(d)
 
     # Predictor (affine-scaling) direction.
@@ -555,6 +566,16 @@ def fused_solve(
     return state, it, status, buf
 
 
+def seg_trace_enabled() -> bool:
+    """Whether TPULP_SEG_VERBOSE asks for live progress lines
+    (conventional 0/1 contract: "", "0", "false", "no" disable)."""
+    import os
+
+    return os.environ.get("TPULP_SEG_VERBOSE", "").lower() not in (
+        "", "0", "false", "no",
+    )
+
+
 def drive_segments(
     run_seg, carry0, max_iter, stall_window, seg_init=16, target_s=15.0,
     stall_patience_floor=0.0, it0_status0=(0, STATUS_RUNNING),
@@ -585,11 +606,7 @@ def drive_segments(
 
     import numpy as _np
 
-    # Progress trace for long runs; conventional 0/1 contract ("0",
-    # "false", "" all disable).
-    trace = _os.environ.get("TPULP_SEG_VERBOSE", "").lower() not in (
-        "", "0", "false", "no",
-    )
+    trace = seg_trace_enabled()
     carry = carry0
     seg = max(int(seg_init), 1)
     # Entry it/status are read from the packed meta the CALLER already has

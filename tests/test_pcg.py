@@ -199,3 +199,21 @@ class TestBlockPCG:
         assert r.status == Status.OPTIMAL
         ref = highs_on_general(p)
         np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
+
+
+def test_endgame_finishes_after_pcg_floor(monkeypatch):
+    # Force the endgame route (threshold dropped below the test size):
+    # phase 1 f32 -> phase 2 PCG (stops at its floor or optimal) ->
+    # host-driven endgame iterations with the factorization computed in
+    # separate dispatches. Must reach full 1e-8 optimality.
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+
+    p = random_dense_lp(48, 128, seed=6)
+    be = DenseJaxBackend()
+    monkeypatch.setattr(DenseJaxBackend, "_ENDGAME_ENTRIES", 1)
+    r = solve(p, backend=be, solve_mode="pcg", use_pallas=False)
+    assert be._pcg
+    _check_optimal(r, p)
+    # the history must be contiguous through the endgame append
+    assert len(r.history) == r.iterations
