@@ -47,12 +47,21 @@ def _solve_timed(problem, backend: str, _retries: int = 2, **cfg):
             return solve(problem, backend=backend, **cfg)
         except Exception as e:  # jax runtime errors don't share one base
             msg = str(e)
+            # Specific tunnel-failure phrases retry regardless of type; the
+            # broad gRPC status tokens (UNAVAILABLE / DEADLINE_EXCEEDED)
+            # only count when they come from an XLA/PJRT runtime error —
+            # substring-matching them against arbitrary exception text
+            # would silently retry deterministic bugs whose wrapped
+            # message happens to contain one.
             transient = any(
                 s in msg
                 for s in (
-                    "remote_compile", "UNAVAILABLE", "response body closed",
-                    "crashed or restarted", "DEADLINE_EXCEEDED",
+                    "remote_compile", "response body closed",
+                    "crashed or restarted",
                 )
+            ) or (
+                type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+                and any(s in msg for s in ("UNAVAILABLE", "DEADLINE_EXCEEDED"))
             )
             if not transient or attempt == _retries:
                 raise

@@ -626,10 +626,11 @@ class BlockAngularBackend(SolverBackend):
             )
             return (make_run_seg, window, patience_now, seg0)
 
-        return core.drive_phase_plan(
+        st, it, status, buf, _ = core.drive_phase_plan(
             [make_phase(s) for s in plan],
             state, jnp.asarray(self._reg, dtype), cfg.max_iter, buf_cap, dtype,
         )
+        return st, it, status, buf
 
     def solve_full(self, state: IPMState):
         if core.use_segments(self._cfg.segment_iters, jax.default_backend()):

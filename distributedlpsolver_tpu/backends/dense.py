@@ -832,9 +832,22 @@ class DenseJaxBackend(SolverBackend):
     # the execution watchdog.
     _ENDGAME_ENTRIES = 1 << 28
 
-    def _endgame_loop(self, state: IPMState, it0: int, buf):
+    def _endgame_loop(self, state: IPMState, it0: int, buf, reg0=None):
         """Host-driven full-precision finish for huge m (see the endgame
-        program docstrings above). Returns (state, it, status, buf)."""
+        program docstrings above). Returns (state, it, status, buf).
+
+        ``reg0`` seeds the regularization from wherever the preceding
+        fused phases escalated it (threaded out of the segment carry by
+        drive_phase_plan) — restarting from self._reg would replay
+        known-bad factorizations at a full assembly+factor round each.
+        The seed is capped at 1e-6 and decays one reg_grow notch per good
+        step: phase-2 escalations answer *f32-preconditioner* breakdowns
+        the f64 factorization does not share, and reg here only ever
+        grows on bad steps, so an uncapped carry-over could pin the
+        finish above tol permanently. Per-dispatch wall times land in
+        ``self.endgame_timings`` (one dict per factor+step attempt);
+        scripts/run_dense10k.py folds them into the timing artifact.
+        """
         import time as _time
 
         cfg = self._cfg
@@ -846,23 +859,54 @@ class DenseJaxBackend(SolverBackend):
         status = core.STATUS_MAXITER
         best = np.inf
         since = 0
-        reg = max(self._reg, 1e-12)
+        reg_base = max(self._reg, 1e-12)  # user-configured floor
+        reg = (
+            max(reg_base, min(reg0, 1e-6)) if reg0 is not None else reg_base
+        )
         budget = cfg.max_iter
         refactor = 0
+        self.endgame_timings = timings = []
+        # Holding M across the step amortizes bad-step retries (only the
+        # factorization sees the escalated reg), but costs an extra m²·8
+        # bytes of HBM concurrent with L and the step's working set —
+        # affordable at the 10k target (M+L ≈ 1.6 GB of 16 GB), not at
+        # m ≳ 24k where two f64 m×m buffers alone approach the chip.
+        # Above the cutoff, fall back to re-assembling on (rare) retries.
+        m = self._A.shape[0]
+        hold_m = m <= 16384
         k = 0
         while k < budget:
             t0 = _time.perf_counter()
+            # M depends only on the iterate, NOT on reg — assemble once
+            # per state; re-running the assembly dispatch (the longest,
+            # ~40 s at 10k×50k) per bad-step retry would be pure waste.
             M = _endgame_assemble(self._A, self._data, state, params)
             jax.block_until_ready(M)  # bound each dispatch's device time
-            L = _endgame_factor(M, jnp.asarray(reg, self._dtype))
-            jax.block_until_ready(L)
-            del M
-            new_state, stats = _endgame_step(
-                self._A, self._data, state, L, params,
-            )
-            bad = bool(stats.bad)
-            dt = _time.perf_counter() - t0
-            if bad:
+            t_asm = _time.perf_counter() - t0
+            failed = False
+            while True:
+                t1 = _time.perf_counter()
+                L = _endgame_factor(M, jnp.asarray(reg, self._dtype))
+                jax.block_until_ready(L)
+                t_fac = _time.perf_counter() - t1
+                if not hold_m:
+                    del M
+                    M = None
+                t1 = _time.perf_counter()
+                new_state, stats = _endgame_step(
+                    self._A, self._data, state, L, params,
+                )
+                bad = bool(stats.bad)  # blocks on the step dispatch
+                t_step = _time.perf_counter() - t1
+                timings.append({
+                    "it": it, "t_assemble": round(t_asm, 3),
+                    "t_factor": round(t_fac, 3),
+                    "t_step": round(t_step, 3),
+                    "bad": bad, "reg": float(reg),
+                })
+                t_asm = 0.0  # amortized: no re-assembly on retries
+                if not bad:
+                    break
                 refactor += 1
                 reg *= cfg.reg_grow
                 if trace:
@@ -870,14 +914,31 @@ class DenseJaxBackend(SolverBackend):
 
                     print(
                         f"[endgame] it={it} bad step, reg->{reg:.1e} "
-                        f"({dt:.1f}s)",
+                        f"(factor {t_fac:.1f}s + step {t_step:.1f}s)",
                         file=_sys.stderr, flush=True,
                     )
                 if refactor > cfg.max_refactor or reg > 1e-2:
-                    status = core.STATUS_NUMERR
+                    failed = True
                     break
-                continue
+                if M is None:  # big-m path dropped M before the step
+                    t1 = _time.perf_counter()
+                    M = _endgame_assemble(self._A, self._data, state,
+                                          params)
+                    jax.block_until_ready(M)
+                    t_asm = _time.perf_counter() - t1
+            if M is not None:
+                del M
+            dt = _time.perf_counter() - t0
+            if failed:
+                status = core.STATUS_NUMERR
+                break
             refactor = 0
+            # One-notch decay per good step: a retry-escalated reg is
+            # evidence about THAT iterate's system, not the remaining
+            # trajectory's; without decay the perturbation compounds into
+            # a permanent tol floor (reg only ever grows above). Floored
+            # at the user-configured base, never below it.
+            reg = max(reg / cfg.reg_grow, reg_base)
             state = new_state
             it += 1
             k += 1
@@ -916,6 +977,10 @@ class DenseJaxBackend(SolverBackend):
         device-program runtime under execution watchdogs."""
         cfg = self._cfg
         dtype = self._dtype
+        # An explicit segment_iters=0 can still reach here (the PCG
+        # two-phase route overrides it — solve_full); 0 would degenerate
+        # seg_open to 1-iteration opening programs, so treat it as auto.
+        seg_cfg = cfg.segment_iters if cfg.segment_iters else None
         # Each phase gets its own max_iter budget (matching the batched
         # path), so a tiny-max_iter warm-up still reaches and compiles
         # every phase; the buffer covers the 2-phase worst case.
@@ -951,10 +1016,10 @@ class DenseJaxBackend(SolverBackend):
             # (observed: a 32-iteration opening PCG segment crashed the
             # tunneled worker). Open with ONE iteration and let the
             # measured-rate adaptation in drive_segments size the rest.
-            seg0 = 1 if cgi else core.seg_open(cfg.segment_iters, est)
+            seg0 = 1 if cgi else core.seg_open(seg_cfg, est)
             return (make_run_seg, window, patience, seg0)
 
-        st, it, status, buf = core.drive_phase_plan(
+        st, it, status, buf, reg_out = core.drive_phase_plan(
             [make_phase(s) for s in self._phase_plan()],
             state, jnp.asarray(self._reg, dtype), cfg.max_iter, buf_cap, dtype,
         )
@@ -965,12 +1030,23 @@ class DenseJaxBackend(SolverBackend):
             and int(np.asarray(status))
             in (core.STATUS_STALL, core.STATUS_MAXITER)
         ):
-            st, it, status, buf = self._endgame_loop(st, int(np.asarray(it)),
-                                                    buf)
+            st, it, status, buf = self._endgame_loop(
+                st, int(np.asarray(it)), buf,
+                reg0=float(np.asarray(reg_out)),
+            )
         return st, it, status, buf
 
     def solve_full(self, state: IPMState):
-        if core.use_segments(self._cfg.segment_iters, jax.default_backend()):
+        # Two-phase PCG always takes the segmented route, even when
+        # segmentation was explicitly disabled: the fused two-phase
+        # program's PCG phase 2 floors at the f32 preconditioner's ~3e-7
+        # accuracy wall with no f64 finish, while the segmented plan
+        # appends one (fused f64 phase below the endgame threshold,
+        # host-driven endgame above). Segment sizing treats the explicit
+        # 0 as auto — see _solve_segmented.
+        if core.use_segments(
+            self._cfg.segment_iters, jax.default_backend()
+        ) or (self._pcg and self._two_phase):
             return self._solve_segmented(state)
         if self._two_phase:
             cfg = self._cfg

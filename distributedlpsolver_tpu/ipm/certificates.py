@@ -82,7 +82,24 @@ def primal_infeasibility_certificate(
     scale = 1.0 + float(np.abs(np.asarray(inf.b) @ yh)) + float(
         np.abs(u[bounded] @ z[bounded]) if bounded.any() else 0.0
     )
-    certified = sep > rel_tol * scale and viol <= rel_tol * max(1.0, sep)
+    # The violation is one component of Aᵀŷ with ‖ŷ‖₂ = 1 — and only
+    # UNBOUNDED columns can contribute it — so its natural magnitude is
+    # the largest unbounded-column norm of A. Test it relative to that,
+    # NOT to max(1, sep): a feasible problem whose feasible points all
+    # have huge ‖x‖₁ drives sep large, and a sep-relative tolerance
+    # would then admit a materially violated "certificate" that falsely
+    # upgrades STALLED to PRIMAL_INFEASIBLE. (Frobenius would be
+    # √(m·n)-looser than the component's scale at reference sizes, and a
+    # large-norm BOUNDED column must not inflate the tolerance either.)
+    A = inf.A
+    col_sq = (
+        np.asarray(A.power(2).sum(axis=0)).ravel() if sp.issparse(A)
+        else np.einsum("ij,ij->j", np.asarray(A), np.asarray(A))
+    )
+    col_scale = float(np.sqrt(np.max(col_sq[~bounded], initial=0.0)))
+    certified = (
+        sep > rel_tol * scale and viol <= rel_tol * max(col_scale, 1e-30)
+    )
     if sep <= 0:
         return None
     return Certificate(

@@ -713,10 +713,13 @@ def drive_phase_plan(phases, state, reg0, max_iter, buf_cap, dtype):
     run_seg(carry, it_stop)`` builds the phase's device program around its
     global iteration bound. Each phase gets its own ``max_iter`` budget;
     between phases the carry is reset via :func:`segment_phase_reset`.
-    Returns ``(state, iterations, status, stats_buffer)`` with the final
-    RUNNING status mapped to STALL/MAXITER exactly as the fused loop
-    would. ONE implementation shared by the dense and block backends so
-    their termination semantics can never diverge.
+    Returns ``(state, iterations, status, stats_buffer, reg)`` — ``reg``
+    is the final phase's escalated regularization (still on device), so
+    a follow-on finisher (the dense endgame) can seed from it instead of
+    replaying known-bad factorizations — with the final RUNNING status
+    mapped to STALL/MAXITER exactly as the fused loop would. ONE
+    implementation shared by the dense and block backends so their
+    termination semantics can never diverge.
     """
     import jax.numpy as jnp
 
@@ -742,7 +745,7 @@ def drive_phase_plan(phases, state, reg0, max_iter, buf_cap, dtype):
             and (not patience or best > patience)
         )
         status = STATUS_STALL if stalled else STATUS_MAXITER
-    return st, it, jnp.asarray(status, jnp.int32), buf
+    return st, it, jnp.asarray(status, jnp.int32), buf, carry[2]
 
 
 # Conservative opening-segment cap in auto mode: big enough that a small

@@ -14,6 +14,7 @@ sys.path.insert(0, "/root/repo")
 m, n = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 else (10000, 50000)
 max_iter = int(sys.argv[3]) if len(sys.argv) > 3 else 200
 
+from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
 from distributedlpsolver_tpu.ipm import solve
 from distributedlpsolver_tpu.models.generators import random_dense_lp
 
@@ -22,8 +23,11 @@ t0 = time.time()
 p = random_dense_lp(m, n, seed=2)  # same seed as the bench suite row
 print(f"built in {time.time()-t0:.0f}s", flush=True)
 
+# Explicit backend instance so the endgame's per-dispatch timings
+# (be.endgame_timings) can be folded into the artifact after the solve.
+be = DenseJaxBackend()
 t0 = time.time()
-r = solve(p, backend="tpu", max_iter=max_iter)
+r = solve(p, backend=be, max_iter=max_iter)
 wall = time.time() - t0
 print(
     f"RESULT: {r.status.name} obj={r.objective:.8f} iters={r.iterations} "
@@ -44,6 +48,7 @@ row = {
     "dinf": float(r.dinf),
     "setup_s": round(r.setup_time, 1),
     "wall_s": round(wall, 1),
+    "endgame_timings": getattr(be, "endgame_timings", []),
 }
 with open("/root/repo/BENCH_10K.json", "w") as fh:
     json.dump(row, fh, indent=2)

@@ -201,19 +201,123 @@ class TestBlockPCG:
         np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
 
 
-def test_endgame_finishes_after_pcg_floor(monkeypatch):
-    # Force the endgame route (threshold dropped below the test size):
-    # phase 1 f32 -> phase 2 PCG (stops at its floor or optimal) ->
-    # host-driven endgame iterations with the factorization computed in
-    # separate dispatches. Must reach full 1e-8 optimality.
-    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
+def _force_endgame(monkeypatch, **extra):
+    """Run a small PCG solve that GENUINELY enters the endgame loop.
 
+    On a well-conditioned toy the f32 preconditioner is essentially
+    exact, so the PCG phase cannot be made to floor the way it does at
+    reference scale (observed there: hard pinf floor ~3e-7). Instead the
+    fused phases' iteration budget is truncated at the host driver so
+    they exit MAXITER with a genuinely unconverged iterate — the endgame
+    must then do real full-precision work to reach 1e-8. Returns
+    (backend, result, problem)."""
+    import distributedlpsolver_tpu.backends.dense as d
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(d.DenseJaxBackend, "_ENDGAME_ENTRIES", 1)
+    real_dpp = d.core.drive_phase_plan
+
+    def truncated(phases, state, reg0, max_iter, buf_cap, dtype):
+        return real_dpp(phases, state, reg0, 4, buf_cap, dtype)
+
+    monkeypatch.setattr(d.core, "drive_phase_plan", truncated)
     p = random_dense_lp(48, 128, seed=6)
-    be = DenseJaxBackend()
-    monkeypatch.setattr(DenseJaxBackend, "_ENDGAME_ENTRIES", 1)
-    r = solve(p, backend=be, solve_mode="pcg", use_pallas=False)
+    be = d.DenseJaxBackend()
+    r = solve(p, backend=be, solve_mode="pcg", use_pallas=False, **extra)
+    return be, r, p
+
+
+def test_endgame_finishes_after_pcg_floor(monkeypatch):
+    # Phase 1 f32 -> phase 2 PCG (crippled: stalls below tol) ->
+    # host-driven endgame iterations with the factorization computed in
+    # separate dispatches. Must reach full 1e-8 optimality, and must
+    # actually have run the endgame (per-dispatch timings recorded).
+    be, r, p = _force_endgame(monkeypatch)
     assert be._pcg
     _check_optimal(r, p)
     # the history must be contiguous through the endgame append
     assert len(r.history) == r.iterations
+    tm = be.endgame_timings
+    assert tm, "endgame loop was never entered"
+    assert {"it", "t_assemble", "t_factor", "t_step", "bad", "reg"} == set(
+        tm[0]
+    )
+    # seeded reg is capped: f32-phase escalations must not pin the f64
+    # finish above tol (code-review finding, round 3)
+    assert all(row["reg"] <= 1e-6 + 1e-18 for row in tm if not row["bad"])
+
+
+def test_endgame_bad_step_escalates_without_reassembly(monkeypatch):
+    # A bad step must re-run ONLY factor+step with escalated reg — the
+    # assembly (longest dispatch at scale) is reused for the same iterate.
+    import distributedlpsolver_tpu.backends.dense as d
+
+    real_step = d._endgame_step
+    real_asm = d._endgame_assemble
+    forced = {"n": 0}
+    asm_calls = {"n": 0}
+
+    def bad_once_step(A, data, state, L, params):
+        new_state, stats = real_step(A, data, state, L, params)
+        if forced["n"] == 0:
+            forced["n"] += 1
+            stats = stats._replace(bad=True)
+        return new_state, stats
+
+    def counting_asm(A, data, state, params):
+        asm_calls["n"] += 1
+        return real_asm(A, data, state, params)
+
+    monkeypatch.setattr(d, "_endgame_step", bad_once_step)
+    monkeypatch.setattr(d, "_endgame_assemble", counting_asm)
+    be, r, p = _force_endgame(monkeypatch)
+    _check_optimal(r, p)
+    tm = be.endgame_timings
+    bad_rows = [row for row in tm if row["bad"]]
+    assert len(bad_rows) == 1  # the forced one
+    # retry escalated reg relative to the failed attempt...
+    i = tm.index(bad_rows[0])
+    assert tm[i + 1]["reg"] > bad_rows[0]["reg"]
+    # ...WITHOUT a fresh assembly: one assemble per endgame ITERATE, not
+    # per attempt (attempts == len(tm) > iterates when a retry happened)
+    assert asm_calls["n"] == len(tm) - len(bad_rows)
+    # and the retry row records no assembly time of its own
+    assert tm[i + 1]["t_assemble"] == 0.0
+
+
+def test_endgame_numerical_error_exit(monkeypatch):
+    # Persistent bad steps must escalate reg to the cap and exit
+    # NUMERICAL_ERROR instead of looping forever.
+    import distributedlpsolver_tpu.backends.dense as d
+
+    real_step = d._endgame_step
+
+    def always_bad(A, data, state, L, params):
+        new_state, stats = real_step(A, data, state, L, params)
+        return new_state, stats._replace(bad=True)
+
+    monkeypatch.setattr(d, "_endgame_step", always_bad)
+    be, r, p = _force_endgame(monkeypatch)
+    assert r.status == Status.NUMERICAL_ERROR
+    tm = be.endgame_timings
+    assert all(row["bad"] for row in tm)
+    regs = [row["reg"] for row in tm]
+    assert regs == sorted(regs) and regs[-1] > regs[0]  # monotone escalation
+
+
+def test_endgame_stall_exit(monkeypatch):
+    # Steps that stop improving must trip the endgame's stall window and
+    # exit STALLED rather than burning the whole iteration budget.
+    import distributedlpsolver_tpu.backends.dense as d
+
+    real_step = d._endgame_step
+
+    def frozen_step(A, data, state, L, params):
+        _, stats = real_step(A, data, state, L, params)
+        return state, stats  # no progress: same iterate every time
+
+    monkeypatch.setattr(d, "_endgame_step", frozen_step)
+    be, r, p = _force_endgame(monkeypatch, stall_window=3, max_iter=60)
+    assert r.status == Status.STALLED
+    # it gave up well before the iteration budget
+    assert len(be.endgame_timings) < 40
