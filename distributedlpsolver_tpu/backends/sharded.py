@@ -77,6 +77,16 @@ class ShardedJaxBackend(DenseJaxBackend):
             mesh_lib.replicated(self._mesh),
         )
 
+    def prec_sharding(self):
+        """Column-shard the PCG preconditioner factor L⁻¹ over the mesh:
+        each device builds (TRSMs) and stores only its identity slabs —
+        m²/K per-device footprint instead of replicated m², the first
+        distributed-factorization cut (SURVEY.md §2.2). The apply becomes
+        two GSPMD matmuls whose psum/all-gather ride ICI."""
+        return jax.sharding.NamedSharding(
+            self._mesh, jax.sharding.PartitionSpec(None, self._axis)
+        )
+
     @property
     def mesh(self) -> jax.sharding.Mesh:
         return self._mesh

@@ -395,9 +395,26 @@ def classify_divergence(mu, pinf, dinf, rel_gap, pobj, dobj):
     These are heuristics, not certificates — a homogeneous self-dual
     embedding would give certified rays (future work, SURVEY.md §5.3 notes
     the reference has no such machinery either).
+
+    Every test is scale-relative (dimensionless): μ and the objectives
+    carry the problem's c·x units, so absolute cutoffs misfire under bad
+    data scaling — scaling c by 1e6 would leave an absolute μ test
+    unreachable (muting detection) or let a legitimately large objective
+    trip an absolute divergence cutoff on a feasible problem. pinf /
+    dinf / rel_gap arrive already normalized (residual_norms divides by
+    ‖b‖ / ‖c‖ / 1+|pobj|), and the objective comparisons below normalize
+    each objective by the OTHER side's magnitude — at a divergence point
+    the runaway side explodes while the other stays finite, so the ratio
+    is scale-free.
     """
-    pinfeas = ((mu < 1e-8) & (pinf > 1e-3)) | (dobj > 1e12)
-    dinfeas = ((dinf > 1e-3) & (pobj < -1e8) & (rel_gap > 0.99)) | (pobj < -1e12)
+    scale_p = 1.0 + abs(pobj)
+    scale_d = 1.0 + abs(dobj)
+    pinfeas = ((mu < 1e-8 * scale_p) & (pinf > 1e-3)) | (
+        dobj > 1e8 * scale_p
+    )
+    dinfeas = ((dinf > 1e-3) & (pobj < -1e8 * scale_d) & (rel_gap > 0.99)) | (
+        pobj < -1e10 * scale_d
+    )
     return pinfeas, dinfeas
 
 

@@ -13,6 +13,11 @@ sys.path.insert(0, "/root/repo")
 
 m, n = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 else (10000, 50000)
 max_iter = int(sys.argv[3]) if len(sys.argv) > 3 else 200
+# CG sweep cap: one PCG-phase Mehrotra iteration is ONE device program
+# holding 2 CG solves, and near the f32 floor each runs its full cap at
+# ~0.5 s/sweep (ew-f64 GEMV pair, measured) — cap 40 keeps the worst
+# program ~40 s, under the ~60 s tunnel execution watchdog.
+cg_iters = int(sys.argv[4]) if len(sys.argv) > 4 else 40
 
 from distributedlpsolver_tpu.backends.dense import DenseJaxBackend
 from distributedlpsolver_tpu.ipm import solve
@@ -27,7 +32,7 @@ print(f"built in {time.time()-t0:.0f}s", flush=True)
 # (be.endgame_timings) can be folded into the artifact after the solve.
 be = DenseJaxBackend()
 t0 = time.time()
-r = solve(p, backend=be, max_iter=max_iter)
+r = solve(p, backend=be, max_iter=max_iter, cg_iters=cg_iters)
 wall = time.time() - t0
 print(
     f"RESULT: {r.status.name} obj={r.objective:.8f} iters={r.iterations} "

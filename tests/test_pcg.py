@@ -321,3 +321,78 @@ def test_endgame_stall_exit(monkeypatch):
     assert r.status == Status.STALLED
     # it gave up well before the iteration budget
     assert len(be.endgame_timings) < 40
+
+
+def test_pcg_sharded_preconditioner_memory_and_agreement():
+    """The column-sharded L⁻¹ build (dense._tri_inv_mesh) must (a) agree
+    with the replicated build and (b) cut per-device compiled memory of a
+    PCG step on the mesh — the distributed-factorization first cut
+    (VERDICT round 2 item 5: 'per-device peak memory measurably below
+    the replicated-PCG baseline')."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributedlpsolver_tpu.backends import dense as D
+    from distributedlpsolver_tpu.ipm import core as C
+    from distributedlpsolver_tpu.ipm.config import SolverConfig as SC
+    from distributedlpsolver_tpu.ipm.state import IPMState
+    from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh((8,), axis_names=("cols",))
+    psh = NamedSharding(mesh, P(None, "cols"))
+
+    # (a) numerical agreement of the sharded triangular inverse
+    rng = np.random.default_rng(3)
+    m = 96
+    Lt = np.tril(rng.standard_normal((m, m))) + 4.0 * np.eye(m)
+    L = jnp.asarray(Lt, dtype=jnp.float32)
+    ref = np.asarray(D._tri_inv_paneled(L, panel=32))
+    got = np.asarray(jax.jit(lambda L: D._tri_inv_mesh(L, psh, panel=8))(L))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    # (b) per-device compiled memory: sharded factor beats replicated
+    mm, nn = 512, 1024
+    inf = to_interior_form(random_dense_lp(mm, nn, seed=5))
+    A = jax.device_put(
+        jnp.asarray(np.asarray(inf.A), dtype=jnp.float64),
+        NamedSharding(mesh, P(None, "cols")),
+    )
+    A32 = A.astype(jnp.float32)
+    data = C.make_problem_data(
+        jnp, jnp.asarray(inf.c), jnp.asarray(inf.b), jnp.asarray(inf.u),
+        jnp.float64,
+    )
+    params = SC().step_params()
+    key_state = IPMState(
+        x=jnp.ones(inf.n), y=jnp.zeros(inf.m), s=jnp.ones(inf.n),
+        w=jnp.ones(inf.n), z=jnp.zeros(inf.n),
+    )
+    reg = jnp.asarray(1e-10, jnp.float64)
+
+    def mem(prec_shard):
+        def step(A, A32, data, state, reg):
+            ops = D._make_ops(
+                A, reg, jnp.dtype(jnp.float32), 0, False, A32, 100, 1e-11,
+                prec_shard,
+            )
+            return C.mehrotra_step(ops, data, params, state)
+
+        lowered = jax.jit(step).lower(A, A32, data, key_state, reg)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    m_repl = mem(None)
+    m_shard = mem(psh)
+    # The replicated step holds the full m×m f64 L⁻¹ per device; the
+    # sharded step holds m×(m/8). Demand a real margin (≥ 2·m² bytes —
+    # a quarter of the f64 factor), not noise: buffer reuse means the
+    # full 7/8·8m² savings is not visible in temp accounting.
+    assert m_shard < m_repl - 2 * mm * mm, (m_shard, m_repl)
+
+    # (c) end-to-end on the mesh through the public API
+    from distributedlpsolver_tpu.backends.sharded import ShardedJaxBackend
+
+    p = random_dense_lp(64, 160, seed=9)
+    be = ShardedJaxBackend(mesh=mesh)
+    r = solve(p, backend=be, solve_mode="pcg")
+    assert be._prec_shard is not None
+    _check_optimal(r, p)

@@ -103,3 +103,56 @@ class TestCertificates:
         cert = primal_infeasibility_certificate(inf, np.array([1.0, -1.0]))
         assert cert is not None and cert.certified
         assert cert.violation <= 1e-12
+
+
+class TestScaleFreeHeuristics:
+    """classify_divergence must be dimensionless: scaling the problem
+    data must not flip a feasible verdict to infeasible/unbounded
+    (VERDICT round 2, weak item 4 / next item 7)."""
+
+    @pytest.mark.parametrize("factor", [1e-6, 1e6])
+    def test_badly_scaled_feasible_is_never_declared_infeasible(self, factor):
+        # A feasible, bounded LP with objective and rhs pushed 6 orders
+        # of magnitude off unit scale, solved WITHOUT the auto-scaler so
+        # the raw magnitudes reach the heuristics. Any terminal status is
+        # tolerable except a false infeasibility/unboundedness verdict.
+        from distributedlpsolver_tpu.models.generators import random_dense_lp
+
+        p = random_dense_lp(24, 60, seed=11)
+        q = LPProblem(
+            c=p.c * factor,
+            A=p.A,
+            rlb=p.rlb * factor,
+            rub=p.rub * factor,
+            lb=p.lb,
+            ub=p.ub,
+            name="badscale",
+        )
+        r = solve(q, backend="tpu", scale=False, max_iter=120)
+        assert r.status not in (
+            Status.PRIMAL_INFEASIBLE,
+            Status.DUAL_INFEASIBLE,
+        ), r.summary()
+
+    def test_classify_divergence_is_scale_invariant(self):
+        # The heuristic's verdict on a diverging trajectory must be the
+        # same at unit scale and with objectives/mu rescaled by 1e8.
+        from distributedlpsolver_tpu.ipm import core
+
+        # Farkas-like signature: mu converged, pinf stuck, dual runaway
+        base = dict(
+            mu=1e-12, pinf=0.1, dinf=1e-9, rel_gap=5.0, pobj=3.0, dobj=1e10
+        )
+        for s in (1.0, 1e8, 1e-8):
+            pin, din = core.classify_divergence(
+                base["mu"] * s, base["pinf"], base["dinf"], base["rel_gap"],
+                base["pobj"] * s, base["dobj"] * s,
+            )
+            assert bool(pin) and not bool(din), s
+
+        # Healthy mid-solve iterate at huge objective scale: no verdict.
+        pin, din = core.classify_divergence(
+            mu=1e2, pinf=1e-5, dinf=1e-6, rel_gap=1e-3,
+            pobj=1e10, dobj=1e10 - 1e5,
+        )
+        assert not bool(pin) and not bool(din)
