@@ -407,13 +407,18 @@ def classify_divergence(mu, pinf, dinf, rel_gap, pobj, dobj):
     the runaway side explodes while the other stays finite, so the ratio
     is scale-free.
     """
+    # Constants preserve the old absolute behavior at unit scale (1e12
+    # for the unguarded runaway legs) — the rewrite makes them relative,
+    # it must not also make them 2-4 orders looser: a feasible problem
+    # whose legitimate optimum is ~ -1e10 would otherwise trip the
+    # primal-dive leg mid-solve while the dual still lags near zero.
     scale_p = 1.0 + abs(pobj)
     scale_d = 1.0 + abs(dobj)
     pinfeas = ((mu < 1e-8 * scale_p) & (pinf > 1e-3)) | (
-        dobj > 1e8 * scale_p
+        dobj > 1e12 * scale_p
     )
     dinfeas = ((dinf > 1e-3) & (pobj < -1e8 * scale_d) & (rel_gap > 0.99)) | (
-        pobj < -1e10 * scale_d
+        pobj < -1e12 * scale_d
     )
     return pinfeas, dinfeas
 
