@@ -955,6 +955,13 @@ class DenseJaxBackend(SolverBackend):
         reg = (
             max(reg_base, min(reg0, 1e-6)) if reg0 is not None else reg_base
         )
+        # The endgame never touches the f32 copy the PCG phases
+        # preconditioned with — drop it before the first f64 assembly:
+        # at 10k×50k the (Pallas-padded) A32 is ~2 GB of HBM, and with it
+        # resident the SECOND endgame iteration's assembly hit
+        # RESOURCE_EXHAUSTED (observed 2026-07-30; iteration 1 fit only
+        # because no previous factor L was alive yet).
+        self._A32 = None
         budget = cfg.max_iter
         refactor = 0
         self.endgame_timings = timings = []
@@ -1013,6 +1020,10 @@ class DenseJaxBackend(SolverBackend):
                     failed = True
                     break
                 if M is None:  # big-m path dropped M before the step
+                    # The failed factor is dead — free it BEFORE the
+                    # re-assembly, the same assembly+L concurrency the
+                    # iteration-boundary del below exists to avoid.
+                    del L
                     t1 = _time.perf_counter()
                     M = _endgame_assemble(self._A, self._data, state,
                                           params)
@@ -1020,6 +1031,10 @@ class DenseJaxBackend(SolverBackend):
                     t_asm = _time.perf_counter() - t1
             if M is not None:
                 del M
+            # The factor is dead once the step consumed it — freeing its
+            # m²·8 bytes BEFORE the next assembly dispatch is what keeps
+            # the 10k-scale endgame inside HBM across iterations.
+            del L
             dt = _time.perf_counter() - t0
             if failed:
                 status = core.STATUS_NUMERR
