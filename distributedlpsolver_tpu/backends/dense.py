@@ -891,17 +891,22 @@ class DenseJaxBackend(SolverBackend):
         params_p1 = cfg.phase1_params()
         m, n = self._A.shape
         if self._pcg:
-            # Phase 2 = f32-preconditioned matrix-free PCG at full tol
-            # with NO stall patience: the f32-assembled preconditioner
-            # carries no information about M's smallest eigen-subspace
-            # once kappa(M) > 1/eps_f32, so PCG hits a hard floor around
-            # 1e-6..3e-7 (observed) — it must hand over at the stall, and
-            # a full-precision phase finishes: a fused f64 phase below
-            # the endgame threshold, the host-driven endgame above it.
+            # Phase 2 = f32-preconditioned matrix-free PCG at the PCG
+            # HANDOFF tol (μ-floor keyed there, the phase1_tol mechanism
+            # one level down — see config.pcg_handoff_tol) with NO stall
+            # patience: the f32-assembled preconditioner carries no
+            # information about M's smallest eigen-subspace once
+            # kappa(M) > 1/eps_f32, so PCG floors around 1e-6 — it hands
+            # over at its handoff tol or its stall, still well-centered,
+            # and a full-precision phase finishes: a fused f64 phase
+            # below the endgame threshold, the host-driven endgame above.
+            params_pcg = cfg.replace(
+                tol=max(cfg.tol, cfg.pcg_handoff_tol)
+            ).step_params()
             phases = [
                 (params_p1, "float32", 0, self._pallas_p1, A32, w, 0.0,
                  0, 0.0, None),
-                (self._params, "float32", 0, self._pallas_p1, A32, w, 0.0,
+                (params_pcg, "float32", 0, self._pallas_p1, A32, w, 0.0,
                  self._cg_iters, self._cg_tol, self._prec_shard),
             ]
             if m * n < self._ENDGAME_ENTRIES:
@@ -1131,11 +1136,19 @@ class DenseJaxBackend(SolverBackend):
             state, jnp.asarray(self._reg, dtype), cfg.max_iter, buf_cap, dtype,
         )
         m, n = self._A.shape
+        # OPTIMAL re-enters the endgame ONLY when the two-phase plan
+        # actually clamped the PCG phase to the looser handoff tol — then
+        # "optimal" means optimal-at-handoff and the endgame owns the
+        # finish. Forced single-phase PCG and tol ≥ handoff configs run
+        # at the requested tol, so their OPTIMAL is final.
+        clamped = self._two_phase and self._cfg.tol < self._cfg.pcg_handoff_tol
+        trigger = (core.STATUS_STALL, core.STATUS_MAXITER) + (
+            (core.STATUS_OPTIMAL,) if clamped else ()
+        )
         if (
             self._pcg
             and m * n >= self._ENDGAME_ENTRIES
-            and int(np.asarray(status))
-            in (core.STATUS_STALL, core.STATUS_MAXITER)
+            and int(np.asarray(status)) in trigger
         ):
             st, it, status, buf = self._endgame_loop(
                 st, int(np.asarray(it)), buf,

@@ -222,6 +222,11 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
         core.STATUS_STALL: Status.STALLED,
     }.get(int(np.asarray(status_code)), Status.NUMERICAL_ERROR)
 
+    # Fused-loop records carry the AVERAGE seconds/iteration, not a
+    # per-iteration measurement: the whole loop (or segment) runs as one
+    # device program, so individual iteration boundaries never cross the
+    # host. The host-driver path (fused_loop=False) records true per-
+    # iteration wall times; the B:2 aggregate metric is exact either way.
     t_avg = solve_time / max(iters, 1)
     history, last = [], None
     for i in range(len(buf)):
