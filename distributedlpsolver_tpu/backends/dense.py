@@ -376,16 +376,34 @@ def _endgame_factor(M, reg):
     return jnp.linalg.cholesky(M)
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def _endgame_step(A, data, state, L, params):
+@functools.partial(jax.jit, static_argnames=("params", "refine"))
+def _endgame_step(A, data, state, L, reg, diagM, params, refine=2):
     """One Mehrotra step with the factorization INJECTED (computed by the
-    preceding dispatches); solves run through the full-precision factor."""
+    preceding dispatches); solves run through the full-precision factor.
+
+    ``refine`` > 0 adds normal-equations-level iterative refinement with
+    a MATRIX-FREE residual against the regularized system the factor
+    approximates — ``M·x = A·(d·(Aᵀx))`` through the chunked ew-f64
+    GEMVs, plus the ``reg·diag(M)`` perturbation via the passed
+    diagonal — so it works at any m without holding the m×m M. At 10k
+    scale κ(M) reaches ~1e9 near convergence and a bare emulated-f64
+    cho_solve direction carries ~1e-5 relative error — observed as the
+    endgame's error INCREASING step over step; two sweeps (each one
+    GEMV pair + cho_solve) restore full f64 solve quality for a few
+    seconds per iteration."""
+
+    d_scale = core.scaling_d(state, data, params)
 
     def factorize(d):
         return L
 
     def solve(Lf, rhs):
-        return jax.scipy.linalg.cho_solve((Lf, True), rhs)
+        x = jax.scipy.linalg.cho_solve((Lf, True), rhs)
+        for _ in range(refine):
+            Mx = _matvec_chunked(A, d_scale * _rmatvec_chunked(A, x))
+            r = rhs - Mx - reg * diagM * x
+            x = x + jax.scipy.linalg.cho_solve((Lf, True), r)
+        return x
 
     ops = core.LinOps(
         xp=jnp,
@@ -960,6 +978,8 @@ class DenseJaxBackend(SolverBackend):
         reg = (
             max(reg_base, min(reg0, 1e-6)) if reg0 is not None else reg_base
         )
+        reg_fail_floor = 0.0  # smallest reg observed to fail a factor
+        good_streak = 0  # consecutive good steps since the last bad one
         # The endgame never touches the f32 copy the PCG phases
         # preconditioned with — drop it before the first f64 assembly:
         # at 10k×50k the (Pallas-padded) A32 is ~2 GB of HBM, and with it
@@ -987,7 +1007,8 @@ class DenseJaxBackend(SolverBackend):
             M = _endgame_assemble(self._A, self._data, state, params)
             jax.block_until_ready(M)  # bound each dispatch's device time
             t_asm = _time.perf_counter() - t0
-            failed = False
+            diagM = jnp.diagonal(M)  # O(m); survives M's deletion, feeds
+            failed = False           # the matrix-free refinement residual
             while True:
                 t1 = _time.perf_counter()
                 L = _endgame_factor(M, jnp.asarray(reg, self._dtype))
@@ -998,7 +1019,8 @@ class DenseJaxBackend(SolverBackend):
                     M = None
                 t1 = _time.perf_counter()
                 new_state, stats = _endgame_step(
-                    self._A, self._data, state, L, params,
+                    self._A, self._data, state, L,
+                    jnp.asarray(reg, self._dtype), diagM, params,
                 )
                 bad = bool(stats.bad)  # blocks on the step dispatch
                 t_step = _time.perf_counter() - t1
@@ -1012,6 +1034,13 @@ class DenseJaxBackend(SolverBackend):
                 if not bad:
                     break
                 refactor += 1
+                good_streak = 0
+                # Decay (below) must never re-enter a reg that already
+                # failed: without this floor a 10×-up/10×-down cycle
+                # repeats the failing factorization EVERY iteration
+                # (observed at 10k×50k: one guaranteed bad step per
+                # iterate, reg thrashing 1e-9 ↔ 1e-8).
+                reg_fail_floor = max(reg_fail_floor, reg * cfg.reg_grow)
                 reg *= cfg.reg_grow
                 if trace:
                     import sys as _sys
@@ -1049,8 +1078,16 @@ class DenseJaxBackend(SolverBackend):
             # evidence about THAT iterate's system, not the remaining
             # trajectory's; without decay the perturbation compounds into
             # a permanent tol floor (reg only ever grows above). Floored
-            # at the user-configured base, never below it.
-            reg = max(reg / cfg.reg_grow, reg_base)
+            # at the user-configured base and at the smallest reg that
+            # recently failed a factorization — but that fail-floor AGES
+            # OUT after 4 clean steps (one probing decay per 4 iterates
+            # at worst), so a single early bad step cannot pin the whole
+            # remaining trajectory above reg_base.
+            good_streak += 1
+            if good_streak >= 4:
+                reg_fail_floor = 0.0
+                good_streak = 0
+            reg = max(reg / cfg.reg_grow, reg_base, reg_fail_floor)
             state = new_state
             it += 1
             k += 1

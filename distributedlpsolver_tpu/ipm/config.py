@@ -63,15 +63,19 @@ class SolverConfig:
     solve_mode: Optional[str] = None
     cg_iters: int = 100  # PCG iteration cap per Newton solve
     cg_tol: float = 1e-11  # PCG relative-residual target
-    # PCG-phase handoff tolerance, the exact phase1_tol mechanism one
-    # level down: the f32-assembled preconditioner floors PCG directions
-    # near ~1e-6 at scale, and a phase whose μ-floor is keyed to the
-    # FINAL tol grinds μ to ~1e-9 on floor-limited directions — an
-    # off-center iterate the full-precision finish cannot repair
-    # (observed at 10k×50k: the endgame oscillated at 7e-6 from such a
-    # handoff). The PCG phase therefore converges to
-    # max(tol, pcg_handoff_tol) with its μ-floor keyed there, and the
-    # f64 finish (fused phase or endgame) owns the last orders.
+    # PCG-phase handoff tolerance of the DENSE two-phase schedule, the
+    # exact phase1_tol mechanism one level down: the f32-assembled
+    # preconditioner floors PCG directions near ~1e-6 at scale, and a
+    # phase whose μ-floor is keyed to the FINAL tol grinds μ to ~1e-9 on
+    # floor-limited directions — an off-center iterate the full-precision
+    # finish cannot repair (observed at 10k×50k: the endgame oscillated
+    # at 7e-6 from such a handoff). The dense PCG phase therefore
+    # converges to max(tol, pcg_handoff_tol) with its μ-floor keyed
+    # there, and the f64 finish (fused phase or endgame) owns the last
+    # orders. The BLOCK backend's PCG phase intentionally keeps the full
+    # tol: it has no full-precision finisher behind it, so clamping
+    # would just relabel its best effort — it grinds and reports
+    # STALLED honestly instead.
     pcg_handoff_tol: float = 1e-6
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
     # Ruiz-equilibrate the interior form before solving (presolve scaling;

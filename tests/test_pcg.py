@@ -257,8 +257,9 @@ def test_endgame_bad_step_escalates_without_reassembly(monkeypatch):
     forced = {"n": 0}
     asm_calls = {"n": 0}
 
-    def bad_once_step(A, data, state, L, params):
-        new_state, stats = real_step(A, data, state, L, params)
+    def bad_once_step(A, data, state, L, reg, params, M=None, refine=0):
+        new_state, stats = real_step(A, data, state, L, reg, params, M,
+                                     refine=refine)
         if forced["n"] == 0:
             forced["n"] += 1
             stats = stats._replace(bad=True)
@@ -292,8 +293,9 @@ def test_endgame_numerical_error_exit(monkeypatch):
 
     real_step = d._endgame_step
 
-    def always_bad(A, data, state, L, params):
-        new_state, stats = real_step(A, data, state, L, params)
+    def always_bad(A, data, state, L, reg, params, M=None, refine=0):
+        new_state, stats = real_step(A, data, state, L, reg, params, M,
+                                     refine=refine)
         return new_state, stats._replace(bad=True)
 
     monkeypatch.setattr(d, "_endgame_step", always_bad)
@@ -312,8 +314,9 @@ def test_endgame_stall_exit(monkeypatch):
 
     real_step = d._endgame_step
 
-    def frozen_step(A, data, state, L, params):
-        _, stats = real_step(A, data, state, L, params)
+    def frozen_step(A, data, state, L, reg, params, M=None, refine=0):
+        _, stats = real_step(A, data, state, L, reg, params, M,
+                             refine=refine)
         return state, stats  # no progress: same iterate every time
 
     monkeypatch.setattr(d, "_endgame_step", frozen_step)
