@@ -225,7 +225,15 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
         Gk = jnp.einsum("kln,kmn->klm", t.L_all * dB[:, None, :], t.B_all)
         # H_k = M_kk⁻¹ G_kᵀ (batched two-triangular-solve), (K, mb, link)
         Hk = jax.scipy.linalg.cho_solve((Lk, True), jnp.swapaxes(Gk, 1, 2))
-        MLL = jnp.einsum("kln,kpn->klp", t.L_all * dB[:, None, :], t.L_all).sum(0)
+        # Contract K INSIDE the einsum: the two-step form
+        # einsum("kln,kpn->klp").sum(0) materializes a (K, link, link)
+        # intermediate — 10.5 GB in f64 at the pds-20 class (K=64,
+        # link=1600), the exact compile-time HBM OOM observed on one
+        # chip. Contracting k,n together lowers to a single
+        # (link, K·nb)×(K·nb, link) GEMM with tile-sized temps. Under a
+        # K-sharded mesh GSPMD still emits per-device partial sums + one
+        # all-reduce, same as the .sum(0) form.
+        MLL = jnp.einsum("kln,kpn->lp", t.L_all * dB[:, None, :], t.L_all)
         if n0:
             d0 = d[t.border_idx]
             MLL = MLL + (t.A0 * d0[None, :]) @ t.A0.T
