@@ -176,17 +176,40 @@ def build_tensors(inf: InteriorForm, dtype, shard_put=None) -> Tuple[BlockTensor
     return tensors, layout
 
 
+# Above this many stored f64 tensor entries, block matvec/rmatvec/diag
+# contractions on TPU run as elementwise multiply + reduction instead of
+# dot_generals: XLA's emulated-f64 DOT lowering materializes 8×-f32
+# operand-split temps of the FULL operand (observed at the pds-20 class,
+# K=64 link=1600 nb≈1300: a 3.91 GB + 1.95 GB pair of L_all-sized HLO
+# temps → compile-time HBM OOM), while elementwise double-double ops
+# fuse with the reduce. Mirrors dense._use_ew_f64; arithmetic identical.
+_EW_F64_BLOCK_ENTRIES = 1 << 24
+
+
+def _ew_block(t: "BlockTensors") -> bool:
+    return (
+        t.B_all.dtype == jnp.float64
+        and t.B_all.size + t.L_all.size > _EW_F64_BLOCK_ENTRIES
+        and jax.default_backend() == "tpu"
+    )
+
+
 def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
     """LinOps over the arrow structure (shared-core seam)."""
     K, mb, nb, link, n0, n, m = lay
+    ew = _ew_block(t)
 
     def pad(v):
         return jnp.concatenate([v, jnp.zeros(1, dtype=v.dtype)])
 
     def matvec(x):
         xb = pad(x)[t.col_idx]  # (K, nb)
-        y_blocks = jnp.einsum("kmn,kn->km", t.B_all, xb)
-        y_link = jnp.einsum("kln,kn->l", t.L_all, xb)
+        if ew:
+            y_blocks = jnp.sum(t.B_all * xb[:, None, :], axis=-1)
+            y_link = jnp.sum(t.L_all * xb[:, None, :], axis=(0, -1))
+        else:
+            y_blocks = jnp.einsum("kmn,kn->km", t.B_all, xb)
+            y_link = jnp.einsum("kln,kn->l", t.L_all, xb)
         if n0:
             y_link = y_link + t.A0 @ x[t.border_idx]
         # Scatter through the row maps (sentinel row m falls off the end);
@@ -197,9 +220,14 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
     def rmatvec(y):
         yb = pad(y)[t.row_idx]  # (K, mb); padded rows read 0
         yL = y[t.link_idx]
-        g = jnp.einsum("kmn,km->kn", t.B_all, yb) + jnp.einsum(
-            "kln,l->kn", t.L_all, yL
-        )
+        if ew:
+            g = jnp.sum(t.B_all * yb[:, :, None], axis=1) + jnp.sum(
+                t.L_all * yL[None, :, None], axis=1
+            )
+        else:
+            g = jnp.einsum("kmn,km->kn", t.B_all, yb) + jnp.einsum(
+                "kln,l->kn", t.L_all, yL
+            )
         out = jnp.zeros(n + 1, dtype=y.dtype).at[t.col_idx].add(g)[:n]
         if n0:
             out = out.at[t.border_idx].add(t.A0.T @ yL)
@@ -261,17 +289,28 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
     )
 
 
-def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout, reg):
+def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout,
+                     reg, precise: bool = False):
     """Phase-1 LinOps: residual matvecs in full precision against the f64
     tensors, factorizations/solves through the f32 tensor stack on the MXU
     (the dense backend's two-phase split, restated for the arrow
     structure). Solutions cast back up so the Mehrotra step's state stays
-    f64."""
+    f64.
+
+    ``precise`` runs the f32 factorization at true-f32 matmul precision
+    (TPU DEFAULT lowers f32 dots to bf16 multiplies, ~1e-3 error — fine
+    for a loose-tol phase 1, fatal for a FINISH phase): with KKT-level
+    refinement in f64 on top, this is the huge-shape finisher that needs
+    no f64 Schur assembly at all (whose emulated-f64 dot_generals cannot
+    be lowered at pds-20 scale — see _EW_F64_BLOCK_ENTRIES)."""
     base = _block_ops(t64, lay, reg, None)
     f32 = jnp.float32
     ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None)
 
     def factorize(d):
+        if precise:
+            with jax.default_matmul_precision("highest"):
+                return ops32.factorize(d.astype(f32))
         return ops32.factorize(d.astype(f32))
 
     def solve(factors, r):
@@ -292,8 +331,14 @@ def _block_diag_m(t: BlockTensors, lay: BlockLayout, d):
     ``reg·diag(M)``)."""
     K, mb, nb, link, n0, n, m = lay
     dB = jnp.concatenate([d, jnp.zeros(1, d.dtype)])[t.col_idx]  # (K, nb)
-    diag_blocks = jnp.einsum("kmn,kn->km", t.B_all * t.B_all, dB)
-    diag_link = jnp.einsum("kln,kn->l", t.L_all * t.L_all, dB)
+    if _ew_block(t):
+        diag_blocks = jnp.sum(t.B_all * t.B_all * dB[:, None, :], axis=-1)
+        diag_link = jnp.sum(
+            t.L_all * t.L_all * dB[:, None, :], axis=(0, -1)
+        )
+    else:
+        diag_blocks = jnp.einsum("kmn,kn->km", t.B_all * t.B_all, dB)
+        diag_link = jnp.einsum("kln,kn->l", t.L_all * t.L_all, dB)
     if n0:
         diag_link = diag_link + (t.A0 * t.A0) @ d[t.border_idx]
     out = jnp.zeros(m + 1, dtype=d.dtype).at[t.row_idx].add(diag_blocks)
@@ -364,13 +409,16 @@ def _block_segment(
     """One bounded continuation of the fused Schur loop (host segmentation
     against the device execution watchdog — see core.drive_segments and
     dense._dense_segment). ``mode`` selects the per-step ops: "f64"
-    (direct full precision), "mixed" (f32 factorizations, phase 1), or
-    "pcg" (f32 preconditioner + full-precision matrix-free CG);
-    ``tensors32`` may be None only for "f64"."""
+    (direct full precision), "mixed" (f32 factorizations, phase 1),
+    "mixedp" (true-f32-precision factorizations + f64 KKT refinement —
+    the huge-shape finisher), or "pcg" (f32 preconditioner +
+    full-precision matrix-free CG); ``tensors32`` may be None only for
+    "f64"."""
 
     def step(state, reg):
-        if mode == "mixed":
-            ops = _block_ops_mixed(tensors, tensors32, lay, reg)
+        if mode in ("mixed", "mixedp"):
+            ops = _block_ops_mixed(tensors, tensors32, lay, reg,
+                                   precise=mode == "mixedp")
         elif mode == "pcg":
             ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
         else:
@@ -581,7 +629,9 @@ class BlockAngularBackend(SolverBackend):
         semantics as the dense backend by construction."""
         cfg = self._cfg
         dtype = self._dtype
-        n_phases = 2 if self._two_phase else 1
+        n_phases = 1 + (1 if self._two_phase else 0) + (
+            1 if (self._pcg and self._two_phase) else 0
+        )
         buf_cap = core.buffer_cap(n_phases * cfg.max_iter)
         mr = jnp.asarray(cfg.max_refactor, jnp.int32)
         rg = jnp.asarray(cfg.reg_grow, dtype)
@@ -590,17 +640,54 @@ class BlockAngularBackend(SolverBackend):
         flops = self._f64_flops
         w = cfg.stall_window
         patience = 1e3 * cfg.tol
-        full_mode = "pcg" if self._pcg else "f64"
-        full_t32 = self._get_tensors32() if self._pcg else None
+        K, mb, nb, link, n0, n, m = self._lay
+        # The f64 direct Schur assembly is un-lowerable at huge shapes on
+        # TPU: XLA's emulated-f64 dot_generals materialize 8×-f32
+        # operand-split temps of the full (K, link, nb) / (K, mb, nb)
+        # tensors (observed OOM at pds-20 scale: 19.4 G needed of
+        # 15.75 G). Above that budget the full-precision finish keeps
+        # the f32 factorization at TRUE f32 matmul precision and leans
+        # on f64 KKT-level refinement ("mixedp") — no f64 assembly runs.
+        split_bytes = 32.0 * (K * link * nb + K * mb * nb)
+        huge_f64 = (
+            self._dtype == jnp.float64
+            and jax.default_backend() == "tpu"
+            and split_bytes > 4e9
+        )
+        params_finish = cfg.replace(
+            kkt_refine=max(4, cfg.kkt_refine)
+        ).step_params()
+        full_mode = "pcg" if self._pcg else ("mixedp" if huge_f64 else "f64")
+        full_t32 = (
+            self._get_tensors32() if full_mode in ("pcg", "mixedp") else None
+        )
+        full_params = params_finish if full_mode == "mixedp" else self._params
         if self._two_phase:
             plan = [
                 (cfg.phase1_params(), "mixed", self._get_tensors32(), w, 0.0),
-                (self._params, full_mode, full_t32, 2 * w if w else 0,
-                 patience),
             ]
+            if self._pcg:
+                # PCG runs to its HANDOFF tol (μ-floor keyed there — see
+                # config.pcg_handoff_tol), then the refinement finisher
+                # owns the last orders at full tolerance.
+                params_pcg = cfg.replace(
+                    tol=max(cfg.tol, cfg.pcg_handoff_tol)
+                ).step_params()
+                plan.append(
+                    (params_pcg, "pcg", self._get_tensors32(), w, 0.0)
+                )
+                plan.append(
+                    (params_finish, "mixedp", self._get_tensors32(),
+                     2 * w if w else 0, patience)
+                )
+            else:
+                plan.append(
+                    (full_params, full_mode, full_t32, 2 * w if w else 0,
+                     patience)
+                )
         else:
             plan = [
-                (self._params, full_mode, full_t32, 2 * w if w else 0,
+                (full_params, full_mode, full_t32, 2 * w if w else 0,
                  patience)
             ]
 
@@ -641,7 +728,13 @@ class BlockAngularBackend(SolverBackend):
         return st, it, status, buf
 
     def solve_full(self, state: IPMState):
-        if core.use_segments(self._cfg.segment_iters, jax.default_backend()):
+        # Two-phase PCG always routes through the segmented plan (same
+        # rule as the dense backend): only that plan carries the
+        # precise-f32 + KKT-refinement finisher behind the PCG phase's
+        # handoff tolerance.
+        if core.use_segments(
+            self._cfg.segment_iters, jax.default_backend()
+        ) or (self._pcg and self._two_phase):
             return self._solve_segmented(state)
         if self._pcg and not self._two_phase:
             # Forced PCG without a phase schedule: ONE full-tol PCG phase

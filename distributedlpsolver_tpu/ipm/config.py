@@ -72,10 +72,10 @@ class SolverConfig:
     # at 7e-6 from such a handoff). The dense PCG phase therefore
     # converges to max(tol, pcg_handoff_tol) with its μ-floor keyed
     # there, and the f64 finish (fused phase or endgame) owns the last
-    # orders. The BLOCK backend's PCG phase intentionally keeps the full
-    # tol: it has no full-precision finisher behind it, so clamping
-    # would just relabel its best effort — it grinds and reports
-    # STALLED honestly instead.
+    # orders. The BLOCK backend's segmented PCG plan applies the same
+    # clamp, finishing with true-f32-precision factorizations + f64
+    # KKT refinement ("mixedp") — its huge shapes admit no f64 Schur
+    # assembly to finish with (see block_angular._solve_segmented).
     pcg_handoff_tol: float = 1e-6
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
     # Ruiz-equilibrate the interior form before solving (presolve scaling;

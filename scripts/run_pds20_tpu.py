@@ -46,9 +46,16 @@ else:
     be = "block"
     tag = "block@tpu"
 
-solve(p, backend=be, max_iter=3)  # compile warm-up
+# solve_mode="direct": the auto rule would pick PCG at this scale, but
+# XLA's chosen lowering for the PCG operator's L_all einsums at
+# (K=64, link=1600, nb=1400) materializes multiple L_all-sized temps
+# (observed 3.9 GB + 1.95 GB HLO temps → compile-time HBM OOM); the
+# direct two-phase Schur path lowers to clean GEMMs and its emulated-f64
+# phase is only ~2 s/iteration of FLOPs at this shape.
+mode = dict(solve_mode="direct")
+solve(p, backend=be, max_iter=3, **mode)  # compile warm-up
 t0 = time.time()
-r = solve(p, backend=be, max_iter=120)
+r = solve(p, backend=be, max_iter=120, **mode)
 wall = time.time() - t0
 print(
     f"{tag}: {r.status.name} obj={r.objective:.6f} iters={r.iterations} "
