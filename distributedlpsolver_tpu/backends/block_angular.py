@@ -185,6 +185,10 @@ def build_tensors(inf: InteriorForm, dtype, shard_put=None) -> Tuple[BlockTensor
 # fuse with the reduce. Mirrors dense._use_ew_f64; arithmetic identical.
 _EW_F64_BLOCK_ENTRIES = 1 << 24
 
+# HBM budget for the 8×-f32 operand-split temps of a ONE-SHOT f64 Schur
+# assembly; above it the full-precision phase runs n-chunked ("f64c").
+_F64_SPLIT_BUDGET = 4e9
+
 
 def _ew_block(t: "BlockTensors") -> bool:
     return (
@@ -233,10 +237,6 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
             out = out.at[t.border_idx].add(t.A0.T @ yL)
         return out
 
-    def _rel_diag_reg(M):
-        di = jnp.diagonal(M, axis1=-2, axis2=-1)
-        return M + jnp.zeros_like(M).at[..., jnp.arange(M.shape[-1]), jnp.arange(M.shape[-1])].set(reg * di)
-
     def factorize(d):
         dB = pad(d)[t.col_idx]  # (K, nb); padded cols get d=0
         Bd = t.B_all * dB[:, None, :]
@@ -249,7 +249,7 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
         Mkk = Mkk + jnp.zeros_like(Mkk).at[
             :, jnp.arange(mb), jnp.arange(mb)
         ].set(pad_diag)
-        Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk))
+        Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg))
         Gk = jnp.einsum("kln,kmn->klm", t.L_all * dB[:, None, :], t.B_all)
         # H_k = M_kk⁻¹ G_kᵀ (batched two-triangular-solve), (K, mb, link)
         Hk = jax.scipy.linalg.cho_solve((Lk, True), jnp.swapaxes(Gk, 1, 2))
@@ -269,7 +269,7 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
         # reference's MPI_Allreduce of Schur blocks (BASELINE.json:5) —
         # an XLA all-reduce when the K axis is mesh-sharded.
         S = MLL - jnp.einsum("klm,kmp->lp", Gk, Hk)
-        Ls = jnp.linalg.cholesky(_rel_diag_reg(S))
+        Ls = jnp.linalg.cholesky(_rel_diag_reg(S, reg))
         return Lk, Ls, Gk
 
     def solve(factors, r):
@@ -290,27 +290,17 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
 
 
 def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout,
-                     reg, precise: bool = False):
+                     reg):
     """Phase-1 LinOps: residual matvecs in full precision against the f64
     tensors, factorizations/solves through the f32 tensor stack on the MXU
     (the dense backend's two-phase split, restated for the arrow
     structure). Solutions cast back up so the Mehrotra step's state stays
-    f64.
-
-    ``precise`` runs the f32 factorization at true-f32 matmul precision
-    (TPU DEFAULT lowers f32 dots to bf16 multiplies, ~1e-3 error — fine
-    for a loose-tol phase 1, fatal for a FINISH phase): with KKT-level
-    refinement in f64 on top, this is the huge-shape finisher that needs
-    no f64 Schur assembly at all (whose emulated-f64 dot_generals cannot
-    be lowered at pds-20 scale — see _EW_F64_BLOCK_ENTRIES)."""
+    f64."""
     base = _block_ops(t64, lay, reg, None)
     f32 = jnp.float32
     ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None)
 
     def factorize(d):
-        if precise:
-            with jax.default_matmul_precision("highest"):
-                return ops32.factorize(d.astype(f32))
         return ops32.factorize(d.astype(f32))
 
     def solve(factors, r):
@@ -322,6 +312,119 @@ def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout,
         rmatvec=base.rmatvec,
         factorize=factorize,
         solve=solve,
+    )
+
+
+def _rel_diag_reg(M, reg):
+    """Per-row relative diagonal perturbation (shared by every block
+    factorize — one definition so the reg semantics cannot diverge)."""
+    di = jnp.diagonal(M, axis1=-2, axis2=-1)
+    return M + jnp.zeros_like(M).at[
+        ..., jnp.arange(M.shape[-1]), jnp.arange(M.shape[-1])
+    ].set(reg * di)
+
+
+def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg, chunk: int = 128):
+    """Full-precision direct Schur LinOps for HUGE shapes (the block
+    analogue of the dense endgame): the f64 assembly einsums run
+    n-CHUNKED inside a fori_loop, so XLA's emulated-f64 dot_generals see
+    only (…, chunk)-sized operands — their 8×-f32 operand-split temps
+    drop from the full-tensor gigabytes (the observed pds-20 OOM) to
+    ~chunk/nb of that. Triangular factors are explicitly inverted
+    (batched small TRSMs against the identity), so every solve is a
+    batched GEMV — no large-rhs TRSM lowering ever runs.
+
+    Per-iteration cost at the pds-20 class (K=64, mb=432, nb≈1300,
+    link=1600): ~5e11 emulated-f64 flops ≈ 2–3 s of MXU time — the
+    price of true f64 factor quality, paid only for the final orders of
+    magnitude after the f32 phases hand over.
+    """
+    K, mb, nb, link, n0, n, m = lay
+    base = _block_ops(t, lay, reg, None)  # ew-f64 mat/rmatvec shared
+
+    def factorize(d):
+        dB = jnp.concatenate([d, jnp.zeros(1, d.dtype)])[t.col_idx]
+        nfull = nb // chunk
+
+        def contrib(Bc, Lc, dc):
+            Bd = Bc * dc[:, None, :]
+            Ld = Lc * dc[:, None, :]
+            return (
+                jnp.einsum("kmc,kpc->kmp", Bd, Bc),
+                jnp.einsum("klc,kmc->klm", Ld, Bc),
+                jnp.einsum("klc,kpc->lp", Ld, Lc),
+            )
+
+        def body(jb, acc):
+            Mkk, Gk, MLL = acc
+            j0 = jb * chunk
+            dMkk, dGk, dMLL = contrib(
+                jax.lax.dynamic_slice_in_dim(t.B_all, j0, chunk, 2),
+                jax.lax.dynamic_slice_in_dim(t.L_all, j0, chunk, 2),
+                jax.lax.dynamic_slice_in_dim(dB, j0, chunk, 1),
+            )
+            return Mkk + dMkk, Gk + dGk, MLL + dMLL
+
+        dt = t.B_all.dtype
+        Mkk, Gk, MLL = jax.lax.fori_loop(
+            0, nfull, body,
+            (
+                jnp.zeros((K, mb, mb), dt),
+                jnp.zeros((K, link, mb), dt),
+                jnp.zeros((link, link), dt),
+            ),
+        )
+        # Ragged tail as one static slice (accumulation forbids the
+        # clamped-slice trick — a re-read tail would double-count — and
+        # padding copies of the full tensors would cost ~1.5 GB inside
+        # the very path built to bound HBM).
+        if nb - nfull * chunk:
+            j0 = nfull * chunk
+            dMkk, dGk, dMLL = contrib(
+                t.B_all[:, :, j0:], t.L_all[:, :, j0:], dB[:, j0:]
+            )
+            Mkk, Gk, MLL = Mkk + dMkk, Gk + dGk, MLL + dMLL
+        if n0:
+            d0 = d[t.border_idx]
+            MLL = MLL + (t.A0 * d0[None, :]) @ t.A0.T
+        pad_diag = (t.row_idx == m).astype(Mkk.dtype)
+        Mkk = Mkk + jnp.zeros_like(Mkk).at[
+            :, jnp.arange(mb), jnp.arange(mb)
+        ].set(pad_diag)
+        Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg))
+        # Explicit batched inverse of the small per-block factors: the
+        # link-many-rhs TRSM this replaces is exactly the lowering that
+        # blows temps; GEMVs against Lk⁻¹ are clean batched dots.
+        eye_b = jnp.broadcast_to(jnp.eye(mb, dtype=dt), (K, mb, mb))
+        Lki = jax.scipy.linalg.solve_triangular(Lk, eye_b, lower=True)
+        # H_k = M_kk⁻¹ G_kᵀ via two batched GEMMs with Lk⁻¹
+        tmp = jnp.einsum("kmp,klp->kml", Lki, Gk)  # Lk⁻¹ Gkᵀ
+        Hk = jnp.einsum("kpm,kpl->kml", Lki, tmp)  # Lk⁻ᵀ (…)
+        S = MLL - jnp.einsum("klm,kmp->lp", Gk, Hk)
+        Ls = jnp.linalg.cholesky(_rel_diag_reg(S, reg))
+        Lsi = jax.scipy.linalg.solve_triangular(
+            Ls, jnp.eye(link, dtype=dt), lower=True
+        )
+        return Lki, Lsi, Gk
+
+    def solve(factors, r):
+        Lki, Lsi, Gk = factors
+        rb = jnp.concatenate([r, jnp.zeros(1, r.dtype)])[t.row_idx]
+        rL = r[t.link_idx]
+        # M_kk⁻¹ rb via two batched GEMVs with Lk⁻¹
+        tmp = jnp.einsum("kmp,kp->km", Lki, rb)
+        tmp = jnp.einsum("kpm,kp->km", Lki, tmp)
+        rS = rL - jnp.einsum("klm,km->l", Gk, tmp)
+        yL = Lsi.T @ (Lsi @ rS)
+        rb2 = rb - jnp.einsum("klm,l->km", Gk, yL)
+        yb = jnp.einsum("kmp,kp->km", Lki, rb2)
+        yb = jnp.einsum("kpm,kp->km", Lki, yb)
+        out = jnp.zeros(m + 1, dtype=r.dtype).at[t.row_idx].add(yb)
+        return out.at[t.link_idx].add(yL)[:m]
+
+    return core.LinOps(
+        xp=jnp, matvec=base.matvec, rmatvec=base.rmatvec,
+        factorize=factorize, solve=solve,
     )
 
 
@@ -409,18 +512,18 @@ def _block_segment(
     """One bounded continuation of the fused Schur loop (host segmentation
     against the device execution watchdog — see core.drive_segments and
     dense._dense_segment). ``mode`` selects the per-step ops: "f64"
-    (direct full precision), "mixed" (f32 factorizations, phase 1),
-    "mixedp" (true-f32-precision factorizations + f64 KKT refinement —
-    the huge-shape finisher), or "pcg" (f32 preconditioner +
-    full-precision matrix-free CG); ``tensors32`` may be None only for
-    "f64"."""
+    (direct full precision), "f64c" (n-chunked f64 direct, the
+    huge-shape finisher), "mixed" (f32 factorizations, phase 1), or
+    "pcg" (f32 preconditioner + full-precision matrix-free CG);
+    ``tensors32`` may be None for the full-precision modes."""
 
     def step(state, reg):
-        if mode in ("mixed", "mixedp"):
-            ops = _block_ops_mixed(tensors, tensors32, lay, reg,
-                                   precise=mode == "mixedp")
+        if mode == "mixed":
+            ops = _block_ops_mixed(tensors, tensors32, lay, reg)
         elif mode == "pcg":
             ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+        elif mode == "f64c":
+            ops = _block_ops_f64c(tensors, lay, reg)
         else:
             ops = _block_ops(tensors, lay, reg, None)
         return core.mehrotra_step(ops, data, params, state)
@@ -641,34 +744,29 @@ class BlockAngularBackend(SolverBackend):
         w = cfg.stall_window
         patience = 1e3 * cfg.tol
         K, mb, nb, link, n0, n, m = self._lay
-        # The f64 direct Schur assembly is un-lowerable at huge shapes on
-        # TPU: XLA's emulated-f64 dot_generals materialize 8×-f32
-        # operand-split temps of the full (K, link, nb) / (K, mb, nb)
-        # tensors (observed OOM at pds-20 scale: 19.4 G needed of
-        # 15.75 G). Above that budget the full-precision finish keeps
-        # the f32 factorization at TRUE f32 matmul precision and leans
-        # on f64 KKT-level refinement ("mixedp") — no f64 assembly runs.
+        # The one-shot f64 direct Schur assembly is un-lowerable at huge
+        # shapes on TPU: XLA's emulated-f64 dot_generals materialize
+        # 8×-f32 operand-split temps of the full (K, link, nb) /
+        # (K, mb, nb) tensors (observed OOM at pds-20 scale: 19.4 G
+        # needed of 15.75 G). Above that budget the full-precision
+        # phase runs n-CHUNKED ("f64c", the block analogue of the dense
+        # endgame) — same f64 arithmetic, bounded per-chunk temps.
         split_bytes = 32.0 * (K * link * nb + K * mb * nb)
         huge_f64 = (
             self._dtype == jnp.float64
             and jax.default_backend() == "tpu"
-            and split_bytes > 4e9
+            and split_bytes > _F64_SPLIT_BUDGET
         )
-        params_finish = cfg.replace(
-            kkt_refine=max(4, cfg.kkt_refine)
-        ).step_params()
-        full_mode = "pcg" if self._pcg else ("mixedp" if huge_f64 else "f64")
-        full_t32 = (
-            self._get_tensors32() if full_mode in ("pcg", "mixedp") else None
-        )
-        full_params = params_finish if full_mode == "mixedp" else self._params
+        finish_mode = "f64c" if huge_f64 else "f64"
+        full_mode = "pcg" if self._pcg else finish_mode
+        full_t32 = self._get_tensors32() if full_mode == "pcg" else None
         if self._two_phase:
             plan = [
                 (cfg.phase1_params(), "mixed", self._get_tensors32(), w, 0.0),
             ]
             if self._pcg:
                 # PCG runs to its HANDOFF tol (μ-floor keyed there — see
-                # config.pcg_handoff_tol), then the refinement finisher
+                # config.pcg_handoff_tol), then the true-f64 finisher
                 # owns the last orders at full tolerance.
                 params_pcg = cfg.replace(
                     tol=max(cfg.tol, cfg.pcg_handoff_tol)
@@ -677,24 +775,25 @@ class BlockAngularBackend(SolverBackend):
                     (params_pcg, "pcg", self._get_tensors32(), w, 0.0)
                 )
                 plan.append(
-                    (params_finish, "mixedp", self._get_tensors32(),
+                    (self._params, finish_mode, None,
                      2 * w if w else 0, patience)
                 )
             else:
                 plan.append(
-                    (full_params, full_mode, full_t32, 2 * w if w else 0,
+                    (self._params, full_mode, full_t32, 2 * w if w else 0,
                      patience)
                 )
         else:
             plan = [
-                (full_params, full_mode, full_t32, 2 * w if w else 0,
+                (self._params, full_mode, full_t32, 2 * w if w else 0,
                  patience)
             ]
 
         def make_phase(spec):
             params, mode, t32, window, patience_now = spec
             rate = (
-                core.SEG_RATE_F32 if mode != "f64" else core.SEG_RATE_F64
+                core.SEG_RATE_F64 if mode in ("f64", "f64c")
+                else core.SEG_RATE_F32
             )
             cgi = self._cg_iters if mode == "pcg" else 0
             cgt = self._cg_tol if mode == "pcg" else 0.0
@@ -729,12 +828,22 @@ class BlockAngularBackend(SolverBackend):
 
     def solve_full(self, state: IPMState):
         # Two-phase PCG always routes through the segmented plan (same
-        # rule as the dense backend): only that plan carries the
-        # precise-f32 + KKT-refinement finisher behind the PCG phase's
-        # handoff tolerance.
-        if core.use_segments(
-            self._cfg.segment_iters, jax.default_backend()
-        ) or (self._pcg and self._two_phase):
+        # rule as the dense backend): only that plan carries the chunked
+        # f64 finisher behind the PCG phase's handoff tolerance. Huge
+        # f64 shapes route there too regardless of segment settings —
+        # the fused one-shot programs would hit the operand-split OOM
+        # the segmented plan's "f64c" mode exists to avoid.
+        K, mb, nb, link, n0, n, m = self._lay
+        huge_f64 = (
+            self._dtype == jnp.float64
+            and jax.default_backend() == "tpu"
+            and 32.0 * (K * link * nb + K * mb * nb) > _F64_SPLIT_BUDGET
+        )
+        if (
+            core.use_segments(self._cfg.segment_iters, jax.default_backend())
+            or (self._pcg and self._two_phase)
+            or huge_f64
+        ):
             return self._solve_segmented(state)
         if self._pcg and not self._two_phase:
             # Forced PCG without a phase schedule: ONE full-tol PCG phase

@@ -73,9 +73,9 @@ class SolverConfig:
     # converges to max(tol, pcg_handoff_tol) with its μ-floor keyed
     # there, and the f64 finish (fused phase or endgame) owns the last
     # orders. The BLOCK backend's segmented PCG plan applies the same
-    # clamp, finishing with true-f32-precision factorizations + f64
-    # KKT refinement ("mixedp") — its huge shapes admit no f64 Schur
-    # assembly to finish with (see block_angular._solve_segmented).
+    # clamp, finishing with the n-chunked true-f64 Schur mode ("f64c" —
+    # one-shot f64 assembly cannot be lowered at its huge shapes; see
+    # block_angular._solve_segmented).
     pcg_handoff_tol: float = 1e-6
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
     # Ruiz-equilibrate the interior form before solving (presolve scaling;

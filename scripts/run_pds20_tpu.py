@@ -46,16 +46,13 @@ else:
     be = "block"
     tag = "block@tpu"
 
-# solve_mode="direct": the auto rule would pick PCG at this scale, but
-# XLA's chosen lowering for the PCG operator's L_all einsums at
-# (K=64, link=1600, nb=1400) materializes multiple L_all-sized temps
-# (observed 3.9 GB + 1.95 GB HLO temps → compile-time HBM OOM); the
-# direct two-phase Schur path lowers to clean GEMMs and its emulated-f64
-# phase is only ~2 s/iteration of FLOPs at this shape.
-mode = dict(solve_mode="direct")
-solve(p, backend=be, max_iter=3, **mode)  # compile warm-up
+# Auto mode resolves to the lowering-safe huge-shape plan: f32 phase 1 →
+# PCG at the handoff tol (ew-f64 matrix-free operator — no emulated-f64
+# dot_generals, whose 8×-f32 operand-split temps OOMed this shape) →
+# n-chunked true-f64 Schur finisher ("f64c") at 1e-8.
+solve(p, backend=be, max_iter=3)  # compile warm-up
 t0 = time.time()
-r = solve(p, backend=be, max_iter=120, **mode)
+r = solve(p, backend=be, max_iter=120)
 wall = time.time() - t0
 print(
     f"{tag}: {r.status.name} obj={r.objective:.6f} iters={r.iterations} "

@@ -148,3 +148,45 @@ def test_two_phase_matches_single_phase():
     obj1 = float(data.c @ st1.x)
     obj2 = float(data.c @ st2.x)
     assert abs(obj1 - obj2) < 1e-6 * (1 + abs(obj1))
+
+
+def test_f64c_chunked_ops_match_direct():
+    """The n-chunked f64 factorize/solve (_block_ops_f64c, the huge-shape
+    finisher) must agree with the one-shot direct ops to round-off —
+    including a chunk width that does not divide nb (pad-with-zeros)."""
+    import jax.numpy as jnp
+
+    from distributedlpsolver_tpu.backends import block_angular as B
+    from distributedlpsolver_tpu.models.problem import to_interior_form
+
+    p = block_angular_lp(5, 12, 25, 9, seed=2, sparse=False)
+    inf = to_interior_form(p)
+    t, lay = B.build_tensors(inf, jnp.float64)
+    reg = jnp.asarray(1e-10, jnp.float64)
+    ops_ref = B._block_ops(t, lay, reg, None)
+    ops_c = B._block_ops_f64c(t, lay, reg, chunk=7)  # ragged on purpose
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.uniform(0.5, 2.0, lay.n))
+    r = jnp.asarray(rng.standard_normal(lay.m))
+    x_ref = np.asarray(ops_ref.solve(ops_ref.factorize(d), r))
+    x_c = np.asarray(ops_c.solve(ops_c.factorize(d), r))
+    np.testing.assert_allclose(x_c, x_ref, rtol=1e-9, atol=1e-9)
+
+
+def test_f64c_finisher_solves_to_full_tol(monkeypatch):
+    """Force the huge-shape plan (split-bytes threshold dropped to 0) on a
+    small block problem: phase 1 f32 -> PCG at handoff -> f64c chunked
+    finisher must reach 1e-8 through the public API."""
+    import jax
+
+    from distributedlpsolver_tpu.backends import block_angular as B
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(B, "_F64_SPLIT_BUDGET", 0.0)
+    p = block_angular_lp(4, 16, 32, 8, seed=6, sparse=False)
+    be = B.BlockAngularBackend()
+    r = solve(p, backend=be, solve_mode="pcg", scale=False, segment_iters=4)
+    assert r.status == Status.OPTIMAL
+    assert r.rel_gap <= 1e-8 and r.pinf <= 1e-8 and r.dinf <= 1e-8
+    ref = highs_on_general(p)
+    np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
