@@ -340,6 +340,7 @@ def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg, chunk: int = 128):
     magnitude after the f32 phases hand over.
     """
     K, mb, nb, link, n0, n, m = lay
+    chunk = min(chunk, nb)  # small shapes: fori body must trace in-bounds
     base = _block_ops(t, lay, reg, None)  # ew-f64 mat/rmatvec shared
 
     def factorize(d):
