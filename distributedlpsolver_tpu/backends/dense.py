@@ -392,6 +392,12 @@ def _endgame_step(A, data, state, L, reg, diagM, params, refine=2):
     GEMV pair + cho_solve) restore full f64 solve quality for a few
     seconds per iteration."""
 
+    # KKT-level refinement is OFF here (params arrives with
+    # kkt_refine=0): the M-refined solves below already deliver
+    # full-f64 direction quality, and every extra solve site multiplies
+    # this emulated-f64 program's compile time — the remote compiler's
+    # response drops after ~55 minutes (observed "Unexpected EOF"), so
+    # program size is a hard correctness constraint, not a nicety.
     d_scale = core.scaling_d(state, data, params)
 
     def factorize(d):
@@ -966,7 +972,11 @@ class DenseJaxBackend(SolverBackend):
         import time as _time
 
         cfg = self._cfg
-        params = self._params
+        # kkt_refine=0 in the endgame step: its solves carry their own
+        # M-level refinement (see _endgame_step), and the KKT-refinement
+        # solve sites would ~3× the emulated-f64 program — whose compile
+        # must stay under the tunnel's ~55-minute response drop.
+        params = cfg.replace(kkt_refine=0).step_params()
         trace = core.seg_trace_enabled()
         buf = np.asarray(buf)[:it0] if it0 else np.zeros((0, core.N_STAT))
         rows = []
@@ -1018,9 +1028,12 @@ class DenseJaxBackend(SolverBackend):
                     del M
                     M = None
                 t1 = _time.perf_counter()
+                # ONE refinement sweep: factor error is ~1e-7 relative
+                # (f64 cholesky at κ~1e9), one exact-residual sweep
+                # squares it — ample for 1e-8, half the compile surface.
                 new_state, stats = _endgame_step(
                     self._A, self._data, state, L,
-                    jnp.asarray(reg, self._dtype), diagM, params,
+                    jnp.asarray(reg, self._dtype), diagM, params, refine=1,
                 )
                 bad = bool(stats.bad)  # blocks on the step dispatch
                 t_step = _time.perf_counter() - t1
