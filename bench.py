@@ -240,6 +240,7 @@ def _bench_fixtures(quick: bool) -> list:
         t0 = time.perf_counter()
         q = read_mps(tmp)
         t_parse = time.perf_counter() - t0
+        _solve_timed(q, "auto", max_iter=3)  # compile warm-up
         r = _solve_timed(q, "auto")
         r_direct = _solve_timed(p, "auto")
         agree = abs(r.objective - r_direct.objective) <= 1e-7 * (
@@ -331,8 +332,12 @@ def run_suite(args) -> list:
     # (auto's platform rules would divert to cpu-native on a CPU-only box)
     # — and the Schur backend executes it, vs the sparse-direct baseline.
     _log("[4/6] large sparse, hint-less (structure detection → Schur backend)")
-    shape = (4, 24, 48, 12) if q else (16, 96, 192, 48)
-    sparse_lp = block_angular_lp(*shape, seed=3, sparse=True, density=0.15)
+    # Non-quick shape is the stormG2-class scale target (VERDICT round 2
+    # item 4): ≥20k rows, hundreds of natural blocks — detection recovers
+    # K=256 and the Schur backend must beat cpu-sparse decisively
+    # (measured 2026-07-31: 10.2 s vs 187 s, 18×).
+    shape, dens = ((4, 24, 48, 12), 0.15) if q else ((256, 80, 160, 48), 0.08)
+    sparse_lp = block_angular_lp(*shape, seed=3, sparse=True, density=dens)
     sparse_lp.block_structure = None  # what a real file looks like
     from distributedlpsolver_tpu.models.structure import detect_block_structure
 

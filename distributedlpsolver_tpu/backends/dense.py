@@ -372,12 +372,22 @@ def _endgame_assemble(A, data, state, params):
 
 @jax.jit
 def _endgame_factor(M, reg):
-    M = M + jnp.diag(jnp.asarray(reg, M.dtype) * jnp.diagonal(M))
-    return jnp.linalg.cholesky(M)
+    """Jacobi-scaled f64 Cholesky: factoring s·M·s (unit diagonal) cuts
+    the FACTORED matrix's condition number by the diagonal's spread —
+    late-IPM diagonals span many orders, and every order removed
+    sharpens the refinement sweep's contraction (observed without it:
+    ~1e-2 contraction at 10k, leaving ~1e-4 direction error after one
+    sweep and a glacial 3%/iteration tail). The relative diagonal
+    perturbation becomes + reg·I exactly in the scaled space."""
+    diagM = jnp.diagonal(M)
+    s = jax.lax.rsqrt(jnp.maximum(diagM, jnp.finfo(M.dtype).tiny))
+    Ms = M * s[:, None] * s[None, :]
+    Ms = Ms + jnp.asarray(reg, M.dtype) * jnp.eye(M.shape[0], dtype=M.dtype)
+    return jnp.linalg.cholesky(Ms), s
 
 
 @functools.partial(jax.jit, static_argnames=("params", "refine"))
-def _endgame_step(A, data, state, L, reg, diagM, params, refine=2):
+def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=2):
     """One Mehrotra step with the factorization INJECTED (computed by the
     preceding dispatches); solves run through the full-precision factor.
 
@@ -401,14 +411,15 @@ def _endgame_step(A, data, state, L, reg, diagM, params, refine=2):
     d_scale = core.scaling_d(state, data, params)
 
     def factorize(d):
-        return L
+        return Ls
 
     def solve(Lf, rhs):
-        x = jax.scipy.linalg.cho_solve((Lf, True), rhs)
+        L, s = Lf  # Jacobi-scaled factor: (M+regD)⁻¹ = s·(LLᵀ)⁻¹·s
+        x = s * jax.scipy.linalg.cho_solve((L, True), s * rhs)
         for _ in range(refine):
             Mx = _matvec_chunked(A, d_scale * _rmatvec_chunked(A, x))
             r = rhs - Mx - reg * diagM * x
-            x = x + jax.scipy.linalg.cho_solve((Lf, True), r)
+            x = x + s * jax.scipy.linalg.cho_solve((L, True), s * r)
         return x
 
     ops = core.LinOps(
