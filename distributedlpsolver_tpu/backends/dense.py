@@ -968,17 +968,15 @@ class DenseJaxBackend(SolverBackend):
         """Host-driven full-precision finish for huge m (see the endgame
         program docstrings above). Returns (state, it, status, buf).
 
-        ``reg0`` seeds the regularization from wherever the preceding
-        fused phases escalated it (threaded out of the segment carry by
-        drive_phase_plan) — restarting from self._reg would replay
-        known-bad factorizations at a full assembly+factor round each.
-        The seed is capped at 1e-6 and decays one reg_grow notch per good
-        step: phase-2 escalations answer *f32-preconditioner* breakdowns
-        the f64 factorization does not share, and reg here only ever
-        grows on bad steps, so an uncapped carry-over could pin the
-        finish above tol permanently. Per-dispatch wall times land in
-        ``self.endgame_timings`` (one dict per factor+step attempt);
-        scripts/run_dense10k.py folds them into the timing artifact.
+        Regularization seeds at the configured base (1e-12), NOT from
+        the phases' escalated value (``reg0`` is informational): phase
+        escalations answer *f32* breakdowns the f64 factorization does
+        not share, and a 1e-6-seeded endgame was observed (10k×50k) to
+        pin pinf at ~1e-5 — while re-finding the right level costs only
+        cheap factor+step retries (the assembly is held across them).
+        Per-dispatch wall times land in ``self.endgame_timings`` (one
+        dict per factor+step attempt); scripts/run_dense10k.py folds
+        them into the timing artifact.
         """
         import time as _time
 
@@ -996,9 +994,7 @@ class DenseJaxBackend(SolverBackend):
         best = np.inf
         since = 0
         reg_base = max(self._reg, 1e-12)  # user-configured floor
-        reg = (
-            max(reg_base, min(reg0, 1e-6)) if reg0 is not None else reg_base
-        )
+        reg = reg_base
         reg_fail_floor = 0.0  # smallest reg observed to fail a factor
         good_streak = 0  # consecutive good steps since the last bad one
         # The endgame never touches the f32 copy the PCG phases

@@ -1,0 +1,18 @@
+"""Force the endgame at 2048x10240 (compiles are minutes, not 45) to
+reproduce and diagnose the bad-step-at-small-reg pattern from the 10k run."""
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+from distributedlpsolver_tpu.backends import dense as D
+from distributedlpsolver_tpu.ipm import solve
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+
+D.DenseJaxBackend._ENDGAME_ENTRIES = 1  # force endgame at this size
+p = random_dense_lp(2048, 10240, seed=2)
+be = D.DenseJaxBackend()
+r = solve(p, backend=be, solve_mode="pcg", max_iter=120)
+print(f"RESULT: {r.status.name} gap={r.rel_gap:.2e} pinf={r.pinf:.2e} "
+      f"dinf={r.dinf:.2e} iters={r.iterations} solve={r.solve_time:.1f}s",
+      flush=True)
+for row in getattr(be, "endgame_timings", [])[:40]:
+    print(row, flush=True)
