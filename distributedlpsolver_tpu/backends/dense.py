@@ -370,6 +370,37 @@ def _endgame_assemble(A, data, state, params):
     return _normal_eq_chunked(A, d)
 
 
+@functools.partial(jax.jit, static_argnames=("params",))
+def _endgame_recenter(data, state, params):
+    """Lift collapsed complementarity pairs to a centered band before the
+    full-precision finish. A phase that ground at its f32 floor can leave
+    pairs with x_i·s_i ≪ μ; the resulting d spans far enough that
+    A·diag(d)·Aᵀ becomes numerically singular beyond ANY tolerable
+    regularization (observed at 10k×50k: factorization unusable below
+    reg 1e-6, which pins pinf at ~1e-5). Raising the smaller member of a
+    collapsed pair to (0.01·μ)/partner perturbs the residuals by at most
+    ‖A‖·Δ — negligible against the entry infeasibility — and restores a
+    factorable Newton system. No-op on a well-centered state."""
+    x, y, s, w, z = state
+    hub = data.hub
+    mu = (x @ s + (hub * w) @ z) / data.ncomp
+    floor = 0.01 * mu
+
+    def lift(a, b):
+        need = a * b < floor
+        a2 = jnp.where(need & (a <= b), floor / jnp.maximum(b, 1e-300), a)
+        b2 = jnp.where(need & (b < a), floor / jnp.maximum(a, 1e-300), b)
+        return a2, b2
+
+    x2, s2 = lift(x, s)
+    w2, z2 = lift(w, z)
+    return IPMState(
+        x=x2, y=y, s=s2,
+        w=jnp.where(hub > 0, w2, w),
+        z=jnp.where(hub > 0, z2, z),
+    )
+
+
 @jax.jit
 def _endgame_factor(M, reg):
     """Jacobi-scaled f64 Cholesky: factoring s·M·s (unit diagonal) cuts
@@ -938,10 +969,17 @@ class DenseJaxBackend(SolverBackend):
             params_pcg = cfg.replace(
                 tol=max(cfg.tol, cfg.pcg_handoff_tol)
             ).step_params()
+            # SHORT stall window for the PCG phase: every iteration it
+            # grinds at its f32-preconditioner floor degrades the iterate
+            # (observed at 10k×50k: 9 floor iterations collapsed
+            # complementarity pairs badly enough that the endgame's f64
+            # factorization failed below reg 1e-6, pinning pinf ~1e-5);
+            # hand over within ~3 of the floor instead.
+            w_pcg = min(3, w) if w else 0
             phases = [
                 (params_p1, "float32", 0, self._pallas_p1, A32, w, 0.0,
                  0, 0.0, None),
-                (params_pcg, "float32", 0, self._pallas_p1, A32, w, 0.0,
+                (params_pcg, "float32", 0, self._pallas_p1, A32, w_pcg, 0.0,
                  self._cg_iters, self._cg_tol, self._prec_shard),
             ]
             if m * n < self._ENDGAME_ENTRIES:
@@ -995,6 +1033,7 @@ class DenseJaxBackend(SolverBackend):
         since = 0
         reg_base = max(self._reg, 1e-12)  # user-configured floor
         reg = reg_base
+        state = _endgame_recenter(self._data, state, params)
         reg_fail_floor = 0.0  # smallest reg observed to fail a factor
         good_streak = 0  # consecutive good steps since the last bad one
         # The endgame never touches the f32 copy the PCG phases
