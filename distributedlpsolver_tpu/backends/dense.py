@@ -417,28 +417,28 @@ def _endgame_factor(M, reg):
     return jnp.linalg.cholesky(Ms), s
 
 
-@functools.partial(jax.jit, static_argnames=("params", "refine"))
-def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=2):
+@functools.partial(jax.jit, static_argnames=("params", "cg_iters"))
+def _endgame_step(A, data, state, Ls, reg, diagM, params, cg_iters=80):
     """One Mehrotra step with the factorization INJECTED (computed by the
-    preceding dispatches); solves run through the full-precision factor.
+    preceding dispatches); each Newton solve runs CG on the TRUE
+    matrix-free operator, preconditioned by the regularized f64 factor.
 
-    ``refine`` > 0 adds normal-equations-level iterative refinement with
-    a MATRIX-FREE residual against the regularized system the factor
-    approximates — ``M·x = A·(d·(Aᵀx))`` through the chunked ew-f64
-    GEMVs, plus the ``reg·diag(M)`` perturbation via the passed
-    diagonal — so it works at any m without holding the m×m M. At 10k
-    scale κ(M) reaches ~1e9 near convergence and a bare emulated-f64
-    cho_solve direction carries ~1e-5 relative error — observed as the
-    endgame's error INCREASING step over step; two sweeps (each one
-    GEMV pair + cho_solve) restore full f64 solve quality for a few
-    seconds per iteration."""
+    Why not cho_solve + refinement: the emulated-f64 Cholesky of the
+    REAL late-IPM spectrum at 10k scale produces NaN below reg ≈ 1e-8
+    (diagnosed via the committed per-attempt L_finite telemetry —
+    synthetic spectra factor fine at 1e-12, the real eigenvalue cluster
+    near zero does not), and at the factorable reg = 1e-6 the direction
+    bias pins pinf at ~1e-5. CG against the exact operator
+    ``M·v = A·(d·(Aᵀv))`` (chunked ew-f64 GEMVs) with the
+    (M + reg·diagM)-factor as preconditioner converges in
+    ~√(1 + reg·d/λ_min) ≈ tens of sweeps to TRUE f64 directions — the
+    factorization's reg floor stops mattering. Also keeps the program
+    small (one while_loop per solve), which is a hard constraint: the
+    remote compiler's response drops after ~55 minutes.
 
-    # KKT-level refinement is OFF here (params arrives with
-    # kkt_refine=0): the M-refined solves below already deliver
-    # full-f64 direction quality, and every extra solve site multiplies
-    # this emulated-f64 program's compile time — the remote compiler's
-    # response drops after ~55 minutes (observed "Unexpected EOF"), so
-    # program size is a hard correctness constraint, not a nicety.
+    KKT-level refinement is OFF (params arrives with kkt_refine=0): the
+    CG solves already deliver full-f64 direction quality.
+    """
     d_scale = core.scaling_d(state, data, params)
 
     def factorize(d):
@@ -446,12 +446,14 @@ def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=2):
 
     def solve(Lf, rhs):
         L, s = Lf  # Jacobi-scaled factor: (M+regD)⁻¹ = s·(LLᵀ)⁻¹·s
-        x = s * jax.scipy.linalg.cho_solve((L, True), s * rhs)
-        for _ in range(refine):
-            Mx = _matvec_chunked(A, d_scale * _rmatvec_chunked(A, x))
-            r = rhs - Mx - reg * diagM * x
-            x = x + s * jax.scipy.linalg.cho_solve((L, True), s * r)
-        return x
+
+        def op(v):
+            return _matvec_chunked(A, d_scale * _rmatvec_chunked(A, v))
+
+        def prec(r):
+            return s * jax.scipy.linalg.cho_solve((L, True), s * r)
+
+        return core.pcg_solve(op, prec, rhs, 1e-12, cg_iters)
 
     ops = core.LinOps(
         xp=jnp,
@@ -1074,12 +1076,9 @@ class DenseJaxBackend(SolverBackend):
                     del M
                     M = None
                 t1 = _time.perf_counter()
-                # ONE refinement sweep: factor error is ~1e-7 relative
-                # (f64 cholesky at κ~1e9), one exact-residual sweep
-                # squares it — ample for 1e-8, half the compile surface.
                 new_state, stats = _endgame_step(
                     self._A, self._data, state, L,
-                    jnp.asarray(reg, self._dtype), diagM, params, refine=1,
+                    jnp.asarray(reg, self._dtype), diagM, params,
                 )
                 bad = bool(stats.bad)  # blocks on the step dispatch
                 t_step = _time.perf_counter() - t1
@@ -1088,6 +1087,18 @@ class DenseJaxBackend(SolverBackend):
                     "t_factor": round(t_fac, 3),
                     "t_step": round(t_step, 3),
                     "bad": bad, "reg": float(reg),
+                    # failure-mechanism diagnostics: bad == non-finite
+                    # direction OR a zero step length. alpha_* are masked
+                    # to 0 on bad; sigma goes NaN iff the PREDICTOR
+                    # direction was non-finite (mu_aff propagates);
+                    # L_finite isolates a failed factorization.
+                    "alpha_p": float(np.asarray(stats.alpha_p)),
+                    "alpha_d": float(np.asarray(stats.alpha_d)),
+                    "mu": float(np.asarray(stats.mu)),
+                    "sigma": float(np.asarray(stats.sigma)),
+                    "L_finite": bool(
+                        np.isfinite(float(np.asarray(jnp.sum(L[0]))))
+                    ),
                 })
                 t_asm = 0.0  # amortized: no re-assembly on retries
                 if not bad:

@@ -239,7 +239,7 @@ def test_endgame_finishes_after_pcg_floor(monkeypatch):
     assert len(r.history) == r.iterations
     tm = be.endgame_timings
     assert tm, "endgame loop was never entered"
-    assert {"it", "t_assemble", "t_factor", "t_step", "bad", "reg"} == set(
+    assert {"it", "t_assemble", "t_factor", "t_step", "bad", "reg"} <= set(
         tm[0]
     )
     # seeded reg is capped: f32-phase escalations must not pin the f64
@@ -257,9 +257,10 @@ def test_endgame_bad_step_escalates_without_reassembly(monkeypatch):
     forced = {"n": 0}
     asm_calls = {"n": 0}
 
-    def bad_once_step(A, data, state, L, reg, params, M=None, refine=0):
-        new_state, stats = real_step(A, data, state, L, reg, params, M,
-                                     refine=refine)
+    def bad_once_step(A, data, state, L, reg, diagM, params,
+                      cg_iters=80):
+        new_state, stats = real_step(A, data, state, L, reg, diagM, params,
+                                     cg_iters=cg_iters)
         if forced["n"] == 0:
             forced["n"] += 1
             stats = stats._replace(bad=True)
@@ -293,9 +294,9 @@ def test_endgame_numerical_error_exit(monkeypatch):
 
     real_step = d._endgame_step
 
-    def always_bad(A, data, state, L, reg, params, M=None, refine=0):
-        new_state, stats = real_step(A, data, state, L, reg, params, M,
-                                     refine=refine)
+    def always_bad(A, data, state, L, reg, diagM, params, cg_iters=80):
+        new_state, stats = real_step(A, data, state, L, reg, diagM, params,
+                                     cg_iters=cg_iters)
         return new_state, stats._replace(bad=True)
 
     monkeypatch.setattr(d, "_endgame_step", always_bad)
@@ -314,9 +315,9 @@ def test_endgame_stall_exit(monkeypatch):
 
     real_step = d._endgame_step
 
-    def frozen_step(A, data, state, L, reg, params, M=None, refine=0):
-        _, stats = real_step(A, data, state, L, reg, params, M,
-                             refine=refine)
+    def frozen_step(A, data, state, L, reg, diagM, params, cg_iters=80):
+        _, stats = real_step(A, data, state, L, reg, diagM, params,
+                             cg_iters=cg_iters)
         return state, stats  # no progress: same iterate every time
 
     monkeypatch.setattr(d, "_endgame_step", frozen_step)
