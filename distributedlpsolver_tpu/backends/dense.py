@@ -358,6 +358,15 @@ def _pcg_ops(A, factor_dtype, use_pallas, Af, cg_tol, cg_iters,
 # split it into row-range pieces next.
 # ----------------------------------------------------------------------
 
+# Endgame-local regularization ladder step. The fused phases escalate by
+# cfg.reg_grow (default 100) — too coarse here: the emulated-f64
+# Cholesky NaNs below a state-dependent threshold, the direction bias
+# (and so the attainable pinf) scales LINEARLY with the reg actually
+# used, and a ×100 ladder overshoots the minimal factorable reg by up
+# to 100×. Factor+step retries cost ~2 s (assembly held), so the finer
+# ladder is nearly free.
+_EG_REG_GROW = 10.0
+
 
 @functools.partial(jax.jit, static_argnames=("params",))
 def _endgame_assemble(A, data, state, params):
@@ -417,28 +426,26 @@ def _endgame_factor(M, reg):
     return jnp.linalg.cholesky(Ms), s
 
 
-@functools.partial(jax.jit, static_argnames=("params", "cg_iters"))
-def _endgame_step(A, data, state, Ls, reg, diagM, params, cg_iters=80):
+@functools.partial(jax.jit, static_argnames=("params", "refine"))
+def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=1):
     """One Mehrotra step with the factorization INJECTED (computed by the
-    preceding dispatches); each Newton solve runs CG on the TRUE
-    matrix-free operator, preconditioned by the regularized f64 factor.
+    preceding dispatches); solves run through the regularized
+    Jacobi-scaled f64 factor with ``refine`` exact-residual sweeps.
 
-    Why not cho_solve + refinement: the emulated-f64 Cholesky of the
-    REAL late-IPM spectrum at 10k scale produces NaN below reg ≈ 1e-8
-    (diagnosed via the committed per-attempt L_finite telemetry —
-    synthetic spectra factor fine at 1e-12, the real eigenvalue cluster
-    near zero does not), and at the factorable reg = 1e-6 the direction
-    bias pins pinf at ~1e-5. CG against the exact operator
-    ``M·v = A·(d·(Aᵀv))`` (chunked ew-f64 GEMVs) with the
-    (M + reg·diagM)-factor as preconditioner converges in
-    ~√(1 + reg·d/λ_min) ≈ tens of sweeps to TRUE f64 directions — the
-    factorization's reg floor stops mattering. Also keeps the program
-    small (one while_loop per solve), which is a hard constraint: the
-    remote compiler's response drops after ~55 minutes.
-
-    KKT-level refinement is OFF (params arrives with kkt_refine=0): the
-    CG solves already deliver full-f64 direction quality.
-    """
+    The REGULARIZED solve is the right object at this conditioning:
+    CG on the exact operator was tried and cannot converge — the
+    preconditioned spectrum λ/(λ+reg·d) still spans ~1e11 at the real
+    late-IPM eigenvalue cluster (measured: 80 preconditioned sweeps
+    bought <1e-3 residual reduction), while the Tikhonov-filtered
+    direct solve yields usable directions whose bias scales with reg.
+    Accuracy therefore hinges on running at the SMALLEST factorable reg
+    (the emulated-f64 Cholesky NaNs below a state-dependent threshold —
+    see the ×10 retry ladder in _endgame_loop), with the refinement
+    sweep (matrix-free exact f64 residual of the regularized system)
+    recovering full solve quality against factor rounding. KKT-level
+    refinement is OFF (params arrives with kkt_refine=0); program size
+    is a hard constraint — the remote compiler's response drops after
+    ~55 minutes."""
     d_scale = core.scaling_d(state, data, params)
 
     def factorize(d):
@@ -446,14 +453,12 @@ def _endgame_step(A, data, state, Ls, reg, diagM, params, cg_iters=80):
 
     def solve(Lf, rhs):
         L, s = Lf  # Jacobi-scaled factor: (M+regD)⁻¹ = s·(LLᵀ)⁻¹·s
-
-        def op(v):
-            return _matvec_chunked(A, d_scale * _rmatvec_chunked(A, v))
-
-        def prec(r):
-            return s * jax.scipy.linalg.cho_solve((L, True), s * r)
-
-        return core.pcg_solve(op, prec, rhs, 1e-12, cg_iters)
+        x = s * jax.scipy.linalg.cho_solve((L, True), s * rhs)
+        for _ in range(refine):
+            Mx = _matvec_chunked(A, d_scale * _rmatvec_chunked(A, x))
+            r = rhs - Mx - reg * diagM * x
+            x = x + s * jax.scipy.linalg.cho_solve((L, True), s * r)
+        return x
 
     ops = core.LinOps(
         xp=jnp,
@@ -1106,12 +1111,11 @@ class DenseJaxBackend(SolverBackend):
                 refactor += 1
                 good_streak = 0
                 # Decay (below) must never re-enter a reg that already
-                # failed: without this floor a 10×-up/10×-down cycle
-                # repeats the failing factorization EVERY iteration
-                # (observed at 10k×50k: one guaranteed bad step per
-                # iterate, reg thrashing 1e-9 ↔ 1e-8).
-                reg_fail_floor = max(reg_fail_floor, reg * cfg.reg_grow)
-                reg *= cfg.reg_grow
+                # failed: without this floor an up/down cycle repeats
+                # the failing factorization EVERY iteration (observed at
+                # 10k×50k: one guaranteed bad step per iterate).
+                reg_fail_floor = max(reg_fail_floor, reg * _EG_REG_GROW)
+                reg *= _EG_REG_GROW
                 if trace:
                     import sys as _sys
 
@@ -1157,7 +1161,7 @@ class DenseJaxBackend(SolverBackend):
             if good_streak >= 4:
                 reg_fail_floor = 0.0
                 good_streak = 0
-            reg = max(reg / cfg.reg_grow, reg_base, reg_fail_floor)
+            reg = max(reg / _EG_REG_GROW, reg_base, reg_fail_floor)
             state = new_state
             it += 1
             k += 1

@@ -257,10 +257,9 @@ def test_endgame_bad_step_escalates_without_reassembly(monkeypatch):
     forced = {"n": 0}
     asm_calls = {"n": 0}
 
-    def bad_once_step(A, data, state, L, reg, diagM, params,
-                      cg_iters=80):
+    def bad_once_step(A, data, state, L, reg, diagM, params, refine=1):
         new_state, stats = real_step(A, data, state, L, reg, diagM, params,
-                                     cg_iters=cg_iters)
+                                     refine=refine)
         if forced["n"] == 0:
             forced["n"] += 1
             stats = stats._replace(bad=True)
@@ -294,9 +293,9 @@ def test_endgame_numerical_error_exit(monkeypatch):
 
     real_step = d._endgame_step
 
-    def always_bad(A, data, state, L, reg, diagM, params, cg_iters=80):
+    def always_bad(A, data, state, L, reg, diagM, params, refine=1):
         new_state, stats = real_step(A, data, state, L, reg, diagM, params,
-                                     cg_iters=cg_iters)
+                                     refine=refine)
         return new_state, stats._replace(bad=True)
 
     monkeypatch.setattr(d, "_endgame_step", always_bad)
@@ -315,9 +314,9 @@ def test_endgame_stall_exit(monkeypatch):
 
     real_step = d._endgame_step
 
-    def frozen_step(A, data, state, L, reg, diagM, params, cg_iters=80):
+    def frozen_step(A, data, state, L, reg, diagM, params, refine=1):
         _, stats = real_step(A, data, state, L, reg, diagM, params,
-                             cg_iters=cg_iters)
+                             refine=refine)
         return state, stats  # no progress: same iterate every time
 
     monkeypatch.setattr(d, "_endgame_step", frozen_step)
