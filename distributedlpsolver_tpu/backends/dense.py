@@ -470,6 +470,218 @@ def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=1):
     return core.mehrotra_step(ops, data, params, state)
 
 
+# ----------------------------------------------------------------------
+# Host-factor endgame (the true-f64 finish on emulated-f64 hardware).
+#
+# Measured on the 10k×50k reference config (BENCH_10K.json, round 3
+# pre-host): the emulated-f64 (double-double) Cholesky NaNs below
+# reg ≈ 1e-7 on the real late-IPM spectrum, and the reg actually used
+# floors both μ (≈3e-10 — steps at α≈1 stop reducing complementarity
+# because the solve error dominates the corrector RHS) and pinf
+# (sublinear in reg: 1.24e-5 at 1e-6, 8.0e-6 at 1e-7). Host LAPACK f64
+# (ε = 2.2e-16 vs the double-double's effective ≈4e-15, plus LAPACK's
+# guarded pivots instead of NaN propagation) factors the same matrices
+# at reg ≈ 1e-11 — four orders less Tikhonov bias. Only the m×m factor
+# and the m-vector triangular solves cross to the host; the O(m²·n)
+# assembly and every refinement matvec stay on device. The step runs
+# core.mehrotra_step EAGERLY (axon_pjrt rejects pure_callback, so the
+# solve cannot be injected into a jitted program; measured eager op
+# latency ~28 ms and 80 KB host↔device hops ~100 ms put the eager
+# overhead at seconds/iteration against the ~60 s M transfer).
+# ----------------------------------------------------------------------
+
+
+def _endgame_factor_host(Mh, reg):
+    """True-f64 host (LAPACK) Cholesky of the Jacobi-scaled, regularized
+    system: factors ``s·Mh·s + reg·I`` (unit diagonal — same scaling
+    rationale as :func:`_endgame_factor`). Returns ``(L, s)`` or None if
+    the factorization fails at this reg (caller escalates the ladder;
+    retries re-use the SAME host copy — no device re-assembly or
+    re-transfer)."""
+    import scipy.linalg as sla
+
+    s = 1.0 / np.sqrt(np.maximum(np.diagonal(Mh), np.finfo(np.float64).tiny))
+    Ms = Mh * s[:, None]
+    Ms *= s[None, :]
+    Ms[np.diag_indices_from(Ms)] += reg
+    try:
+        L = sla.cholesky(Ms, lower=True, overwrite_a=True, check_finite=False)
+    except np.linalg.LinAlgError:
+        return None
+    if not np.all(np.isfinite(L[:: max(1, L.shape[0] // 64)])):
+        return None
+    return L, s
+
+
+@jax.jit
+def _eg_op_residual(A, d, diagM, reg, xv, rhs):
+    """``rhs − (A·diag(d)·Aᵀ + reg·diag(diagM))·x`` — the true-operator
+    refinement residual, one device dispatch per sweep (exact emulated-f64
+    matvec pair; never forms M)."""
+    return rhs - _matvec_chunked(A, d * _rmatvec_chunked(A, xv)) - reg * diagM * xv
+
+
+def _endgame_step_host(A, data, state, hostf, reg, diagM, params, refine=1):
+    """One Mehrotra step with the factorization resident on the HOST in
+    true f64. ``core.mehrotra_step`` runs eagerly (one implementation of
+    the step shared with every other path) over ops whose solve ships the
+    m-vector RHS to host LAPACK and refines against the true operator on
+    device. KKT-level refinement is affordable again here (no device
+    program to size-limit), restoring the cancellation digits the
+    device endgame had to give up (see core._solve_kkt's rationale)."""
+    import scipy.linalg as sla
+
+    L, sh = hostf
+    d_scale = core.scaling_d(state, data, params)
+    regj = jnp.asarray(reg, diagM.dtype)
+
+    def host_tri(rh):
+        return sh * sla.cho_solve((L, True), sh * rh, check_finite=False)
+
+    def solve(_, rhs):
+        rhs_h = np.asarray(rhs)
+        xh = host_tri(rhs_h)
+        for _ in range(refine):
+            r = np.asarray(
+                _eg_op_residual(A, d_scale, diagM, regj, jnp.asarray(xh), rhs)
+            )
+            xh = xh + host_tri(r)
+        return jnp.asarray(xh)
+
+    ops = core.LinOps(
+        xp=jnp,
+        matvec=lambda v: _matvec_chunked(A, v),
+        rmatvec=lambda v: _rmatvec_chunked(A, v),
+        factorize=lambda d: None,
+        solve=solve,
+    )
+    return core.mehrotra_step(ops, data, params, state)
+
+
+@jax.jit
+def _eg_pinf(A, data, x, w):
+    """Relative primal infeasibility of (x, w) — the projector's accept
+    test, same normalization as core.residual_norms."""
+    r_p = data.b - _matvec_chunked(A, x)
+    r_u = data.hub * (data.u_f - x - w)
+    return jnp.sqrt(jnp.sum(r_p * r_p) + jnp.sum(r_u * r_u)) / data.norm_b
+
+
+@jax.jit
+def _eg_w_op_residual(A, wdiag, t, r):
+    """``r − (A·diag(w)·Aᵀ)·t`` — projector refinement residual."""
+    return r - _matvec_chunked(A, wdiag * _rmatvec_chunked(A, t))
+
+
+@jax.jit
+def _eg_norms(A, data, state):
+    """Full residual_norms of a state in one dispatch — re-scores the
+    recorded iteration row after a feasibility projection moved x."""
+    ops = core.LinOps(
+        xp=jnp,
+        matvec=lambda v: _matvec_chunked(A, v),
+        rmatvec=lambda v: _rmatvec_chunked(A, v),
+        factorize=None,
+        solve=None,
+    )
+    return core.residual_norms(ops, data, state)
+
+
+def _build_host_projector(A, data, state, trace=False):
+    """Capped-weight primal feasibility restoration.
+
+    The diagnosed terminal-pinf wall (BENCH_10K.json round-3 analysis) is
+    the near-null-space component of the feasibility RHS: the IPM's
+    *weighted* normal matrix A·D²·Aᵀ collapses exactly the directions
+    that component needs (D → 0 on nonbasic columns), so no regularized
+    solve of it can restore Ax = b. This projector solves the SAME
+    restoration with weights that cannot collapse:
+
+        min ‖W^{-1/2}Δx‖  s.t.  A·Δx = b − A·x,
+        Δx = W·Aᵀ·(A·W·Aᵀ)⁻¹·(b − A·x),   W = diag(min(x, τ)² + floor²)
+
+    with τ = the m-th largest component of x (the basic scale). Capping
+    at τ removes D's huge side (basic x/s → ∞ is what wrecks κ(AD²Aᵀ));
+    keeping tiny components tiny means Δx lands on columns that can
+    absorb it without violating x > 0 (an UNweighted projection spreads
+    Δx uniformly and the positivity clamp on ~n tiny nonbasic columns
+    re-pollutes pinf by ‖A‖·‖Δx_clamped‖ — back where it started). For
+    ANY fixed W ≻ 0 the projection is exact: A·Δx = r up to solve
+    precision, so W only shapes where the movement goes. The m×m
+    A·W·Aᵀ is assembled on device, factored ONCE on host (true f64),
+    and each application is two device matvecs + one host solve with
+    true-operator refinement. Returns ``project(state) -> (state',
+    pinf_before, pinf_after)`` or None if no factorization succeeded.
+    """
+    import time as _time
+
+    m, n = A.shape
+    x = state.x
+    xs = jnp.sort(x)
+    tau = float(xs[n - m]) if n > m else float(xs[0])
+    tau = max(tau, 1e-10 * float(xs[-1]), np.finfo(np.float64).tiny)
+    # floor keeps A·W·Aᵀ definite even when fewer than m components reach
+    # basic scale; movement through floor-weighted columns is ~1e-14·τ².
+    wdiag = jnp.minimum(x, tau) ** 2 + (1e-7 * tau) ** 2
+    t0 = _time.perf_counter()
+    G = _normal_eq_chunked(A, wdiag)
+    jax.block_until_ready(G)
+    Gh = np.asarray(G)
+    del G
+    hostf = None
+    reg = 1e-12
+    while reg <= 1e-4:
+        hostf = _endgame_factor_host(Gh, reg)
+        if hostf is not None:
+            break
+        reg *= 100.0
+    del Gh
+    if hostf is None:
+        return None
+    if trace:
+        import sys as _sys
+
+        print(
+            f"[endgame] projector built in {_time.perf_counter() - t0:.1f}s "
+            f"(tau={tau:.3e}, reg={reg:.1e})",
+            file=_sys.stderr, flush=True,
+        )
+    L, sh = hostf
+
+    def host_tri(rh):
+        import scipy.linalg as sla
+
+        return sh * sla.cho_solve((L, True), sh * rh, check_finite=False)
+
+    def project(st):
+        pinf0 = float(_eg_pinf(A, data, st.x, st.w))
+        r = data.b - _matvec_chunked(A, st.x)
+        rh = np.asarray(r)
+        th = host_tri(rh)
+        for _ in range(2):
+            res = np.asarray(_eg_w_op_residual(A, wdiag, jnp.asarray(th), r))
+            th = th + host_tri(res)
+        dx = wdiag * _rmatvec_chunked(A, jnp.asarray(th))
+        x2 = st.x + dx
+        # Guards: strict positivity, and stay strictly inside any finite
+        # upper bound (w is then re-synced so r_u stays ~0). Both clamps
+        # are rare by construction (capped weights keep |Δx_i| ≪ x_i on
+        # tiny columns) — the accept test below backstops the exceptions.
+        x2 = jnp.where(x2 > 0, x2, 0.5 * st.x)
+        x2 = jnp.where(
+            (data.hub > 0) & (x2 >= data.u_f),
+            st.x + 0.5 * (data.u_f - st.x),
+            x2,
+        )
+        w2 = jnp.where(data.hub > 0, data.u_f - x2, st.w)
+        pinf1 = float(_eg_pinf(A, data, x2, w2))
+        if not (pinf1 < pinf0):
+            return st, pinf0, pinf0
+        return st._replace(x=x2, w=w2), pinf0, pinf1
+
+    return project
+
+
 def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
     """Build factorize/solve closures over a (traced) matrix ``A``.
 
@@ -1053,6 +1265,40 @@ class DenseJaxBackend(SolverBackend):
         budget = cfg.max_iter
         refactor = 0
         self.endgame_timings = timings = []
+        # Host-factor mode (cfg.endgame_host; auto = on under emulated
+        # f64): LAPACK factorization + triangular solves on host, assembly
+        # and refinement matvecs on device. The same mode builds the
+        # capped-weight feasibility projector and applies it at entry and
+        # after every good step — together the two mechanisms that break
+        # the round-3 terminal wall (BENCH_10K.json analysis): a four-
+        # orders-smaller factorable reg, and pinf restoration that does
+        # not go through the collapsed-weight normal matrix at all.
+        host_mode = (
+            cfg.endgame_host
+            if cfg.endgame_host is not None
+            else jax.default_backend() == "tpu"
+        )
+        project = None
+        if host_mode:
+            # Eager steps carry no program-size limit — restore one round
+            # of KKT-level refinement (the device endgame had to run 0).
+            params = cfg.replace(kkt_refine=min(cfg.kkt_refine, 1)).step_params()
+            project = _build_host_projector(
+                self._A, self._data, state, trace=trace
+            )
+            if project is not None:
+                state, p0, p1 = project(state)
+                timings.append(
+                    {"projector": True, "pinf_before": float(p0),
+                     "pinf_after": float(p1)}
+                )
+                if trace:
+                    import sys as _sys
+
+                    print(
+                        f"[endgame] entry projection pinf {p0:.3e} -> {p1:.3e}",
+                        file=_sys.stderr, flush=True,
+                    )
         # Holding M across the step amortizes bad-step retries (only the
         # factorization sees the escalated reg), but costs an extra m²·8
         # bytes of HBM concurrent with L and the step's working set —
@@ -1070,25 +1316,88 @@ class DenseJaxBackend(SolverBackend):
             M = _endgame_assemble(self._A, self._data, state, params)
             jax.block_until_ready(M)  # bound each dispatch's device time
             t_asm = _time.perf_counter() - t0
-            diagM = jnp.diagonal(M)  # O(m); survives M's deletion, feeds
-            failed = False           # the matrix-free refinement residual
+            Mh = None
+            if host_mode:
+                # One d2h transfer per iterate (~62 s measured for the
+                # 800 MB 10k×10k over the tunnel, the host path's main
+                # cost); retries refactor from this SAME host copy, and
+                # the device M is freed immediately — the host path never
+                # holds M and L in HBM together.
+                t1 = _time.perf_counter()
+                Mh = np.asarray(M)
+                t_xfer = _time.perf_counter() - t1
+                diagM_h = np.ascontiguousarray(np.diagonal(Mh))
+                diagM = jnp.asarray(diagM_h)
+                del M
+                M = None
+            else:
+                t_xfer = 0.0
+                diagM = jnp.diagonal(M)  # O(m); survives M's deletion,
+            failed = False  # feeds the matrix-free refinement residual
             while True:
                 t1 = _time.perf_counter()
-                L = _endgame_factor(M, jnp.asarray(reg, self._dtype))
-                jax.block_until_ready(L)
-                t_fac = _time.perf_counter() - t1
-                if not hold_m:
-                    del M
-                    M = None
-                t1 = _time.perf_counter()
-                new_state, stats = _endgame_step(
-                    self._A, self._data, state, L,
-                    jnp.asarray(reg, self._dtype), diagM, params,
-                )
-                bad = bool(stats.bad)  # blocks on the step dispatch
-                t_step = _time.perf_counter() - t1
+                if host_mode:
+                    hostf = _endgame_factor_host(Mh, reg)
+                    t_fac = _time.perf_counter() - t1
+                    if hostf is None:
+                        # Failed host factorization: escalate without
+                        # paying for a step dispatch (LAPACK reports
+                        # breakdown instead of propagating NaN).
+                        timings.append({
+                            "it": it, "t_assemble": round(t_asm, 3),
+                            "t_transfer": round(t_xfer, 3),
+                            "t_factor": round(t_fac, 3), "t_step": 0.0,
+                            "bad": True, "reg": float(reg),
+                            "alpha_p": 0.0, "alpha_d": 0.0,
+                            "mu": float("nan"), "sigma": float("nan"),
+                            "L_finite": False, "host": True,
+                        })
+                        t_asm = 0.0
+                        t_xfer = 0.0
+                        refactor += 1
+                        good_streak = 0
+                        reg_fail_floor = max(reg_fail_floor, reg * _EG_REG_GROW)
+                        reg *= _EG_REG_GROW
+                        if trace:
+                            import sys as _sys
+
+                            print(
+                                f"[endgame] it={it} host factor failed, "
+                                f"reg->{reg:.1e}",
+                                file=_sys.stderr, flush=True,
+                            )
+                        if refactor > cfg.max_refactor or reg > 1e-2:
+                            failed = True
+                            break
+                        continue
+                    t1 = _time.perf_counter()
+                    new_state, stats = _endgame_step_host(
+                        self._A, self._data, state, hostf, float(reg),
+                        diagM, params,
+                    )
+                    bad = bool(np.asarray(stats.bad))
+                    t_step = _time.perf_counter() - t1
+                    L_finite = True
+                else:
+                    L = _endgame_factor(M, jnp.asarray(reg, self._dtype))
+                    jax.block_until_ready(L)
+                    t_fac = _time.perf_counter() - t1
+                    if not hold_m:
+                        del M
+                        M = None
+                    t1 = _time.perf_counter()
+                    new_state, stats = _endgame_step(
+                        self._A, self._data, state, L,
+                        jnp.asarray(reg, self._dtype), diagM, params,
+                    )
+                    bad = bool(stats.bad)  # blocks on the step dispatch
+                    t_step = _time.perf_counter() - t1
+                    L_finite = bool(
+                        np.isfinite(float(np.asarray(jnp.sum(L[0]))))
+                    )
                 timings.append({
                     "it": it, "t_assemble": round(t_asm, 3),
+                    "t_transfer": round(t_xfer, 3),
                     "t_factor": round(t_fac, 3),
                     "t_step": round(t_step, 3),
                     "bad": bad, "reg": float(reg),
@@ -1101,11 +1410,11 @@ class DenseJaxBackend(SolverBackend):
                     "alpha_d": float(np.asarray(stats.alpha_d)),
                     "mu": float(np.asarray(stats.mu)),
                     "sigma": float(np.asarray(stats.sigma)),
-                    "L_finite": bool(
-                        np.isfinite(float(np.asarray(jnp.sum(L[0]))))
-                    ),
+                    "L_finite": L_finite,
+                    "host": host_mode,
                 })
                 t_asm = 0.0  # amortized: no re-assembly on retries
+                t_xfer = 0.0
                 if not bad:
                     break
                 refactor += 1
@@ -1127,7 +1436,9 @@ class DenseJaxBackend(SolverBackend):
                 if refactor > cfg.max_refactor or reg > 1e-2:
                     failed = True
                     break
-                if M is None:  # big-m path dropped M before the step
+                if M is None and not host_mode:
+                    # Big-m device path dropped M before the step (host
+                    # mode refactors from the held host copy instead).
                     # The failed factor is dead — free it BEFORE the
                     # re-assembly, the same assembly+L concurrency the
                     # iteration-boundary del below exists to avoid.
@@ -1141,8 +1452,13 @@ class DenseJaxBackend(SolverBackend):
                 del M
             # The factor is dead once the step consumed it — freeing its
             # m²·8 bytes BEFORE the next assembly dispatch is what keeps
-            # the 10k-scale endgame inside HBM across iterations.
-            del L
+            # the 10k-scale endgame inside HBM across iterations (host
+            # mode: the host copies go instead, ~2.4 GB of RAM each).
+            if host_mode:
+                Mh = None
+                hostf = None
+            else:
+                del L
             dt = _time.perf_counter() - t0
             if failed:
                 status = core.STATUS_NUMERR
@@ -1172,6 +1488,29 @@ class DenseJaxBackend(SolverBackend):
                     "alpha_p", "alpha_d", "sigma",
                 )
             ]
+            if project is not None:
+                # Restore Ax = b after the (regularized) step — the
+                # Tikhonov filtering re-pollutes exactly the component
+                # the projector removes — then re-score the row so the
+                # convergence test below sees the projected iterate.
+                t1 = _time.perf_counter()
+                state, p0, p1 = project(state)
+                if p1 < p0:
+                    norms = [
+                        float(np.asarray(v))
+                        for v in _eg_norms(self._A, self._data, state)
+                    ]
+                    # residual_norms order: pinf dinf gap rel_gap pobj dobj mu
+                    row[0] = norms[6]
+                    row[1:7] = [norms[2], norms[3], norms[0], norms[1],
+                                norms[4], norms[5]]
+                timings[-1]["t_project"] = round(
+                    _time.perf_counter() - t1, 3
+                )
+                timings[-1]["pinf_proj"] = float(p1)
+                # p1 == p0 ⇒ the projection was REJECTED (accept test:
+                # strictly improved pinf) and the state is untouched.
+                timings[-1]["proj_from"] = float(p0)
             rows.append(row)
             err = max(row[2], row[3], row[4])  # rel_gap, pinf, dinf
             if trace:

@@ -78,6 +78,18 @@ class SolverConfig:
     # block_angular._solve_segmented).
     pcg_handoff_tol: float = 1e-6
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
+    # Endgame factorization placement (dense huge-m finish). On hardware
+    # whose f64 is emulated (TPU), the endgame's Cholesky breaks down
+    # (NaN) orders of magnitude above real-f64 breakdown — measured at
+    # 10k×50k: unfactorable below reg ≈ 1e-7 on-device while host LAPACK
+    # factors the same matrix at reg ≈ 1e-11 — and the attainable
+    # pinf/μ floor scales with the reg actually used. True moves ONLY
+    # the m×m factorization and triangular solves to host LAPACK (true
+    # f64); the O(m²·n) assembly and all refinement matvecs stay on
+    # device. False forces the on-device factorization. None = auto:
+    # host on TPU, device elsewhere (where device f64 already IS
+    # LAPACK-grade).
+    endgame_host: Optional[bool] = None
     # Ruiz-equilibrate the interior form before solving (presolve scaling;
     # convergence is then tested in the scaled space, standard practice).
     scale: bool = True
@@ -107,6 +119,15 @@ class SolverConfig:
     profile_dir: Optional[str] = None  # jax.profiler trace dir (SURVEY.md §5.1)
 
     def __post_init__(self):
+        if self.endgame_host is not None and not isinstance(
+            self.endgame_host, bool
+        ):
+            # A string ("host"/"device") would be truthy and silently
+            # select host mode either way — reject like solve_mode does.
+            raise ValueError(
+                f"endgame_host must be None, True, or False; "
+                f"got {self.endgame_host!r}"
+            )
         if self.solve_mode not in (None, "direct", "pcg"):
             # A typo ("PCG", "cg") silently selecting the direct path
             # would re-enable the emulated-f64 work the mode exists to
