@@ -62,11 +62,15 @@ class BatchedResult:
         return int(np.sum(self.status == Status.OPTIMAL))
 
 
-def _single_step(A, data, state, reg, params, factor_dtype, Af=None):
+def _single_step(A, data, state, reg, params, factor_dtype, Af=None,
+                 cg_iters=0, cg_tol=0.0):
     # Af: loop-invariant precast copy — with a low-precision factor_dtype
     # the O(m²n) normal-equations assembly then runs at that precision on
     # the MXU instead of in emulated f64 (see dense._cholesky_ops).
-    ops = _make_ops(A, reg, factor_dtype, 0, False, Af)
+    # cg_iters > 0 selects the PCG ops (f32 preconditioner + matrix-free
+    # full-precision CG — dense._pcg_ops, everything traceable, so the
+    # whole solve vmaps over the batch).
+    ops = _make_ops(A, reg, factor_dtype, 0, False, Af, cg_iters, cg_tol)
     return core.mehrotra_step(ops, data, params, state)
 
 
@@ -78,6 +82,7 @@ def _single_start(A, data, reg, params, factor_dtype, Af=None):
 def _batched_phase(
     A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
     it_stop=None, stall_window=0, stall_status=_RUNNING, A32=None,
+    cg_iters=0, cg_tol=0.0,
 ):
     """One masked batched IPM while_loop phase over the whole batch.
 
@@ -104,11 +109,15 @@ def _batched_phase(
         states, active, it, regs, badcount, status, iters, best, since = carry
         if A32 is not None:
             new_states, stats = jax.vmap(
-                lambda a, a32, d, st, rg: _single_step(a, d, st, rg, params, fdt, a32)
+                lambda a, a32, d, st, rg: _single_step(
+                    a, d, st, rg, params, fdt, a32, cg_iters, cg_tol
+                )
             )(A, A32, data, states, regs)
         else:
             new_states, stats = jax.vmap(
-                lambda a, d, st, rg: _single_step(a, d, st, rg, params, fdt)
+                lambda a, d, st, rg: _single_step(
+                    a, d, st, rg, params, fdt, None, cg_iters, cg_tol
+                )
             )(A, data, states, regs)
         bad = stats.bad
         conv = (
@@ -156,15 +165,20 @@ def _batched_phase(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("params", "factor_dtype", "stall_window", "stall_status"),
+    static_argnames=(
+        "params", "factor_dtype", "stall_window", "stall_status",
+        "cg_iters", "cg_tol",
+    ),
 )
 def _batched_segment_jit(
     A, data, carry, it_stop, max_iter, max_refactor, reg_grow, params,
     factor_dtype, stall_window=0, stall_status=_RUNNING, A32=None,
+    cg_iters=0, cg_tol=0.0,
 ):
     out = _batched_phase(
         A, data, carry, params, max_iter, max_refactor, reg_grow,
         jnp.dtype(factor_dtype), it_stop, stall_window, stall_status, A32,
+        cg_iters, cg_tol,
     )
     # Packed [it, status, n_active, n_unfinished] in core.drive_segments'
     # meta layout (one device→host transfer per segment — separate scalar
@@ -202,11 +216,14 @@ def _batched_start_jit(A, data, reg0, params, factor_dtype):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("params", "params_p1", "factor_dtype", "two_phase", "stall_window"),
+    static_argnames=(
+        "params", "params_p1", "factor_dtype", "two_phase", "stall_window",
+        "cg_iters", "cg_tol",
+    ),
 )
 def _solve_batched_jit(
     A, data, reg0, params, params_p1, max_iter, max_refactor, reg_grow,
-    factor_dtype, two_phase, stall_window=0,
+    factor_dtype, two_phase, stall_window=0, cg_iters=0, cg_tol=0.0,
 ):
     # max_iter / max_refactor / reg_grow are traced scalars so one compile
     # serves every iteration-limit config (warm-up shares the timed compile).
@@ -222,9 +239,10 @@ def _solve_batched_jit(
     dtype = A.dtype
     # Loop-invariant f32 copy for f32 factorizations AND their assembly
     # (without it the O(m²n) assembly runs emulated-f64) — used by the
-    # two-phase first phase and by an explicit single-phase f32 config.
+    # two-phase first phase, the PCG middle phase's preconditioner, and
+    # an explicit single-phase f32 config.
     f32 = jnp.dtype(jnp.float32)
-    A32 = A.astype(f32) if (two_phase or fdt == f32) else None
+    A32 = A.astype(f32) if (two_phase or fdt == f32 or cg_iters) else None
     # The starting point stays at full precision even under two-phase: it
     # is ONE factorization amortized over the whole solve, and an f32
     # Mehrotra least-squares start can be bad enough on an ill-conditioned
@@ -242,6 +260,19 @@ def _solve_batched_jit(
         )
         # keep states + per-problem iters; reset provisional verdicts
         carry = _fresh_batch_carry(carry[0], carry[6], B, reg0, dtype)
+    if cg_iters:
+        # PCG middle phase at FULL tolerance: f32 preconditioner + f64
+        # matrix-free CG — no emulated-f64 assembly or Cholesky. Its
+        # OPTIMAL verdicts are final (honest f64 residuals); only
+        # stalled/unconverged members re-enter the f64 finish.
+        carry = _batched_phase(
+            A, data, carry, params, max_iter, max_refactor, reg_grow,
+            jnp.dtype(jnp.float32), None, stall_window, _RUNNING, A32,
+            cg_iters, cg_tol,
+        )
+        carry = _fresh_batch_carry(
+            carry[0], carry[6], B, reg0, dtype, status=carry[5]
+        )
     states, active, _, _, _, status, iters, _, _ = _batched_phase(
         A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
         None, 2 * stall_window if stall_window else 0, _STALL,
@@ -269,21 +300,36 @@ def _cleanup_cap(B: int) -> int:
     return max(4, B // 8)
 
 
-def _fresh_batch_carry(states, iters, B, reg0, dtype):
+def _fresh_batch_carry(states, iters, B, reg0, dtype, status=None):
+    """Phase-boundary carry reset. With ``status=None`` every member
+    re-enters the next phase (the f32 phase-1 reset: its verdicts are
+    provisional — tol was loosened). Passing the previous phase's status
+    keeps _OPTIMAL members SETTLED: a full-tolerance phase (the PCG
+    middle phase) judged them with honest f64 residuals, so re-running
+    them through the f64 finish would burn its per-iteration cost on
+    already-final members."""
+    if status is None:
+        active = jnp.ones(B, dtype=bool)
+        status = jnp.full(B, _RUNNING, jnp.int32)
+    else:
+        active = status != _OPTIMAL
+        status = jnp.where(status == _OPTIMAL, _OPTIMAL, _RUNNING)
     return (
         states,
-        jnp.ones(B, dtype=bool),
+        active,
         jnp.asarray(0, jnp.int32),
         jnp.full(B, reg0, dtype=dtype),
         jnp.zeros(B, jnp.int32),
-        jnp.full(B, _RUNNING, jnp.int32),
+        status,
         iters,
         jnp.full(B, jnp.inf, dtype=dtype),
         jnp.zeros(B, jnp.int32),
     )
 
 
-def _solve_batched_segmented(A, data, cfg, params, params_p1, fname, two_phase, seg):
+def _solve_batched_segmented(
+    A, data, cfg, params, params_p1, fname, two_phase, seg, cg=(0, 0.0)
+):
     """Host-segmented batched solve: same phases as _solve_batched_jit but
     each device program is bounded to ~15s (execution-watchdog guard —
     long fused batched solves trip the ~60s limit on tunneled TPUs)."""
@@ -293,20 +339,28 @@ def _solve_batched_segmented(A, data, cfg, params, params_p1, fname, two_phase, 
     mi = jnp.asarray(cfg.max_iter, jnp.int32)
     mr = jnp.asarray(cfg.max_refactor, jnp.int32)
     rg = jnp.asarray(cfg.reg_grow, dtype)
-    A32 = A.astype(jnp.float32) if (two_phase or fname == "float32") else None
+    cgi, cgt = cg
+    A32 = (
+        A.astype(jnp.float32)
+        if (two_phase or fname == "float32" or cgi)
+        else None
+    )
     # Starting point at the resolved factor dtype (== full dtype under the
     # auto two-phase schedule) — see _solve_batched_jit for why an f32
     # start under two-phase is dangerous.
     states0 = _batched_start_jit(A, data, reg0, params, fname)
 
+    # Phase tuples: (step params, factor dtype, stall window, stall
+    # status, cg iters, keep-optimal-at-exit). The PCG middle phase runs
+    # at FULL tolerance, so its optimal verdicts survive the boundary;
+    # the f32 phase-1 verdicts are provisional and reset.
     w = cfg.stall_window
+    phases = []
     if two_phase:
-        phases = [
-            (params_p1, "float32", w, _RUNNING),
-            (params, fname, 2 * w if w else 0, _STALL),
-        ]
-    else:
-        phases = [(params, fname, 2 * w if w else 0, _STALL)]
+        phases.append((params_p1, "float32", w, _RUNNING, 0, False))
+    if cgi:
+        phases.append((params, "float32", w, _RUNNING, cgi, True))
+    phases.append((params, fname, 2 * w if w else 0, _STALL, 0, False))
     carry = _fresh_batch_carry(states0, jnp.zeros(B, jnp.int32), B, reg0, dtype)
     # Tail extraction: a handful of stragglers would otherwise keep the
     # full-batch masked loop running at whole-batch cost per iteration.
@@ -319,14 +373,15 @@ def _solve_batched_segmented(A, data, cfg, params, params_p1, fname, two_phase, 
     # problem is never left without its cleanup solve.
     tail = B // 32
     cleanup_cap = _cleanup_cap(B)
-    for pi, (p, f, win, wstat) in enumerate(phases):
+    for pi, (p, f, win, wstat, pcgi, keep_opt) in enumerate(phases):
         final = pi == len(phases) - 1
 
-        def run_seg(c, stop, _a=(p, f, win, wstat)):
-            pp, ff, w, ws = _a
+        def run_seg(c, stop, _a=(p, f, win, wstat, pcgi)):
+            pp, ff, w, ws, ci = _a
             return _batched_segment_jit(
                 A, data, c, jnp.asarray(stop, jnp.int32), mi, mr, rg, pp, ff,
-                w, ws, A32 if ff == "float32" else None,
+                w, ws, A32 if ff == "float32" else None, ci,
+                cgt if ci else 0.0,
             )
 
         # Batch-level stall/status live per problem inside the device loop;
@@ -345,13 +400,36 @@ def _solve_batched_segmented(A, data, cfg, params, params_p1, fname, two_phase, 
             ),
         )
         if not final:
-            # Phase boundary: provisional f32 verdicts reset, iterates kept.
-            carry = _fresh_batch_carry(carry[0], carry[6], B, reg0, dtype)
+            # Phase boundary: iterates kept; verdicts reset — except a
+            # full-tolerance phase's OPTIMAL members, which stay settled.
+            carry = _fresh_batch_carry(
+                carry[0], carry[6], B, reg0, dtype,
+                status=carry[5] if keep_opt else None,
+            )
 
     states, _, _, _, _, status, iters, _, _ = carry
     status = jnp.where(status == _RUNNING, _MAXITER, status)
     pinf, dinf, rel_gap, pobj = _batched_norms_jit(A, data, states, fname)
     return states, status, iters, pinf, dinf, rel_gap, pobj
+
+
+def member_interior_form(batch: BatchedLP, i: int):
+    """One batch member as a standalone InteriorForm — the solo-cleanup
+    path's input, exported so bench warm-ups can compile the SAME dense
+    solo programs the cleanup will run (its first compile otherwise lands
+    inside the timed solve)."""
+    from distributedlpsolver_tpu.models.problem import InteriorForm, _SHIFT
+
+    n = np.asarray(batch.A).shape[2]
+    return InteriorForm(
+        c=np.asarray(batch.c[i], dtype=np.float64),
+        A=np.asarray(batch.A[i], dtype=np.float64),
+        b=np.asarray(batch.b[i], dtype=np.float64),
+        u=np.full(n, np.inf), c0=0.0, orig_n=n,
+        col_kind=np.full(n, _SHIFT, dtype=np.int8),
+        col_orig=np.arange(n), col_shift=np.zeros(n),
+        col_sign=np.ones(n), name=f"{batch.name}[{i}]",
+    )
 
 
 def _concat_results(parts, solve_time, setup_time) -> BatchedResult:
@@ -464,12 +542,21 @@ def solve_batched(
     t1 = time.perf_counter()
     two_phase = cfg.two_phase_enabled(jax.default_backend())
     params_p1 = cfg.phase1_params()
+    # PCG middle phase (full tolerance, f32 preconditioner + f64
+    # matrix-free CG): replaces most of the f64 finish's per-iteration
+    # emulated-f64 assembly+Cholesky — the batched phase-2 cost center —
+    # with MXU work. Auto-on wherever the two-phase schedule is (TPU);
+    # "direct" opts out, "pcg" opts in anywhere.
+    use_pcg = cfg.cg_iters > 0 and (
+        cfg.solve_mode == "pcg" or (cfg.solve_mode is None and two_phase)
+    )
+    cg = (cfg.cg_iters, cfg.cg_tol) if use_pcg else (0, 0.0)
     seg = cfg.segment_iters
     if seg is None:
         seg = 8 if jax.default_backend() == "tpu" else 0
     if seg:
         states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_segmented(
-            A, data, cfg, params, params_p1, fname, two_phase, seg
+            A, data, cfg, params, params_p1, fname, two_phase, seg, cg
         )
     else:
         states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_jit(
@@ -484,6 +571,8 @@ def solve_batched(
             fname,
             two_phase,
             cfg.stall_window,
+            cg[0],
+            cg[1],
         )
     jax.block_until_ready(states)
 
@@ -514,7 +603,6 @@ def solve_batched(
     bad = [i for i in range(Bsz) if status_arr[i] != Status.OPTIMAL]
     if bad and len(bad) <= _cleanup_cap(Bsz):
         from distributedlpsolver_tpu.ipm.driver import solve as _solve
-        from distributedlpsolver_tpu.models.problem import InteriorForm, _SHIFT
 
         base_cfg = cfg.replace(
             verbose=False, log_jsonl=None, checkpoint_path=None,
@@ -525,7 +613,7 @@ def solve_batched(
         # the cleanup comparison must use the same total — comparing
         # against a single max_iter would deny tail-extracted members the
         # cleanup solve the early stop promised them.
-        n_phases = 2 if two_phase else 1
+        n_phases = 1 + (1 if two_phase else 0) + (1 if use_pcg else 0)
         for i in bad:
             # The solo solve only gets what the batched loop left unspent
             # (tail-extracted members keep most of theirs; genuine
@@ -536,15 +624,7 @@ def solve_batched(
             solo_cfg = base_cfg.replace(max_iter=remaining)
             # Per-member host conversion — full-batch f64 copies just to
             # patch a handful of rows would be ~hundreds of MB transient.
-            inf_i = InteriorForm(
-                c=np.asarray(batch.c[i], dtype=np.float64),
-                A=np.asarray(batch.A[i], dtype=np.float64),
-                b=np.asarray(batch.b[i], dtype=np.float64),
-                u=np.full(n, np.inf), c0=0.0, orig_n=n,
-                col_kind=np.full(n, _SHIFT, dtype=np.int8),
-                col_orig=np.arange(n), col_shift=np.zeros(n),
-                col_sign=np.ones(n), name=f"{batch.name}[{i}]",
-            )
+            inf_i = member_interior_form(batch, i)
             ws = IPMState(
                 x=x[i],
                 y=np.asarray(states.y[i], dtype=np.float64),

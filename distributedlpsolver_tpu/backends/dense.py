@@ -588,43 +588,41 @@ def _eg_norms(A, data, state):
 
 
 def _build_host_projector(A, data, state, trace=False):
-    """Capped-weight primal feasibility restoration.
+    """Primal feasibility restoration by alternating projections.
 
     The diagnosed terminal-pinf wall (BENCH_10K.json round-3 analysis) is
     the near-null-space component of the feasibility RHS: the IPM's
     *weighted* normal matrix A·D²·Aᵀ collapses exactly the directions
     that component needs (D → 0 on nonbasic columns), so no regularized
-    solve of it can restore Ax = b. This projector solves the SAME
-    restoration with weights that cannot collapse:
+    solve of it can restore Ax = b — and for the same reason ANY
+    x-derived reweighting fails structurally (a capped-weight variant
+    min ‖W^{-1/2}Δx‖, W = min(x, τ)², was tried first: the residual
+    component lives in directions reachable only through the tiny-x
+    columns W zeroes out, so its Δx explodes on the capped set, every
+    clamp fires, and the accept test rejects — observed at 10k×50k,
+    entry pinf 1.54e-5 unimproved). This projector instead alternates
+    between the two constraint sets directly (POCS):
 
-        min ‖W^{-1/2}Δx‖  s.t.  A·Δx = b − A·x,
-        Δx = W·Aᵀ·(A·W·Aᵀ)⁻¹·(b − A·x),   W = diag(min(x, τ)² + floor²)
+        repeat: x ← x + Aᵀ·(A·Aᵀ)⁻¹·(b − A·x)   (affine projection)
+                x ← clamp to the box (x > 0, x < u) (box projection)
 
-    with τ = the m-th largest component of x (the basic scale). Capping
-    at τ removes D's huge side (basic x/s → ∞ is what wrecks κ(AD²Aᵀ));
-    keeping tiny components tiny means Δx lands on columns that can
-    absorb it without violating x > 0 (an UNweighted projection spreads
-    Δx uniformly and the positivity clamp on ~n tiny nonbasic columns
-    re-pollutes pinf by ‖A‖·‖Δx_clamped‖ — back where it started). For
-    ANY fixed W ≻ 0 the projection is exact: A·Δx = r up to solve
-    precision, so W only shapes where the movement goes. The m×m
-    A·W·Aᵀ is assembled on device, factored ONCE on host (true f64),
-    and each application is two device matvecs + one host solve with
-    true-operator refinement. Returns ``project(state) -> (state',
-    pinf_before, pinf_after)`` or None if no factorization succeeded.
+    The affine step goes through the UNWEIGHTED A·Aᵀ — well-conditioned
+    for any full-row-rank A, no IPM scaling involved — so each round is
+    numerically clean; the clamp re-pollutes Ax = b only through the
+    (tiny, nonbasic) columns the affine step pushed negative, and the
+    alternation contracts toward the intersection (both sets convex,
+    intersection = the feasible region, nonempty). Rounds stop when pinf
+    stops improving; the best iterate is accepted only if it beat the
+    entry. A·Aᵀ is assembled on device, factored ONCE on host (true
+    f64); each round is two device matvecs + one refined host solve.
+    Returns ``project(state, rounds=...) -> (state', pinf_before,
+    pinf_after)`` or None if no factorization succeeded.
     """
     import time as _time
 
-    m, n = A.shape
-    x = state.x
-    xs = jnp.sort(x)
-    tau = float(xs[n - m]) if n > m else float(xs[0])
-    tau = max(tau, 1e-10 * float(xs[-1]), np.finfo(np.float64).tiny)
-    # floor keeps A·W·Aᵀ definite even when fewer than m components reach
-    # basic scale; movement through floor-weighted columns is ~1e-14·τ².
-    wdiag = jnp.minimum(x, tau) ** 2 + (1e-7 * tau) ** 2
+    ones = jnp.ones((A.shape[1],), A.dtype)
     t0 = _time.perf_counter()
-    G = _normal_eq_chunked(A, wdiag)
+    G = _normal_eq_chunked(A, ones)
     jax.block_until_ready(G)
     Gh = np.asarray(G)
     del G
@@ -642,8 +640,8 @@ def _build_host_projector(A, data, state, trace=False):
         import sys as _sys
 
         print(
-            f"[endgame] projector built in {_time.perf_counter() - t0:.1f}s "
-            f"(tau={tau:.3e}, reg={reg:.1e})",
+            f"[endgame] projector (AAᵀ) built in "
+            f"{_time.perf_counter() - t0:.1f}s (reg={reg:.1e})",
             file=_sys.stderr, flush=True,
         )
     L, sh = hostf
@@ -653,31 +651,37 @@ def _build_host_projector(A, data, state, trace=False):
 
         return sh * sla.cho_solve((L, True), sh * rh, check_finite=False)
 
-    def project(st):
+    def project(st, rounds=6):
         pinf0 = float(_eg_pinf(A, data, st.x, st.w))
-        r = data.b - _matvec_chunked(A, st.x)
-        rh = np.asarray(r)
-        th = host_tri(rh)
-        for _ in range(2):
-            res = np.asarray(_eg_w_op_residual(A, wdiag, jnp.asarray(th), r))
+        x, w = st.x, st.w
+        best_x, best_w, best = x, w, pinf0
+        prev = pinf0
+        for _ in range(rounds):
+            r = data.b - _matvec_chunked(A, x)
+            th = host_tri(np.asarray(r))
+            res = np.asarray(_eg_w_op_residual(A, ones, jnp.asarray(th), r))
             th = th + host_tri(res)
-        dx = wdiag * _rmatvec_chunked(A, jnp.asarray(th))
-        x2 = st.x + dx
-        # Guards: strict positivity, and stay strictly inside any finite
-        # upper bound (w is then re-synced so r_u stays ~0). Both clamps
-        # are rare by construction (capped weights keep |Δx_i| ≪ x_i on
-        # tiny columns) — the accept test below backstops the exceptions.
-        x2 = jnp.where(x2 > 0, x2, 0.5 * st.x)
-        x2 = jnp.where(
-            (data.hub > 0) & (x2 >= data.u_f),
-            st.x + 0.5 * (data.u_f - st.x),
-            x2,
-        )
-        w2 = jnp.where(data.hub > 0, data.u_f - x2, st.w)
-        pinf1 = float(_eg_pinf(A, data, x2, w2))
-        if not (pinf1 < pinf0):
-            return st, pinf0, pinf0
-        return st._replace(x=x2, w=w2), pinf0, pinf1
+            x2 = x + _rmatvec_chunked(A, jnp.asarray(th))
+            # Box projection, kept strictly interior: a column pushed
+            # nonpositive keeps 10% of its current value (the IPM needs
+            # x > 0; exact-0 clamping would also collapse the next d).
+            x2 = jnp.where(x2 > 0, x2, 0.1 * x)
+            x2 = jnp.where(
+                (data.hub > 0) & (x2 >= data.u_f),
+                x + 0.5 * (data.u_f - x),
+                x2,
+            )
+            w2 = jnp.where(data.hub > 0, data.u_f - x2, w)
+            p = float(_eg_pinf(A, data, x2, w2))
+            if p < best:
+                best, best_x, best_w = p, x2, w2
+            if not (p < 0.9 * prev):
+                break  # alternation has stopped paying
+            prev = p
+            x, w = x2, w2
+        if best < pinf0:
+            return st._replace(x=best_x, w=best_w), pinf0, best
+        return st, pinf0, pinf0
 
     return project
 
@@ -1268,7 +1272,7 @@ class DenseJaxBackend(SolverBackend):
         # Host-factor mode (cfg.endgame_host; auto = on under emulated
         # f64): LAPACK factorization + triangular solves on host, assembly
         # and refinement matvecs on device. The same mode builds the
-        # capped-weight feasibility projector and applies it at entry and
+        # POCS feasibility projector and applies it at entry and
         # after every good step — together the two mechanisms that break
         # the round-3 terminal wall (BENCH_10K.json analysis): a four-
         # orders-smaller factorable reg, and pinf restoration that does

@@ -62,3 +62,49 @@ def test_batch_sharded_over_mesh(batch):
     np.testing.assert_allclose(r_mesh.objective, r_ref.objective, rtol=1e-9)
     with pytest.raises(ValueError):
         solve_batched(batch, mesh=mesh)  # 12 % 8 != 0
+
+
+def test_pcg_middle_phase_full_tol(batch):
+    """solve_mode="pcg" adds the full-tolerance PCG middle phase (f32
+    preconditioner + f64 matrix-free CG). Every member must still reach
+    1e-8 with objectives matching the direct path."""
+    r_pcg = solve_batched(batch, solve_mode="pcg")
+    r_dir = solve_batched(batch)
+    assert r_pcg.n_optimal == len(r_pcg.status)
+    assert (r_pcg.rel_gap <= 1e-8).all() and (r_pcg.pinf <= 1e-8).all()
+    np.testing.assert_allclose(r_pcg.objective, r_dir.objective, rtol=1e-8)
+
+
+def test_pcg_phase_keeps_optimal_members_settled():
+    """Members a full-tol phase proved OPTIMAL must NOT re-enter the next
+    phase: the keep-optimal carry reset leaves them inactive and settled
+    (this boundary is the PCG middle phase's whole payoff), while the
+    provisional reset re-activates everyone."""
+    import jax.numpy as jnp
+    import distributedlpsolver_tpu.backends.batched as bt
+
+    B = 6
+    states = jnp.zeros((B, 3))  # any pytree-of-arrays works for the reset
+    iters = jnp.arange(B, dtype=jnp.int32)
+    status = jnp.asarray(
+        [bt._OPTIMAL, bt._RUNNING, bt._OPTIMAL, bt._STALL, bt._NUMERR,
+         bt._RUNNING], jnp.int32
+    )
+    carry = bt._fresh_batch_carry(
+        states, iters, B, 1e-10, jnp.float64, status=status
+    )
+    active, new_status = np.asarray(carry[1]), np.asarray(carry[5])
+    # optimal members settled+inactive; everyone else re-activated RUNNING
+    np.testing.assert_array_equal(
+        active, [False, True, False, True, True, True]
+    )
+    np.testing.assert_array_equal(
+        new_status,
+        [bt._OPTIMAL, bt._RUNNING, bt._OPTIMAL, bt._RUNNING, bt._RUNNING,
+         bt._RUNNING],
+    )
+    np.testing.assert_array_equal(np.asarray(carry[6]), np.asarray(iters))
+    # provisional reset (status=None): everyone re-enters
+    carry2 = bt._fresh_batch_carry(states, iters, B, 1e-10, jnp.float64)
+    assert np.asarray(carry2[1]).all()
+    assert (np.asarray(carry2[5]) == bt._RUNNING).all()
