@@ -144,14 +144,21 @@ def _bench_batched(quick: bool):
         # Warm the solo-cleanup path too: tail-extracted stragglers
         # re-solve through the dense backend, and its first compile
         # (~60 s observed for the two-phase segment programs at the
-        # member shape) otherwise lands inside the timed solve. A
-        # 3-iteration truncated member solve compiles the same programs.
+        # member shape) otherwise lands inside the timed solve. The
+        # warm-up max_iter must land in the SAME buffer_cap bucket as a
+        # real cleanup solve (buffer caps are static jit keys): cleanup
+        # members get remaining = n_batched_phases·max_iter − spent
+        # ≈ 3·200 − ~40, so warm with that figure — a tiny max_iter
+        # would compile a different (never reused) executable. The solve
+        # itself converges in ~20 iterations, so the large bound only
+        # shapes the bucket, not the runtime.
         from distributedlpsolver_tpu.backends.batched import (
             member_interior_form,
         )
         from distributedlpsolver_tpu.ipm.driver import solve as _solo_solve
 
-        _solo_solve(member_interior_form(batch, 0), backend="tpu", max_iter=3)
+        _solo_solve(member_interior_form(batch, 0), backend="tpu",
+                    max_iter=560)
     except Exception as e:
         _log(f"  solo-path warm-up failed (non-fatal): {e}")
     t0 = time.perf_counter()

@@ -521,14 +521,18 @@ def _eg_op_residual(A, d, diagM, reg, xv, rhs):
     return rhs - _matvec_chunked(A, d * _rmatvec_chunked(A, xv)) - reg * diagM * xv
 
 
-def _endgame_step_host(A, data, state, hostf, reg, diagM, params, refine=1):
+def _endgame_step_host(A, data, state, hostf, reg, diagM, params, refine=1,
+                       restore=None):
     """One Mehrotra step with the factorization resident on the HOST in
     true f64. ``core.mehrotra_step`` runs eagerly (one implementation of
     the step shared with every other path) over ops whose solve ships the
     m-vector RHS to host LAPACK and refines against the true operator on
     device. KKT-level refinement is affordable again here (no device
     program to size-limit), restoring the cancellation digits the
-    device endgame had to give up (see core._solve_kkt's rationale)."""
+    device endgame had to give up (see core._solve_kkt's rationale).
+    ``restore`` (the AAᵀ primal closure from _build_host_projector)
+    makes every back-substituted dx exactly primal-feasible — see
+    core.LinOps.primal_project."""
     import scipy.linalg as sla
 
     L, sh = hostf
@@ -554,6 +558,7 @@ def _endgame_step_host(A, data, state, hostf, reg, diagM, params, refine=1):
         rmatvec=lambda v: _rmatvec_chunked(A, v),
         factorize=lambda d: None,
         solve=solve,
+        primal_project=restore,
     )
     return core.mehrotra_step(ops, data, params, state)
 
@@ -571,20 +576,6 @@ def _eg_pinf(A, data, x, w):
 def _eg_w_op_residual(A, wdiag, t, r):
     """``r − (A·diag(w)·Aᵀ)·t`` — projector refinement residual."""
     return r - _matvec_chunked(A, wdiag * _rmatvec_chunked(A, t))
-
-
-@jax.jit
-def _eg_norms(A, data, state):
-    """Full residual_norms of a state in one dispatch — re-scores the
-    recorded iteration row after a feasibility projection moved x."""
-    ops = core.LinOps(
-        xp=jnp,
-        matvec=lambda v: _matvec_chunked(A, v),
-        rmatvec=lambda v: _rmatvec_chunked(A, v),
-        factorize=None,
-        solve=None,
-    )
-    return core.residual_norms(ops, data, state)
 
 
 def _build_host_projector(A, data, state, trace=False):
@@ -651,6 +642,18 @@ def _build_host_projector(A, data, state, trace=False):
 
         return sh * sla.cho_solve((L, True), sh * rh, check_finite=False)
 
+    def restore(rv):
+        """``rv (m,) ↦ Aᵀ·(A·Aᵀ)⁻¹·rv (n,)`` — one refined host solve.
+        The exact primal-row closure injected into the endgame step's
+        KKT back-substitution (core.LinOps.primal_project): correcting
+        the DIRECTION keeps feasibility decaying as (1−α) per iteration
+        without touching the iterate (iterate-space repair was measured
+        to inflate μ 4 orders and crush step lengths at 10k×50k)."""
+        th = host_tri(np.asarray(rv))
+        res = np.asarray(_eg_w_op_residual(A, ones, jnp.asarray(th), rv))
+        th = th + host_tri(res)
+        return _rmatvec_chunked(A, jnp.asarray(th))
+
     def project(st, rounds=6):
         pinf0 = float(_eg_pinf(A, data, st.x, st.w))
         x, w = st.x, st.w
@@ -683,6 +686,7 @@ def _build_host_projector(A, data, state, trace=False):
             return st._replace(x=best_x, w=best_w), pinf0, best
         return st, pinf0, pinf0
 
+    project.restore = restore
     return project
 
 
@@ -760,9 +764,41 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
     return factorize, solve
 
 
+@jax.jit
+def _closure_from_G(G):
+    """Shared factor body of the primal-row closure: Jacobi-scale the
+    Gram matrix ``G = A·Aᵀ``, shift, f32 Cholesky, paneled explicit
+    inverse. One definition for both assembly routes (plain f32 GEMM
+    and the Pallas-padded kernel) so the shift/scaling can never
+    silently diverge between them. Unlike the per-iteration A·D²·Aᵀ,
+    G carries no IPM scaling, so its conditioning never degrades as
+    μ → 0; the small relative shift keeps the f32 Cholesky robust and
+    washes out under the closure's true-operator refinement sweeps."""
+    with jax.default_matmul_precision("highest"):
+        dG = jnp.diagonal(G)
+        s = jax.lax.rsqrt(jnp.maximum(dG, jnp.finfo(jnp.float32).tiny))
+        Gs = G * s[:, None] * s[None, :]
+        Gs = Gs + jnp.asarray(1e-6, jnp.float32) * jnp.eye(
+            G.shape[0], dtype=jnp.float32
+        )
+        L = jnp.linalg.cholesky(Gs)
+        Linv = _tri_inv_paneled(L)
+    return Linv, s.astype(jnp.float64)
+
+
+@jax.jit
+def _closure_factors(A32v):
+    """f32 factor of the LOOP-INVARIANT ``G = A·Aᵀ`` from an unpadded
+    f32 copy — built once per problem, it powers the primal-row closure
+    (core.LinOps.primal_project) of every PCG-plan phase."""
+    with jax.default_matmul_precision("highest"):
+        G = A32v @ A32v.T
+    return _closure_from_G(G)
+
+
 def _make_ops(
     A, reg, factor_dtype, refine_steps, use_pallas=False, Af=None,
-    cg_iters=0, cg_tol=0.0, prec_shard=None,
+    cg_iters=0, cg_tol=0.0, prec_shard=None, closure=None, closure_sweeps=0,
 ):
     if cg_iters > 0:
         factorize, solve = _pcg_ops(
@@ -772,12 +808,33 @@ def _make_ops(
         factorize, solve = _cholesky_ops(
             A, factor_dtype, refine_steps, use_pallas, Af
         )
+    pp = None
+    if closure is not None:
+        # Direction-level primal closure δ = Aᵀ·(A·Aᵀ)⁻¹·rv (see
+        # core.LinOps.primal_project and core._solve_kkt): the f32
+        # factor is applied through the Jacobi scaling; each refinement
+        # sweep re-evaluates the TRUE operator A·(Aᵀt) at iterate
+        # precision. Pure jax — runs inside fused/jitted programs.
+        LinvG, sG = closure
+
+        def prec(r):
+            z = LinvG @ (sG * r).astype(LinvG.dtype)
+            return sG * (LinvG.T @ z).astype(sG.dtype)
+
+        def pp(rv):
+            t = prec(rv)
+            for _ in range(closure_sweeps):
+                rr = rv - _matvec_chunked(A, _rmatvec_chunked(A, t))
+                t = t + prec(rr)
+            return _rmatvec_chunked(A, t)
+
     return core.LinOps(
         xp=jnp,
         matvec=lambda v: _matvec_chunked(A, v),
         rmatvec=lambda v: _rmatvec_chunked(A, v),
         factorize=functools.partial(factorize, reg=reg),
         solve=solve,
+        primal_project=pp,
     )
 
 
@@ -850,12 +907,14 @@ def _dense_solve_full(
     static_argnames=(
         "params", "factor_dtype", "refine_steps", "buf_cap", "use_pallas",
         "stall_window", "patience", "cg_iters", "cg_tol", "prec_shard",
+        "closure_sweeps",
     ),
 )
 def _dense_segment(
     A, data, carry, it_stop, max_iter, max_refactor, reg_grow,
     params, factor_dtype, refine_steps, buf_cap, use_pallas=False, Af=None,
     stall_window=0, patience=0.0, cg_iters=0, cg_tol=0.0, prec_shard=None,
+    closure=None, closure_sweeps=0,
 ):
     """One bounded continuation of the fused loop (host segmentation —
     see core.drive_segments). ``carry`` is the raw fused_solve carry;
@@ -865,7 +924,7 @@ def _dense_segment(
     def step(state, reg):
         ops = _make_ops(
             A, reg, jnp.dtype(factor_dtype), refine_steps, use_pallas, Af,
-            cg_iters, cg_tol, prec_shard,
+            cg_iters, cg_tol, prec_shard, closure, closure_sweeps,
         )
         return core.mehrotra_step(ops, data, params, state)
 
@@ -1052,6 +1111,7 @@ class DenseJaxBackend(SolverBackend):
             and config.use_pallas is not False
         )
         self._A32 = None
+        self._closure = None
         # PCG full-accuracy mode (config.solve_mode): replaces the f64
         # phase 2 / f64 host-driver steps with f32-preconditioned
         # matrix-free CG, auto-on for large two-phase TPU problems where
@@ -1086,6 +1146,34 @@ class DenseJaxBackend(SolverBackend):
             else:
                 self._A32 = self._A.astype(jnp.float32)
         return self._A32
+
+    def _ensure_closure(self):
+        """(LinvG, sG) — the f32 factor of the loop-invariant G = A·Aᵀ
+        powering the primal-row closure of the PCG phase plans (see
+        core.LinOps.primal_project; built once, ~m²·4 bytes of HBM).
+        The closure keeps pinf pinned from the FIRST iteration: the
+        feasibility junk each regularized/filtered solve leaks is
+        removed while μ is still large enough to absorb the induced
+        complementarity perturbation — removing it later was measured
+        to be impossible without wrecking μ or the dual (10k×50k,
+        round 3)."""
+        if self._closure is None:
+            m, n = self._A.shape
+            A32 = self._ensure_A32()
+            if A32.shape != (m, n):
+                # Pallas-padded copy: assemble G through the kernel
+                # (d = 1 on real columns, 0 on padding) instead of
+                # slicing out an unpadded ~m·n·4-byte duplicate.
+                from distributedlpsolver_tpu.ops import normal_eq_pallas
+
+                G = normal_eq_pallas(
+                    A32, jnp.ones((n,), jnp.float32), out_m=m
+                )
+                self._closure = _closure_from_G(G)
+            else:
+                self._closure = _closure_factors(A32)
+            jax.block_until_ready(self._closure)
+        return self._closure
 
     def _point_spec(self):
         """(factor_dtype_name, refine, use_pallas, Af, cg_iters, cg_tol,
@@ -1156,7 +1244,8 @@ class DenseJaxBackend(SolverBackend):
     def _phase_plan(self):
         """Per-phase execution specs for the fused solve: (params,
         factor_dtype_name, refine_steps, use_pallas, Af, stall_window,
-        stall_patience_floor, cg_iters, cg_tol, prec_shard)."""
+        stall_patience_floor, cg_iters, cg_tol, prec_shard, closure,
+        closure_sweeps)."""
         cfg = self._cfg
         patience = 1e3 * cfg.tol  # near-tol plateaus deserve patience
         w = cfg.stall_window
@@ -1165,7 +1254,7 @@ class DenseJaxBackend(SolverBackend):
             fdt, refine, pallas, Af, cgi, cgt, psh = self._point_spec()
             return [
                 (self._params, fdt, refine, pallas, Af, 2 * w if w else 0,
-                 patience, cgi, cgt, psh)
+                 patience, cgi, cgt, psh, self._ensure_closure(), 2)
             ]
         if not self._two_phase:
             # Final (only) phase gets the same stall semantics as the
@@ -1174,7 +1263,7 @@ class DenseJaxBackend(SolverBackend):
             return [
                 (self._params, self._factor_dtype_name, self._refine,
                  self._use_pallas, self._Af, 2 * w if w else 0, patience,
-                 0, 0.0, None)
+                 0, 0.0, None, None, 0)
             ]
         A32 = self._ensure_A32()
         params_p1 = cfg.phase1_params()
@@ -1199,23 +1288,31 @@ class DenseJaxBackend(SolverBackend):
             # factorization failed below reg 1e-6, pinning pinf ~1e-5);
             # hand over within ~3 of the floor instead.
             w_pcg = min(3, w) if w else 0
+            # The primal-row closure runs in EVERY pcg-plan phase: pinf
+            # junk must never accumulate past the μ that can absorb its
+            # removal (core._solve_kkt rationale). Phase 1 gets 0
+            # true-operator sweeps (f32-factor accuracy ~1e-6 matches
+            # the phase's own floor and skips the ew-f64 matvec cost);
+            # the full-precision phases sweep twice.
+            closure = self._ensure_closure()
             phases = [
                 (params_p1, "float32", 0, self._pallas_p1, A32, w, 0.0,
-                 0, 0.0, None),
+                 0, 0.0, None, closure, 0),
                 (params_pcg, "float32", 0, self._pallas_p1, A32, w_pcg, 0.0,
-                 self._cg_iters, self._cg_tol, self._prec_shard),
+                 self._cg_iters, self._cg_tol, self._prec_shard, closure, 2),
             ]
             if m * n < self._ENDGAME_ENTRIES:
                 phases.append(
                     (self._params, self._dtype.name, self._refine, False,
-                     None, 2 * w if w else 0, patience, 0, 0.0, None)
+                     None, 2 * w if w else 0, patience, 0, 0.0, None,
+                     closure, 2)
                 )
             return phases
         phase2 = (self._params, self._dtype.name, self._refine, False,
-                  None, 2 * w if w else 0, patience, 0, 0.0, None)
+                  None, 2 * w if w else 0, patience, 0, 0.0, None, None, 0)
         return [
             (params_p1, "float32", 0, self._pallas_p1, A32, w, 0.0, 0, 0.0,
-             None),
+             None, None, 0),
             phase2,
         ]
 
@@ -1264,45 +1361,47 @@ class DenseJaxBackend(SolverBackend):
         # at 10k×50k the (Pallas-padded) A32 is ~2 GB of HBM, and with it
         # resident the SECOND endgame iteration's assembly hit
         # RESOURCE_EXHAUSTED (observed 2026-07-30; iteration 1 fit only
-        # because no previous factor L was alive yet).
+        # because no previous factor L was alive yet). The device-side
+        # closure factor goes with it (~m²·4 bytes) — the host endgame
+        # uses the exact host AAᵀ closure instead.
         self._A32 = None
+        self._closure = None
         budget = cfg.max_iter
         refactor = 0
         self.endgame_timings = timings = []
         # Host-factor mode (cfg.endgame_host; auto = on under emulated
         # f64): LAPACK factorization + triangular solves on host, assembly
-        # and refinement matvecs on device. The same mode builds the
-        # POCS feasibility projector and applies it at entry and
-        # after every good step — together the two mechanisms that break
-        # the round-3 terminal wall (BENCH_10K.json analysis): a four-
-        # orders-smaller factorable reg, and pinf restoration that does
-        # not go through the collapsed-weight normal matrix at all.
+        # and refinement matvecs on device. The same mode builds the AAᵀ
+        # host factor whose restore() closure makes every Newton dx
+        # exactly primal-feasible — with the phases' device closure, the
+        # two mechanisms that break the round-3 terminal wall
+        # (BENCH_10K.json analysis): a four-orders-smaller factorable
+        # reg, and feasibility that never leaks into the iterate.
         host_mode = (
             cfg.endgame_host
             if cfg.endgame_host is not None
             else jax.default_backend() == "tpu"
         )
         project = None
+        restore = None
         if host_mode:
             # Eager steps carry no program-size limit — restore one round
             # of KKT-level refinement (the device endgame had to run 0).
             params = cfg.replace(kkt_refine=min(cfg.kkt_refine, 1)).step_params()
+            # The AAᵀ factor powers the DIRECTION-level primal closure
+            # (restore → ops.primal_project): every Newton dx is made
+            # exactly primal-feasible, so pinf decays as (1−α) per
+            # iteration. The iterate-space project() is deliberately NOT
+            # applied here: projecting the ITERATE was measured (10k×50k)
+            # to inflate μ by 4 orders (Δx ~ ‖r_p‖/σ_min lands on
+            # complementarity products) and its box clamps crushed the
+            # next step's α to ~0.01 — the direction-level closure has
+            # neither failure mode.
             project = _build_host_projector(
                 self._A, self._data, state, trace=trace
             )
             if project is not None:
-                state, p0, p1 = project(state)
-                timings.append(
-                    {"projector": True, "pinf_before": float(p0),
-                     "pinf_after": float(p1)}
-                )
-                if trace:
-                    import sys as _sys
-
-                    print(
-                        f"[endgame] entry projection pinf {p0:.3e} -> {p1:.3e}",
-                        file=_sys.stderr, flush=True,
-                    )
+                restore = project.restore
         # Holding M across the step amortizes bad-step retries (only the
         # factorization sees the escalated reg), but costs an extra m²·8
         # bytes of HBM concurrent with L and the step's working set —
@@ -1377,7 +1476,7 @@ class DenseJaxBackend(SolverBackend):
                     t1 = _time.perf_counter()
                     new_state, stats = _endgame_step_host(
                         self._A, self._data, state, hostf, float(reg),
-                        diagM, params,
+                        diagM, params, restore=restore,
                     )
                     bad = bool(np.asarray(stats.bad))
                     t_step = _time.perf_counter() - t1
@@ -1492,36 +1591,15 @@ class DenseJaxBackend(SolverBackend):
                     "alpha_p", "alpha_d", "sigma",
                 )
             ]
-            if project is not None:
-                # Restore Ax = b after the (regularized) step — the
-                # Tikhonov filtering re-pollutes exactly the component
-                # the projector removes — then re-score the row so the
-                # convergence test below sees the projected iterate.
-                t1 = _time.perf_counter()
-                state, p0, p1 = project(state)
-                if p1 < p0:
-                    norms = [
-                        float(np.asarray(v))
-                        for v in _eg_norms(self._A, self._data, state)
-                    ]
-                    # residual_norms order: pinf dinf gap rel_gap pobj dobj mu
-                    row[0] = norms[6]
-                    row[1:7] = [norms[2], norms[3], norms[0], norms[1],
-                                norms[4], norms[5]]
-                timings[-1]["t_project"] = round(
-                    _time.perf_counter() - t1, 3
-                )
-                timings[-1]["pinf_proj"] = float(p1)
-                # p1 == p0 ⇒ the projection was REJECTED (accept test:
-                # strictly improved pinf) and the state is untouched.
-                timings[-1]["proj_from"] = float(p0)
             rows.append(row)
             err = max(row[2], row[3], row[4])  # rel_gap, pinf, dinf
             if trace:
                 import sys as _sys
 
                 print(
-                    f"[endgame] it={it} err={err:.3e} ({dt:.1f}s)",
+                    f"[endgame] it={it} gap={row[2]:.3e} pinf={row[3]:.3e} "
+                    f"dinf={row[4]:.3e} mu={row[0]:.2e} "
+                    f"a={row[7]:.2f}/{row[8]:.2f} ({dt:.1f}s)",
                     file=_sys.stderr, flush=True,
                 )
             if row[2] <= cfg.tol and row[3] <= cfg.tol and row[4] <= cfg.tol:
@@ -1559,7 +1637,7 @@ class DenseJaxBackend(SolverBackend):
 
         def make_phase(spec):
             (params, fdt, refine, pallas, Af, window, patience, cgi,
-             cgt, psh) = spec
+             cgt, psh, closure, csweeps) = spec
             rate = core.SEG_RATE_F32 if fdt == "float32" else core.SEG_RATE_F64
             est = flops / rate
 
@@ -1570,7 +1648,7 @@ class DenseJaxBackend(SolverBackend):
                     return _dense_segment(
                         self._A, self._data, c, jnp.asarray(stop, jnp.int32),
                         mi, mr, rg, params, fdt, refine, buf_cap, pallas, Af,
-                        window, patience, cgi, cgt, psh,
+                        window, patience, cgi, cgt, psh, closure, csweeps,
                     )
 
                 return run_seg

@@ -48,6 +48,17 @@ class LinOps(NamedTuple):
     rmatvec: Callable[[Any], Any]  # v ↦ Aᵀ @ v          (m,) → (n,)
     factorize: Callable[[Any], Any]  # d ↦ factors of A·diag(d)·Aᵀ (+ reg)
     solve: Callable[[Any, Any], Any]  # (factors, rhs) ↦ M⁻¹ rhs
+    # Optional exact primal-row closure: rv ↦ Aᵀ(A·Aᵀ)⁻¹·rv. When set,
+    # each KKT solve corrects its final dx so A·dx equals its target —
+    # the regularized normal-equations solve Tikhonov-filters precisely
+    # the near-null-space component of the feasibility RHS (the
+    # diagnosed 10k×50k terminal-pinf wall), and iterate-space repair
+    # was measured to break centrality/step lengths instead. Two valid
+    # implementations exist: a pure-jax closure over a precomputed f32
+    # factor of A·Aᵀ (dense._make_ops — traces into fused/jitted
+    # programs), and an eager host-LAPACK closure (the dense host
+    # endgame). The default None leaves every other path unchanged.
+    primal_project: Any = None
 
 
 class ProblemData(NamedTuple):
@@ -119,6 +130,22 @@ def _solve_kkt(
             ops, state, hub, d, factors, e_p, e_u, e_d, e_xs, e_wz
         )
         dx, dy, ds, dw, dz = dx + cx, dy + cy, ds + cs, dw + cw, dz + cz
+    if ops.primal_project is not None:
+        # Exact primal-row closure (LinOps.primal_project), applied ONCE
+        # on the final direction and deliberately NOT fed back into
+        # ds/dz: those back-substitutions divide by x (resp. w), so a
+        # tiny-column correction δ would come back as ds_i ~ δ_i·s_i/x_i
+        # — measured at 10k×50k to explode dinf to O(1) and zero every
+        # step length. dw IS kept consistent (dw = r_u − dx involves no
+        # division), so the closure never leaks into the upper-bound
+        # row. The residual it induces in the complementarity rows is
+        # ~s·δ with δ the CURRENT solve's filtered junk (reg·D̃·dy-scale,
+        # not the accumulated pinf) — absorbed by the corrector at any
+        # μ above that scale, which is why the closure must be active
+        # from the FIRST phase (junk must never accumulate past μ).
+        delta = ops.primal_project(r_p - ops.matvec(dx))
+        dx = dx + delta
+        dw = dw - hub * delta
     return dx, dy, ds, dw, dz
 
 

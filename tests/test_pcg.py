@@ -413,18 +413,16 @@ class TestHostEndgame:
 
     def test_host_endgame_finishes(self, monkeypatch):
         # auto-resolution: endgame_host=None on (monkeypatched) TPU ->
-        # host mode. Must reach 1e-8 with host rows + projector rows in
-        # the timing record.
+        # host mode. Must reach 1e-8 with host step rows in the timing
+        # record; the AAᵀ direction-level primal closure keeps the final
+        # iterate essentially on Ax=b (far below the 1e-8 test above).
         be, r, p = _force_endgame(monkeypatch)
         _check_optimal(r, p)
         tm = be.endgame_timings
         assert any(row.get("host") for row in tm)
-        assert any(row.get("projector") for row in tm)
         steps = [row for row in tm if "t_step" in row and not row["bad"]]
         assert steps and all("t_transfer" in row for row in steps)
-        # per-step projections keep the iterate essentially on Ax=b
-        projected = [row["pinf_proj"] for row in tm if "pinf_proj" in row]
-        assert projected and min(projected) < 1e-10
+        assert r.pinf < 1e-10
 
     def test_host_factor_failure_escalates_without_retransfer(
         self, monkeypatch
@@ -466,9 +464,11 @@ class TestHostEndgame:
         forced = {"n": 0}
         asm_calls = {"n": 0}
 
-        def bad_once(A, data, state, hostf, reg, diagM, params, refine=1):
+        def bad_once(A, data, state, hostf, reg, diagM, params, refine=1,
+                     restore=None):
             new_state, stats = real_step(
-                A, data, state, hostf, reg, diagM, params, refine=refine
+                A, data, state, hostf, reg, diagM, params, refine=refine,
+                restore=restore,
             )
             if forced["n"] == 0:
                 forced["n"] += 1
