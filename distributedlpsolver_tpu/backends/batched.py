@@ -300,6 +300,36 @@ def _cleanup_cap(B: int) -> int:
     return max(4, B // 8)
 
 
+# Backend name the solo-cleanup pass re-solves through — exported so a
+# warm-up (bench.py) can pre-compile the exact path cleanup will take.
+CLEANUP_BACKEND = "tpu"
+
+
+def _phase_plan(cfg: SolverConfig):
+    """(two_phase, use_pcg, n_phases) — the batched loop's phase schedule,
+    ONE definition shared by solve_batched and the cleanup-budget helper
+    so the per-problem iteration budget (n_phases·max_iter) cannot
+    silently diverge from the schedule that spends it."""
+    two_phase = cfg.two_phase_enabled(jax.default_backend())
+    use_pcg = cfg.cg_iters > 0 and (
+        cfg.solve_mode == "pcg" or (cfg.solve_mode is None and two_phase)
+    )
+    return two_phase, use_pcg, 1 + (1 if two_phase else 0) + (1 if use_pcg else 0)
+
+
+def cleanup_solo_max_iter(config: Optional[SolverConfig] = None,
+                          typical_spent: int = 40) -> int:
+    """The ``max_iter`` a typical solo-cleanup solve runs with (cleanup
+    budget = n_phases·max_iter − iterations already spent in the batched
+    loop, via the shared :func:`_phase_plan`). Compile-cache buckets
+    (core.buffer_cap) are keyed by this figure, so a warm-up must use it —
+    a hardcoded number silently compiles a never-reused executable
+    whenever the defaults move."""
+    cfg = config or SolverConfig()
+    _, _, n_phases = _phase_plan(cfg)
+    return max(1, n_phases * cfg.max_iter - typical_spent)
+
+
 def _fresh_batch_carry(states, iters, B, reg0, dtype, status=None):
     """Phase-boundary carry reset. With ``status=None`` every member
     re-enters the next phase (the f32 phase-1 reset: its verdicts are
@@ -540,16 +570,13 @@ def solve_batched(
     setup_time = time.perf_counter() - t0
 
     t1 = time.perf_counter()
-    two_phase = cfg.two_phase_enabled(jax.default_backend())
-    params_p1 = cfg.phase1_params()
     # PCG middle phase (full tolerance, f32 preconditioner + f64
     # matrix-free CG): replaces most of the f64 finish's per-iteration
     # emulated-f64 assembly+Cholesky — the batched phase-2 cost center —
     # with MXU work. Auto-on wherever the two-phase schedule is (TPU);
     # "direct" opts out, "pcg" opts in anywhere.
-    use_pcg = cfg.cg_iters > 0 and (
-        cfg.solve_mode == "pcg" or (cfg.solve_mode is None and two_phase)
-    )
+    two_phase, use_pcg, n_phases = _phase_plan(cfg)
+    params_p1 = cfg.phase1_params()
     cg = (cfg.cg_iters, cfg.cg_tol) if use_pcg else (0, 0.0)
     seg = cfg.segment_iters
     if seg is None:
@@ -609,11 +636,11 @@ def solve_batched(
             checkpoint_every=0, profile_dir=None,
         )
         # The batched loop's total budget is max_iter PER PHASE (the f32
-        # phase's accepted steps land in the same per-problem counter), so
-        # the cleanup comparison must use the same total — comparing
-        # against a single max_iter would deny tail-extracted members the
-        # cleanup solve the early stop promised them.
-        n_phases = 1 + (1 if two_phase else 0) + (1 if use_pcg else 0)
+        # phase's accepted steps land in the same per-problem counter;
+        # n_phases from the shared _phase_plan above), so the cleanup
+        # comparison must use the same total — comparing against a single
+        # max_iter would deny tail-extracted members the cleanup solve
+        # the early stop promised them.
         for i in bad:
             # The solo solve only gets what the batched loop left unspent
             # (tail-extracted members keep most of theirs; genuine
@@ -632,7 +659,8 @@ def solve_batched(
                 w=np.asarray(states.w[i], dtype=np.float64),
                 z=np.asarray(states.z[i], dtype=np.float64),
             )
-            r = _solve(inf_i, backend="tpu", config=solo_cfg, warm_start=ws)
+            r = _solve(inf_i, backend=CLEANUP_BACKEND, config=solo_cfg,
+                       warm_start=ws)
             status_arr[i] = r.status
             objective[i] = r.objective
             x[i] = r.x

@@ -500,7 +500,16 @@ def _endgame_factor_host(Mh, reg):
     re-transfer)."""
     import scipy.linalg as sla
 
-    s = 1.0 / np.sqrt(np.maximum(np.diagonal(Mh), np.finfo(np.float64).tiny))
+    dg = np.diagonal(Mh)
+    if not np.all(np.isfinite(dg)) or np.any(dg < 0.0):
+        # These diagonals are sums of nonnegative terms (Σ d_j·A_ij², plus
+        # reg·diagM) — a negative or non-finite entry means upstream
+        # corruption no reg in the ladder can repair; bail before the
+        # Jacobi scaling overflows on 1/sqrt of it. An EXACTLY-zero entry
+        # is legitimate (zero row ⇒ its off-diagonals are zero too): clamp
+        # it, so the scaled row is zero and the +reg shift makes it PD.
+        return None
+    s = 1.0 / np.sqrt(np.maximum(dg, np.finfo(np.float64).tiny))
     Ms = Mh * s[:, None]
     Ms *= s[None, :]
     Ms[np.diag_indices_from(Ms)] += reg
@@ -508,7 +517,9 @@ def _endgame_factor_host(Mh, reg):
         L = sla.cholesky(Ms, lower=True, overwrite_a=True, check_finite=False)
     except np.linalg.LinAlgError:
         return None
-    if not np.all(np.isfinite(L[:: max(1, L.shape[0] // 64)])):
+    # potrf breakdown propagates NaN down-column, so the full diagonal of
+    # L (O(m)) witnesses any column breakdown anywhere in the factor.
+    if not np.all(np.isfinite(np.diagonal(L))):
         return None
     return L, s
 
@@ -578,7 +589,7 @@ def _eg_w_op_residual(A, wdiag, t, r):
     return r - _matvec_chunked(A, wdiag * _rmatvec_chunked(A, t))
 
 
-def _build_host_projector(A, data, state, trace=False):
+def _build_host_projector(A, data, trace=False):
     """Primal feasibility restoration by alternating projections.
 
     The diagnosed terminal-pinf wall (BENCH_10K.json round-3 analysis) is
@@ -1387,6 +1398,10 @@ class DenseJaxBackend(SolverBackend):
         if host_mode:
             # Eager steps carry no program-size limit — restore one round
             # of KKT-level refinement (the device endgame had to run 0).
+            # Capped at 1 even if cfg asks for more: each eager round is a
+            # full host solve + device residual pair against a direction
+            # already operator-refined inside solve() — see the
+            # endgame_host note in ipm/config.py.
             params = cfg.replace(kkt_refine=min(cfg.kkt_refine, 1)).step_params()
             # The AAᵀ factor powers the DIRECTION-level primal closure
             # (restore → ops.primal_project): every Newton dx is made
@@ -1397,9 +1412,7 @@ class DenseJaxBackend(SolverBackend):
             # complementarity products) and its box clamps crushed the
             # next step's α to ~0.01 — the direction-level closure has
             # neither failure mode.
-            project = _build_host_projector(
-                self._A, self._data, state, trace=trace
-            )
+            project = _build_host_projector(self._A, self._data, trace=trace)
             if project is not None:
                 restore = project.restore
         # Holding M across the step amortizes bad-step retries (only the

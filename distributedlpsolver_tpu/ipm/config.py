@@ -88,7 +88,11 @@ class SolverConfig:
     # f64); the O(m²·n) assembly and all refinement matvecs stay on
     # device. False forces the on-device factorization. None = auto:
     # host on TPU, device elsewhere (where device f64 already IS
-    # LAPACK-grade).
+    # LAPACK-grade). Note: host-endgame steps cap kkt_refine at 1
+    # regardless of the setting here — each eager KKT round is a full
+    # host solve + device residual pair, and the host solve already
+    # refines against the true operator internally; one round restores
+    # the cancellation digits, more only adds host↔device latency.
     endgame_host: Optional[bool] = None
     # Ruiz-equilibrate the interior form before solving (presolve scaling;
     # convergence is then tested in the scaled space, standard practice).

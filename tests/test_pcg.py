@@ -528,7 +528,7 @@ def test_host_projector_restores_feasibility_and_respects_bounds():
         z=jnp.where(data.hub > 0, 1.0, 0.0),
     )
     pinf0 = float(d._eg_pinf(A, data, st.x, st.w))
-    project = d._build_host_projector(A, data, st)
+    project = d._build_host_projector(A, data)
     assert project is not None
     st2, p0, p1 = project(st, rounds=40)
     assert p0 == pytest.approx(pinf0)
