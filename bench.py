@@ -411,7 +411,6 @@ def run_scale(args) -> list:
     import jax
 
     from distributedlpsolver_tpu.backends import dense as D
-    from distributedlpsolver_tpu.ipm import solve
     from distributedlpsolver_tpu.models.generators import random_dense_lp
 
     on_tpu = jax.default_backend() == "tpu"
@@ -424,8 +423,9 @@ def run_scale(args) -> list:
     # from n_phases·max_iter (core.buffer_cap), so a small-max_iter
     # warm-up would compile a different (never reused) bucket and the
     # timed solve would pay the real compile inside its 3 s envelope.
-    solve(p, backend=args.backend)
-    r = solve(p, backend=args.backend)
+    # _solve_timed: one tunnel drop must not crash the whole tier.
+    _solve_timed(p, args.backend)
+    r = _solve_timed(p, args.backend)
     row = {
         "check": "dense_2048x10240",
         "status": r.status.value,
@@ -464,7 +464,7 @@ def run_scale(args) -> list:
         D.DenseJaxBackend._ENDGAME_ENTRIES = 1  # force the 10k finish path
         be = D.DenseJaxBackend()
         p2 = random_dense_lp(1024, 5120, seed=2)
-        r2 = solve(p2, backend=be, solve_mode="pcg", max_iter=120)
+        r2 = _solve_timed(p2, be, solve_mode="pcg", max_iter=120)
     finally:
         D.DenseJaxBackend._ENDGAME_ENTRIES = entries_save
     row2 = {

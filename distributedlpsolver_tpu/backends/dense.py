@@ -283,6 +283,14 @@ def _pcg_ops(A, factor_dtype, use_pallas, Af, cg_tol, cg_iters,
             M = normal_eq_pallas(Af, df, out_m=m)
         else:
             M = (Af * df[None, :]) @ Af.T
+        if prec_shard is not None:
+            # Pin the assembly output to the factor's column sharding:
+            # with A variable-sharded the GSPMD partials then combine by
+            # REDUCE-SCATTER (each device keeps only its slab) instead of
+            # the all-reduce that would materialize a replicated m² M on
+            # every device — the first stage of the fully distributed
+            # factorization (ops/dist_chol.py).
+            M = jax.lax.with_sharding_constraint(M, prec_shard)
         diagM = jnp.diagonal(M)
         # Jacobi (unit-diagonal) symmetric scaling before the f32
         # factorization: late-IPM diagonals span ~10 orders, and an f32
@@ -293,7 +301,6 @@ def _pcg_ops(A, factor_dtype, use_pallas, Af, cg_tol, cg_iters,
         s = jax.lax.rsqrt(jnp.maximum(diagM, jnp.finfo(factor_dtype).tiny))
         Ms = M * s[:, None] * s[None, :]
         Ms = Ms + jnp.asarray(reg, M.dtype) * jnp.eye(m, dtype=M.dtype)
-        L = jnp.linalg.cholesky(Ms)
         # The preconditioner APPLY must run in the iterate dtype: an f32
         # apply injects ~1e-7 nonlinear rounding noise per call, which
         # breaks plain CG's recurrences at late-IPM conditioning — the
@@ -304,12 +311,20 @@ def _pcg_ops(A, factor_dtype, use_pallas, Af, cg_tol, cg_iters,
         # once per factorization so the apply is an exact fixed linear
         # operator and CG behaves like textbook PCG.
         if prec_shard is not None:
-            # Mesh placement: build L⁻¹ column-sharded (each device TRSMs
-            # its own slabs) instead of replicated — m²/K storage and
-            # compute per device.
-            Linv = _tri_inv_mesh(L, prec_shard).astype(A.dtype)
+            # Fully distributed factorization (SURVEY.md §2.2 second cut):
+            # panel Cholesky + blocked inversion inside shard_map — the
+            # round-3 path (replicated cholesky + _tri_inv_mesh slabs)
+            # still held full m² M and L on every device; this one never
+            # materializes a replicated m×m anywhere, so per-device peak
+            # is ~3·m²/K + the (m, panel) psum buffers.
+            from distributedlpsolver_tpu.ops.dist_chol import (
+                chol_tri_inv_mesh,
+            )
+
+            Linv = chol_tri_inv_mesh(Ms, prec_shard).astype(A.dtype)
             Linv = jax.lax.with_sharding_constraint(Linv, prec_shard)
         else:
+            L = jnp.linalg.cholesky(Ms)
             Linv = _tri_inv_paneled(L).astype(A.dtype)
         return (
             Linv, s.astype(A.dtype), diagM.astype(A.dtype), d,

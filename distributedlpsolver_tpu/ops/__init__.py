@@ -1,5 +1,7 @@
-"""Custom TPU ops (Pallas kernels) with plain-XLA fallbacks."""
+"""Custom TPU ops (Pallas kernels, mesh-distributed factorizations) with
+plain-XLA fallbacks."""
 
+from distributedlpsolver_tpu.ops.dist_chol import chol_tri_inv_mesh
 from distributedlpsolver_tpu.ops.normal_eq import (
     normal_eq,
     normal_eq_pallas,
@@ -9,6 +11,7 @@ from distributedlpsolver_tpu.ops.normal_eq import (
 )
 
 __all__ = [
+    "chol_tri_inv_mesh",
     "normal_eq",
     "normal_eq_pallas",
     "normal_eq_reference",

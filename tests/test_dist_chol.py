@@ -1,0 +1,94 @@
+"""Mesh-distributed Cholesky + triangular inversion (ops/dist_chol.py) —
+the second distributed-factorization cut (SURVEY.md §2.2, VERDICT round 3
+item 6): unlike round 3's sharded-TRSM-only build, no stage may
+materialize a replicated m×m buffer on any device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributedlpsolver_tpu.ops.dist_chol import chol_tri_inv_mesh
+from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return mesh_lib.make_mesh((8,), axis_names=("cols",))
+
+
+def _spd(m, seed=0):
+    rng = np.random.default_rng(seed)
+    G = rng.standard_normal((m, m))
+    return G @ G.T + m * np.eye(m)
+
+
+@pytest.mark.parametrize(
+    "m,panel,dtype,tol",
+    [
+        (96, 8, jnp.float64, 1e-12),   # divisible: w=12, pad-free
+        (130, 16, jnp.float64, 1e-12), # ragged: slab padded to panel mult
+        (200, 32, jnp.float32, 5e-6),  # f32 (the production factor dtype)
+        (8, 4, jnp.float64, 1e-12),    # one column per device
+    ],
+)
+def test_matches_replicated_factorization(mesh8, m, panel, dtype, tol):
+    sh = NamedSharding(mesh8, P(None, "cols"))
+    Ms = _spd(m)
+    ref = np.linalg.inv(np.linalg.cholesky(Ms))
+    got = np.asarray(
+        jax.jit(lambda M: chol_tri_inv_mesh(M, sh, panel=panel))(
+            jnp.asarray(Ms, dtype)
+        )
+    )
+    err = np.abs(got - ref).max() / np.abs(ref).max()
+    assert err < tol, err
+
+
+def test_output_is_column_sharded(mesh8):
+    sh = NamedSharding(mesh8, P(None, "cols"))
+    out = jax.jit(lambda M: chol_tri_inv_mesh(M, sh, panel=8))(
+        jnp.asarray(_spd(64), jnp.float32)
+    )
+    spec = out.sharding.spec
+    assert tuple(spec) == (None, "cols"), spec
+
+
+def test_memory_beats_round3_replicated_cholesky(mesh8):
+    """Per-device compiled peak of the full distributed pipeline must be
+    measurably below the round-3 path (replicated jnp Cholesky feeding
+    the sharded TRSM slabs), whose replicated Ms and L buffers are the
+    multi-chip HBM ceiling this cut removes."""
+    from distributedlpsolver_tpu.backends import dense as D
+
+    sh = NamedSharding(mesh8, P(None, "cols"))
+    m = 1024
+    Ms = jnp.asarray(_spd(m), jnp.float32)
+
+    def peak(fn):
+        comp = jax.jit(fn).lower(Ms).compile()
+        return comp.memory_analysis().temp_size_in_bytes
+
+    new = peak(lambda M: chol_tri_inv_mesh(M, sh, panel=128))
+    old = peak(lambda M: D._tri_inv_mesh(jnp.linalg.cholesky(M), sh))
+    # The old path's replicated L alone is m²·4 bytes on every device;
+    # demand at least half of that as the margin (buffer reuse hides
+    # part of the win from temp accounting).
+    assert new < old - 2 * m * m, (new, old)
+
+
+def test_preconditioner_path_end_to_end(mesh8):
+    """The sharded PCG backend must route through the distributed
+    factorization and still converge to the same optimum as the
+    replicated dense solve."""
+    from distributedlpsolver_tpu.backends.sharded import ShardedJaxBackend
+    from distributedlpsolver_tpu.ipm import solve
+    from distributedlpsolver_tpu.models.generators import random_dense_lp
+
+    p = random_dense_lp(48, 120, seed=11)
+    r_ref = solve(p, backend="cpu")
+    be = ShardedJaxBackend(mesh=mesh8)
+    r = solve(p, backend=be, solve_mode="pcg")
+    assert r.status.value == "optimal"
+    assert r.objective == pytest.approx(r_ref.objective, rel=1e-6)
