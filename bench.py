@@ -168,12 +168,24 @@ def _bench_batched(quick: bool):
     dt = time.perf_counter() - t0
     ok = sum(1 for s in res.status if s.value == "optimal")
     _log(f"  batched: {B} LPs in {res.solve_time:.3f}s, {ok}/{B} optimal")
+    # Per-member status breakdown (VERDICT round 3 item 2: the artifact
+    # must say WHAT the non-optimal members are, not just how many).
+    breakdown: dict = {}
+    for s in res.status:
+        breakdown[s.value] = breakdown.get(s.value, 0) + 1
+    non_opt = [
+        {"i": int(i), "status": res.status[i].value,
+         "rel_gap": float(res.rel_gap[i]), "pinf": float(res.pinf[i])}
+        for i in range(B) if res.status[i].value != "optimal"
+    ]
     row = {
         "backend": "batched(vmap)",
         "time_s": round(res.solve_time, 4),
         "problems": B,
         "problems_per_sec": round(B / max(res.solve_time, 1e-9), 1),
         "optimal": ok,
+        "status_breakdown": breakdown,
+        "non_optimal_members": non_opt[:16],  # cap: artifact readability
         "wall_s": round(dt, 4),
         "tol": 1e-8,
         # null until the baseline measurement actually succeeds — a
@@ -181,7 +193,7 @@ def _bench_batched(quick: bool):
         "vs_baseline": None,
     }
     try:
-        sample = min(16, B) if quick else min(64, B)
+        sample = min(16, B) if quick else min(128, B)
         rng = __import__("numpy").random.default_rng(7)
         idx = rng.choice(B, size=sample, replace=False)
         probs = [batch.problem(int(i)) for i in idx]

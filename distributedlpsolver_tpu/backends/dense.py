@@ -426,6 +426,21 @@ def _endgame_recenter(data, state, params):
 
 
 @jax.jit
+def _cent_diag(data, state, gamma):
+    """Centrality diagnostics of an iterate: (minprod/μ, #products below
+    γ·μ, μ). Scalars only — the endgame loop records them per iteration
+    so a blocked-step stall is attributable from the artifact alone (is
+    the iterate outside N₋∞(γ), and how far?)."""
+    x, _, s, w, z = state
+    xs = x * s
+    wz_on = jnp.where(data.hub > 0, w * z, jnp.inf)
+    mu = (jnp.sum(xs) + jnp.sum(jnp.where(data.hub > 0, w * z, 0.0))) / data.ncomp
+    minprod = jnp.minimum(jnp.min(xs), jnp.min(wz_on))
+    below = jnp.sum(xs < gamma * mu) + jnp.sum(wz_on < gamma * mu)
+    return minprod / jnp.maximum(mu, jnp.finfo(x.dtype).tiny), below, mu
+
+
+@jax.jit
 def _endgame_factor(M, reg):
     """Jacobi-scaled f64 Cholesky: factoring s·M·s (unit diagonal) cuts
     the FACTORED matrix's condition number by the diagonal's spread —
@@ -1438,9 +1453,37 @@ class DenseJaxBackend(SolverBackend):
         # Above the cutoff, fall back to re-assembling on (rare) retries.
         m = self._A.shape[0]
         hold_m = m <= 16384
+        # Anti-stagnation ladder for the BLOCKED-STEP mode (first observed
+        # 2026-07-31 at 10k×50k: pinf/dinf at ~9e-15 but μ frozen at
+        # 3.7e-8 with α pinned to the backoff grid's floor — the Mehrotra
+        # direction anti-centers the minimum pair, every N₋∞ candidate is
+        # inadmissible, and σ stays tiny because the AFFINE step keeps
+        # predicting progress the guard can't accept). Remedy ladder:
+        # after 2 consecutive μ-stagnant steps, run ONE pure centering
+        # step (StepParams.center: one KKT solve aiming every product at
+        # the current μ — admissible by construction, restores the step
+        # room the next Mehrotra iteration needs); if stagnation persists,
+        # lift collapsed pairs (_endgame_recenter) once; the stall window
+        # remains the final exit.
+        import dataclasses as _dc
+
+        params_center = _dc.replace(params, center=True)
+        stag = 0
+        center_next = False
+        recenters = 0
+        prev_mu = None
         k = 0
         while k < budget:
             t0 = _time.perf_counter()
+            # σ=1 on a centering iteration; the ASSEMBLY always runs with
+            # the base params (d depends only on reg_primal, identical in
+            # both — and a params-keyed recompile of the assembly would
+            # cost minutes at 10k scale for a bitwise-equal program).
+            step_par = params_center if center_next else params
+            cr, nb, _ = _cent_diag(
+                self._data, state, jnp.asarray(params.gamma_cent)
+            )
+            cent_ratio, n_below = float(np.asarray(cr)), int(np.asarray(nb))
             # M depends only on the iterate, NOT on reg — assemble once
             # per state; re-running the assembly dispatch (the longest,
             # ~40 s at 10k×50k) per bad-step retry would be pure waste.
@@ -1504,7 +1547,7 @@ class DenseJaxBackend(SolverBackend):
                     t1 = _time.perf_counter()
                     new_state, stats = _endgame_step_host(
                         self._A, self._data, state, hostf, float(reg),
-                        diagM, params, restore=restore,
+                        diagM, step_par, restore=restore,
                     )
                     bad = bool(np.asarray(stats.bad))
                     t_step = _time.perf_counter() - t1
@@ -1519,7 +1562,7 @@ class DenseJaxBackend(SolverBackend):
                     t1 = _time.perf_counter()
                     new_state, stats = _endgame_step(
                         self._A, self._data, state, L,
-                        jnp.asarray(reg, self._dtype), diagM, params,
+                        jnp.asarray(reg, self._dtype), diagM, step_par,
                     )
                     bad = bool(stats.bad)  # blocks on the step dispatch
                     t_step = _time.perf_counter() - t1
@@ -1543,6 +1586,12 @@ class DenseJaxBackend(SolverBackend):
                     "sigma": float(np.asarray(stats.sigma)),
                     "L_finite": L_finite,
                     "host": host_mode,
+                    # blocked-step-mode diagnostics (entry state): a stall
+                    # with cent_ratio ≪ γ is a guard-limited deadlock, one
+                    # with ratio ≈ γ and tiny α a ratio-test block.
+                    "center": bool(center_next),
+                    "cent_ratio": cent_ratio,
+                    "n_below": n_below,
                 })
                 t_asm = 0.0  # amortized: no re-assembly on retries
                 t_xfer = 0.0
@@ -1627,7 +1676,8 @@ class DenseJaxBackend(SolverBackend):
                 print(
                     f"[endgame] it={it} gap={row[2]:.3e} pinf={row[3]:.3e} "
                     f"dinf={row[4]:.3e} mu={row[0]:.2e} "
-                    f"a={row[7]:.2f}/{row[8]:.2f} ({dt:.1f}s)",
+                    f"a={row[7]:.2f}/{row[8]:.2f}"
+                    f"{' CENTER' if center_next else ''} ({dt:.1f}s)",
                     file=_sys.stderr, flush=True,
                 )
             if row[2] <= cfg.tol and row[3] <= cfg.tol and row[4] <= cfg.tol:
@@ -1640,6 +1690,35 @@ class DenseJaxBackend(SolverBackend):
                 if cfg.stall_window and since > 2 * cfg.stall_window:
                     status = core.STATUS_STALL
                     break
+            # Blocked-step ladder (see init above): μ-stagnation drives
+            # one centering step, then one collapsed-pair lift. Gated on
+            # BOTH counters: in the healthy endgame tail μ deliberately
+            # pins at core.mehrotra_step's mu_floor while pinf still
+            # improves 10×/iteration — μ-stagnation alone would fire
+            # centering (and the decidedly non-free recenter) mid-polish,
+            # so the ladder additionally requires err to have stopped
+            # improving (since > 0).
+            mu_new = row[0]
+            was_center = center_next
+            center_next = False
+            if prev_mu is not None and mu_new > 0.98 * prev_mu:
+                stag += 1
+            else:
+                stag = 0
+            prev_mu = mu_new
+            if stag >= 2 and since > 0 and not was_center:
+                if stag >= 4 and recenters == 0:
+                    state = _endgame_recenter(self._data, state, params)
+                    recenters += 1
+                    if trace:
+                        import sys as _sys
+
+                        print(
+                            "[endgame] stagnant after centering — lifting "
+                            "collapsed pairs",
+                            file=_sys.stderr, flush=True,
+                        )
+                center_next = True
         buf = np.concatenate([buf, np.asarray(rows)]) if rows else buf
         return state, it, jnp.asarray(status, jnp.int32), buf
 

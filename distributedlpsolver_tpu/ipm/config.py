@@ -190,3 +190,12 @@ class StepParams:
     gamma_cent: float
     reg_primal: float
     kkt_refine: int
+    # Pure centering step: skip the predictor entirely and aim every
+    # complementarity product at the CURRENT μ (σ=1, no second-order
+    # cross term). The blocked-step remedy (dense endgame anti-stagnation
+    # ladder): a Mehrotra direction that anti-centers the minimum pair
+    # can pin both ratio tests at ~0 while σ stays tiny (the affine step
+    # keeps predicting progress the N₋∞ guard cannot accept) — the
+    # centering direction is admissible by construction and restores the
+    # step room the next Mehrotra iteration needs.
+    center: bool = False

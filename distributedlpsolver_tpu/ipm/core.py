@@ -324,22 +324,6 @@ def mehrotra_step(
     d = scaling_d(state, data, cfg)
     factors = ops.factorize(d)
 
-    # Predictor (affine-scaling) direction.
-    rxs_aff = -x * s
-    rwz_aff = -(w * z) * hub
-    dxa, dya, dsa, dwa, dza = _solve_kkt(
-        ops, state, hub, d, factors, r_p, r_u, r_d, rxs_aff, rwz_aff, cfg.kkt_refine
-    )
-    ap_aff = _max_step(xp, x, dxa, w, dwa, hub)
-    ad_aff = _max_step(xp, s, dsa, z, dza, hub)
-    mu_aff = (
-        (x + ap_aff * dxa) @ (s + ad_aff * dsa)
-        + ((w + ap_aff * dwa) * (z + ad_aff * dza)) @ hub
-    ) / data.ncomp
-    sigma = xp.clip(
-        (xp.maximum(mu_aff, 0.0) / mu) ** cfg.sigma_power, cfg.sigma_min, cfg.sigma_max
-    )
-
     # Aim the centering target at the convergence tolerance, not at zero:
     # letting μ overshoot orders of magnitude below what a 1e-8 relative
     # gap needs pushes the scaling spread d_max/d_min past what f64 can
@@ -348,12 +332,39 @@ def mehrotra_step(
     # keeps a safe 30× margin below the gap test.
     pobj_now = c @ x
     mu_floor = 0.03 * cfg.tol * (1.0 + xp.abs(pobj_now)) / data.ncomp
-    target = xp.maximum(sigma * mu, mu_floor)
 
-    # Corrector: recenter to the target and cancel the second-order term,
-    # reusing the factorization (the defining Mehrotra move, BASELINE.json:5).
-    rxs = target - x * s - dxa * dsa
-    rwz = hub * (target - w * z - dwa * dza)
+    if cfg.center:
+        # Pure centering step (StepParams.center): one KKT solve aiming
+        # every product at the current μ — no predictor, no cross term.
+        sigma = xp.asarray(1.0, dtype=x.dtype)
+        target = xp.maximum(mu, mu_floor)
+        rxs = target - x * s
+        rwz = hub * (target - w * z)
+    else:
+        # Predictor (affine-scaling) direction.
+        rxs_aff = -x * s
+        rwz_aff = -(w * z) * hub
+        dxa, dya, dsa, dwa, dza = _solve_kkt(
+            ops, state, hub, d, factors, r_p, r_u, r_d, rxs_aff, rwz_aff,
+            cfg.kkt_refine
+        )
+        ap_aff = _max_step(xp, x, dxa, w, dwa, hub)
+        ad_aff = _max_step(xp, s, dsa, z, dza, hub)
+        mu_aff = (
+            (x + ap_aff * dxa) @ (s + ad_aff * dsa)
+            + ((w + ap_aff * dwa) * (z + ad_aff * dza)) @ hub
+        ) / data.ncomp
+        sigma = xp.clip(
+            (xp.maximum(mu_aff, 0.0) / mu) ** cfg.sigma_power,
+            cfg.sigma_min, cfg.sigma_max,
+        )
+        target = xp.maximum(sigma * mu, mu_floor)
+
+        # Corrector: recenter to the target and cancel the second-order
+        # term, reusing the factorization (the defining Mehrotra move,
+        # BASELINE.json:5).
+        rxs = target - x * s - dxa * dsa
+        rwz = hub * (target - w * z - dwa * dza)
     dx, dy, ds, dw, dz = _solve_kkt(
         ops, state, hub, d, factors, r_p, r_u, r_d, rxs, rwz, cfg.kkt_refine
     )

@@ -493,6 +493,96 @@ class TestHostEndgame:
         assert asm_calls["n"] == len(tm) - len(bad_rows)
 
 
+def test_pure_centering_step_improves_centrality():
+    """StepParams.center: a pure centering step on a badly off-center
+    iterate must raise the worst product/μ ratio while staying feasible —
+    the blocked-step remedy the endgame's anti-stagnation ladder fires."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    import distributedlpsolver_tpu.backends.dense as d
+    from distributedlpsolver_tpu.ipm import core as C
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.ipm.state import IPMState
+
+    rng = np.random.default_rng(4)
+    m, n = 12, 32
+    A = jnp.asarray(rng.standard_normal((m, n)))
+    x0 = jnp.asarray(rng.uniform(0.5, 2.0, n))
+    b = A @ x0
+    data = C.make_problem_data(
+        jnp, jnp.asarray(rng.standard_normal(n)), b,
+        jnp.full(n, jnp.inf), jnp.float64,
+    )
+    # off-center: a handful of products orders below the average
+    s0 = jnp.asarray(rng.uniform(0.5, 2.0, n)).at[:4].set(1e-6)
+    st = IPMState(x=x0, y=jnp.zeros(m), s=s0, w=jnp.ones(n),
+                  z=jnp.zeros(n))
+    params = dataclasses.replace(SolverConfig().step_params(), center=True)
+    ops = d._make_ops(A, jnp.asarray(1e-10), jnp.dtype(jnp.float64), 0,
+                      False, None, 0, 0.0, None)
+    r0, _, _ = d._cent_diag(data, st, jnp.asarray(params.gamma_cent))
+    st1, stats = C.mehrotra_step(ops, data, params, st)
+    r1, _, _ = d._cent_diag(data, st1, jnp.asarray(params.gamma_cent))
+    assert not bool(np.asarray(stats.bad))
+    assert float(np.asarray(stats.sigma)) == 1.0
+    # centrality must improve by a real factor, not noise
+    assert float(np.asarray(r1)) > 10 * float(np.asarray(r0))
+    assert np.all(np.asarray(st1.x) > 0) and np.all(np.asarray(st1.s) > 0)
+
+
+def test_endgame_stagnation_fires_centering_ladder(monkeypatch):
+    """μ-stagnant accepted steps must trigger the anti-stagnation ladder:
+    a pure centering step after 2 stagnant iterations (center=True param
+    reaching the step, row flagged), the collapsed-pair lift after 4, and
+    the run still finishing OPTIMAL once the (simulated) blockage lifts."""
+    import distributedlpsolver_tpu.backends.dense as d
+
+    real_step = d._endgame_step_host
+    real_recenter = d._endgame_recenter
+    sim = {"blocked": 0, "centers": 0, "recenters": 0}
+
+    def blocked_then_real(A, data, state, hostf, reg, diagM, params,
+                          refine=1, restore=None):
+        import jax.numpy as jnp
+
+        new_state, stats = real_step(
+            A, data, state, hostf, reg, diagM, params, refine=refine,
+            restore=restore,
+        )
+        if params.center:
+            sim["centers"] += 1
+        if sim["centers"] >= 2:
+            return new_state, stats  # blockage lifted — run real
+        # Simulate the blocked-step mode: the iterate does not move and
+        # μ reports a CONSTANT, so the loop's stagnation counter climbs
+        # deterministically through the whole ladder (2 → center,
+        # 4 → recenter + center) before the real solve resumes.
+        sim["blocked"] += 1
+        return state, stats._replace(
+            alpha_p=jnp.asarray(0.005), alpha_d=jnp.asarray(0.01),
+            mu=stats.mu * 0 + 1e-6, bad=stats.bad & False,
+        )
+
+    def counting_recenter(data, state, params):
+        sim["recenters"] += 1
+        return real_recenter(data, state, params)
+
+    monkeypatch.setattr(d, "_endgame_step_host", blocked_then_real)
+    monkeypatch.setattr(d, "_endgame_recenter", counting_recenter)
+    be, r, p = _force_endgame(monkeypatch)
+    _check_optimal(r, p)
+    tm = [row for row in be.endgame_timings if "t_step" in row]
+    # the ladder fired at least one centering step, flagged in the rows
+    assert sim["centers"] >= 1
+    assert any(row["center"] for row in tm)
+    # entry recenter always runs once; the ladder's mid-loop lift adds one
+    assert sim["recenters"] >= 2
+    # every row carries the blocked-step diagnostics
+    assert all("cent_ratio" in row and "n_below" in row for row in tm)
+
+
 def test_host_projector_restores_feasibility_and_respects_bounds():
     """Unit test of the alternating-projections (POCS) projector: an
     iterate pushed off Ax=b must come back to ~machine feasibility
