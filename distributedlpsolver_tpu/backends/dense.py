@@ -1770,10 +1770,21 @@ class DenseJaxBackend(SolverBackend):
             seg0 = 1 if cgi else core.seg_open(seg_cfg, est)
             return (make_run_seg, window, patience, seg0)
 
+        plan = self._phase_plan()
+        self.phase_report = []  # per-phase iters/wall split (utilization)
         st, it, status, buf, reg_out = core.drive_phase_plan(
-            [make_phase(s) for s in self._phase_plan()],
+            [make_phase(s) for s in plan],
             state, jnp.asarray(self._reg, dtype), cfg.max_iter, buf_cap, dtype,
+            report=self.phase_report,
         )
+        # Phase MODE recorded from the plan spec itself (cg_iters > 0 =
+        # pcg, else the factor dtype) — utilization folding keys seed
+        # rates off this, never off positional index guesses.
+        for ph, spec in zip(self.phase_report, plan):
+            ph["mode"] = (
+                "pcg" if spec[7] else
+                ("f32" if spec[1] == "float32" else "f64")
+            )
         m, n = self._A.shape
         # OPTIMAL re-enters the endgame ONLY when the two-phase plan
         # actually clamped the PCG phase to the looser handoff tol — then
@@ -1789,10 +1800,21 @@ class DenseJaxBackend(SolverBackend):
             and m * n >= self._ENDGAME_ENTRIES
             and int(np.asarray(status)) in trigger
         ):
+            import time as _time
+
+            it_before, t_eg = int(np.asarray(it)), _time.perf_counter()
             st, it, status, buf = self._endgame_loop(
-                st, int(np.asarray(it)), buf,
+                st, it_before, buf,
                 reg0=float(np.asarray(reg_out)),
             )
+            # The endgame is a phase too: without this row the report
+            # under-attributes exactly the iterations the utilization
+            # artifacts care most about.
+            self.phase_report.append({
+                "phase": len(self.phase_report), "mode": "endgame",
+                "iters": int(it) - it_before,
+                "wall_s": round(_time.perf_counter() - t_eg, 3),
+            })
         return st, it, status, buf
 
     def solve_full(self, state: IPMState):

@@ -1,0 +1,42 @@
+"""Utilization folding for phase reports (VERDICT round 3 item 4).
+
+`core.drive_phase_plan` records one ``{"phase", "iters", "wall_s"}`` row
+per phase; the backends stamp each row's ``"mode"`` from their own plan
+specs ("f32"/"mixed"/"f64"/"f64c"/"pcg"/"endgame"). This helper turns
+that into the utilization fields the scale artifacts record: effective
+FLOP/s per assembly-bound phase and its percentage of the watchdog seed
+rates (`core.SEG_RATE_F32`/`SEG_RATE_F64` — the conservative per-dtype
+device rates every backend already budgets segments with). PCG and
+endgame phases get no rate: their per-iteration flops are data-dependent
+(CG sweep counts; endgame host/device split), so a single
+flops-per-iteration figure would be fiction — their rows still carry the
+measured iters/wall split.
+"""
+
+from __future__ import annotations
+
+
+def fold_utilization(report, flops_per_iter: float):
+    """Annotate ``report`` rows (in place) with ``eff_flops_per_s`` and
+    ``pct_of_seed_rate`` for the assembly-bound phases; returns the list.
+
+    ``flops_per_iter`` is the backend's own per-iteration estimate for
+    the direct factorization path (e.g. ``BlockAngularBackend._f64_flops``)
+    — the same operation count runs in f32 and f64, only the seed rate
+    differs.
+    """
+    from distributedlpsolver_tpu.ipm import core
+
+    rates = {
+        "f32": core.SEG_RATE_F32,
+        "mixed": core.SEG_RATE_F32,
+        "f64": core.SEG_RATE_F64,
+        "f64c": core.SEG_RATE_F64,
+    }
+    for ph in report:
+        seed = rates.get(ph.get("mode"))
+        if seed and ph.get("iters") and ph.get("wall_s", 0) > 0:
+            eff = flops_per_iter * ph["iters"] / ph["wall_s"]
+            ph["eff_flops_per_s"] = f"{eff:.3g}"
+            ph["pct_of_seed_rate"] = round(100.0 * eff / seed, 1)
+    return report

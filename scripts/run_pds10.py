@@ -42,10 +42,14 @@ if mode == "cpu":
     tag = "cpu-sparse (SciPy sparse-direct normal equations, 1 host core)"
 else:
     from bench import _solve_timed  # tunnel-transient retry wrapper
+    from distributedlpsolver_tpu.backends.block_angular import (
+        BlockAngularBackend,
+    )
 
     _solve_timed(p, "block", max_iter=3)  # compile warm-up
+    be = BlockAngularBackend()  # explicit instance: phase_report access
     t0 = time.time()
-    r = _solve_timed(p, "block", max_iter=120)
+    r = _solve_timed(p, be, max_iter=120)
     tag = "block@tpu"
 wall = time.time() - t0
 print(
@@ -65,6 +69,18 @@ row = {
     "tol": 1e-8,
     "objective": float(r.objective),
 }
+if mode == "tpu":
+    # Per-phase wall split + FLOP/s vs seed rates, keyed by the
+    # backend-recorded phase mode (utils/utilization.py — shared with
+    # run_pds20_tpu.py).
+    from distributedlpsolver_tpu.utils.utilization import fold_utilization
+
+    report = list(getattr(be, "phase_report", []))
+    if report:
+        flops_it = float(be._f64_flops)
+        row["flops_per_iter_est"] = f"{flops_it:.3g}"
+        row["phase_report"] = fold_utilization(report, flops_it)
+
 out = os.path.join(_REPO, f".pds10_{mode}.json")
 with open(out, "w") as fh:
     json.dump(row, fh, indent=2)

@@ -217,8 +217,8 @@ def _force_endgame(monkeypatch, **extra):
     monkeypatch.setattr(d.DenseJaxBackend, "_ENDGAME_ENTRIES", 1)
     real_dpp = d.core.drive_phase_plan
 
-    def truncated(phases, state, reg0, max_iter, buf_cap, dtype):
-        return real_dpp(phases, state, reg0, 4, buf_cap, dtype)
+    def truncated(phases, state, reg0, max_iter, buf_cap, dtype, **kw):
+        return real_dpp(phases, state, reg0, 4, buf_cap, dtype, **kw)
 
     monkeypatch.setattr(d.core, "drive_phase_plan", truncated)
     p = random_dense_lp(48, 128, seed=6)
@@ -242,6 +242,11 @@ def test_endgame_finishes_after_pcg_floor(monkeypatch):
     assert {"it", "t_assemble", "t_factor", "t_step", "bad", "reg"} <= set(
         tm[0]
     )
+    # the endgame is a phase_report row too — without it the utilization
+    # artifacts under-attribute exactly the endgame iterations
+    rep = be.phase_report
+    assert rep[-1]["mode"] == "endgame"
+    assert sum(ph["iters"] for ph in rep) == r.iterations
     # seeded reg is capped: f32-phase escalations must not pin the f64
     # finish above tol (code-review finding, round 3)
     assert all(row["reg"] <= 1e-6 + 1e-18 for row in tm if not row["bad"])

@@ -182,6 +182,16 @@ def test_two_phase_solves_to_full_tol(monkeypatch):
     # the iteration log must cover every iteration exactly once
     assert len(r.history) == r.iterations
     assert [rec.iter for rec in r.history] == list(range(1, r.iterations + 1))
+    # per-phase utilization split (drive_phase_plan report): every phase
+    # row carries the keys the scale artifacts fold into FLOP/s — mode
+    # from the plan spec, never an index guess — and the iteration
+    # totals reconcile with the solve
+    rep = be.phase_report
+    assert rep and all(
+        {"phase", "iters", "wall_s", "mode"} <= set(ph) for ph in rep
+    )
+    assert [ph["mode"] for ph in rep][:1] == ["f32"]
+    assert sum(ph["iters"] for ph in rep) == r.iterations
 
 
 def test_auto_is_single_phase_off_tpu():
