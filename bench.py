@@ -46,29 +46,35 @@ def _solve_timed(problem, backend: str, _retries: int = 2, **cfg):
         try:
             return solve(problem, backend=backend, **cfg)
         except Exception as e:  # jax runtime errors don't share one base
-            msg = str(e)
-            # Specific tunnel-failure phrases retry regardless of type; the
-            # broad gRPC status tokens (UNAVAILABLE / DEADLINE_EXCEEDED)
-            # only count when they come from an XLA/PJRT runtime error —
-            # substring-matching them against arbitrary exception text
-            # would silently retry deterministic bugs whose wrapped
-            # message happens to contain one.
-            transient = any(
-                s in msg
-                for s in (
-                    "remote_compile", "response body closed",
-                    "crashed or restarted",
-                )
-            ) or (
-                type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
-                and any(s in msg for s in ("UNAVAILABLE", "DEADLINE_EXCEEDED"))
-            )
-            if not transient or attempt == _retries:
+            if not _is_transient(e) or attempt == _retries:
                 raise
             last = e
-            _log(f"  transient failure (attempt {attempt + 1}): {msg[:200]}")
+            _log(
+                f"  transient failure (attempt {attempt + 1}): {str(e)[:200]}"
+            )
             time.sleep(5.0)
     raise last  # unreachable
+
+
+def _is_transient(e: Exception) -> bool:
+    """Tunnel/worker failure classification shared by every retry site.
+
+    Specific tunnel-failure phrases retry regardless of type; the broad
+    gRPC status tokens (UNAVAILABLE / DEADLINE_EXCEEDED) only count when
+    they come from an XLA/PJRT runtime error — substring-matching them
+    against arbitrary exception text would silently retry deterministic
+    bugs whose wrapped message happens to contain one.
+    """
+    msg = str(e)
+    return any(
+        s in msg
+        for s in (
+            "remote_compile", "response body closed", "crashed or restarted",
+        )
+    ) or (
+        type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+        and any(s in msg for s in ("UNAVAILABLE", "DEADLINE_EXCEEDED"))
+    )
 
 
 def _headline_problem(args):
@@ -96,7 +102,13 @@ def _bench_one(problem, backend: str, baseline: str | None, **cfg):
     solve for the speedup ratio. Returns a result row dict."""
     from distributedlpsolver_tpu.backends import available_backends
 
-    _solve_timed(problem, backend, max_iter=3, **cfg)  # compile warm-up
+    # Warm-up at the SAME config as the timed solve: segmented backends
+    # key their compiled programs on buffer_cap(n_phases·max_iter), so a
+    # small-max_iter warm-up compiles a never-reused bucket and the timed
+    # solve pays the real compile (observed: storm-class row 74 s cold vs
+    # 10 s warm). A full warm solve costs seconds; a cold compile in the
+    # timed region costs the row its meaning.
+    _solve_timed(problem, backend, **cfg)
     r = _solve_timed(problem, backend, **cfg)
     _log(f"  {backend}: " + r.summary())
     row = {
@@ -108,11 +120,18 @@ def _bench_one(problem, backend: str, baseline: str | None, **cfg):
         # Every row records the tolerance it was solved to — rows at a
         # looser tol (e.g. first-order configs) must say so (VERDICT.md).
         "tol": cfg.get("tol", 1e-8),
-        "vs_baseline": 1.0,
+        # null until a baseline is actually measured (same rule as the
+        # batched row): a fabricated neutral 1.0 reads as "measured, no
+        # speedup" — e.g. the dense 2048x10240 row, whose CPU baseline
+        # is deliberately not run at full size.
+        "vs_baseline": None,
     }
     if baseline and baseline in available_backends() and baseline != backend:
         try:
-            _solve_timed(problem, baseline, max_iter=3)  # compile warm-up
+            # Baselines are CPU paths (no segmented buffer_cap buckets to
+            # warm) — a tiny warm-up covers any lazy init without running
+            # the slowest solve in the row twice.
+            _solve_timed(problem, baseline, max_iter=3)
             rb = _solve_timed(problem, baseline)
             _log(f"  baseline {baseline}: " + rb.summary())
             if rb.solve_time > 0 and r.solve_time > 0:
@@ -139,7 +158,21 @@ def _bench_batched(quick: bool):
 
     B, m, n = (32, 16, 40) if quick else (1024, 128, 512)
     batch = random_batched_lp(B, m, n, seed=0)
-    solve_batched(batch, max_iter=3)  # compile warm-up
+
+    def batched_retry(**kw):
+        # solve_batched with the same transient-retry the scalar rows get
+        # (a TPU worker restart mid-batch sank a whole suite run once).
+        for attempt in range(3):
+            try:
+                return solve_batched(batch, **kw)
+            except Exception as e:
+                if not _is_transient(e) or attempt == 2:
+                    raise
+                _log(f"  batched transient (attempt {attempt + 1}): "
+                     f"{str(e)[:200]}")
+                time.sleep(5.0)
+
+    batched_retry(max_iter=3)  # compile warm-up
     try:
         # Warm the solo-cleanup path too: tail-extracted stragglers
         # re-solve through the dense backend, and its first compile
@@ -164,7 +197,7 @@ def _bench_batched(quick: bool):
     except Exception as e:
         _log(f"  solo-path warm-up failed (non-fatal): {e}")
     t0 = time.perf_counter()
-    res = solve_batched(batch)
+    res = batched_retry()
     dt = time.perf_counter() - t0
     ok = sum(1 for s in res.status if s.value == "optimal")
     _log(f"  batched: {B} LPs in {res.solve_time:.3f}s, {ok}/{B} optimal")

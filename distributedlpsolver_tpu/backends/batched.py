@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -56,6 +57,9 @@ class BatchedResult:
     dinf: np.ndarray  # (B,)
     solve_time: float = 0.0
     setup_time: float = 0.0
+    # Per-phase iters/wall rows (segmented path; per CHUNK when chunked) —
+    # the utilization split the scale artifacts record.
+    phase_report: Optional[list] = None
 
     @property
     def n_optimal(self) -> int:
@@ -305,12 +309,39 @@ def _cleanup_cap(B: int) -> int:
 CLEANUP_BACKEND = "tpu"
 
 
-def _phase_plan(cfg: SolverConfig):
+# Member size (m·n entries) above which the multi-phase schedules can pay
+# for themselves in the batched loop. MEASURED at the reference batched
+# config (B=256 of 128×512 members, one chip, 2026-07-31):
+#     single-phase f64 direct      39.6 s   <- auto
+#     two-phase (all-f32 phase 1)  60.7 s
+#     two-phase (f32 factor only)  66.8 s
+#     PCG middle phase            575   s   (and its chunk>=256 programs
+#                                            crash the current TPU worker)
+# Small members invert every large-scale intuition: the per-iteration
+# factorization is microseconds of MXU work, the real cost is ELEMENTWISE
+# emulated-f64 arithmetic (~100 ns/element measured — a 648 ms f64 step
+# vs 108 ms all-f32 at B=128), and a phase-1 handoff at 3e-5 does NOT cut
+# the f64 finish's iteration count enough to amortize the phase's own
+# cost (observed: 27 f64 iterations after handoff vs ~30 from scratch).
+# PCG is strictly worse: it multiplies the elementwise work per solve.
+# Both schedules only win where the f64 FACTORIZATION is the wall (dense
+# 10k-scale); below this threshold auto runs the single-phase f64 loop.
+_PHASED_MEMBER_ENTRIES = 1 << 24
+
+
+def _phase_plan(cfg: SolverConfig, member_entries: Optional[int] = None):
     """(two_phase, use_pcg, n_phases) — the batched loop's phase schedule,
     ONE definition shared by solve_batched and the cleanup-budget helper
     so the per-problem iteration budget (n_phases·max_iter) cannot
-    silently diverge from the schedule that spends it."""
-    two_phase = cfg.two_phase_enabled(jax.default_backend())
+    silently diverge from the schedule that spends it.
+
+    ``member_entries`` (m·n of ONE member) gates the auto phase rules; None
+    (the cleanup-budget helper, which has no batch in hand) assumes the
+    reference batched class — small members, single phase."""
+    phased_pays = (
+        member_entries is not None and member_entries >= _PHASED_MEMBER_ENTRIES
+    )
+    two_phase = cfg.two_phase_enabled(jax.default_backend()) and phased_pays
     use_pcg = cfg.cg_iters > 0 and (
         cfg.solve_mode == "pcg" or (cfg.solve_mode is None and two_phase)
     )
@@ -357,6 +388,17 @@ def _fresh_batch_carry(states, iters, B, reg0, dtype, status=None):
     )
 
 
+def _cast_batch_carry(carry, dtype):
+    """Cast the batched carry's floating leaves (state, regs, best) to
+    ``dtype`` across an f32-phase boundary; integer/bool lanes (active,
+    counters, status) pass through untouched."""
+    states, active, it, regs, badcount, status, iters, best, since = carry
+    cast = lambda v: v.astype(dtype)
+    states = jax.tree_util.tree_map(cast, states)
+    return (states, active, it, cast(regs), badcount, status, iters,
+            cast(best), since)
+
+
 def _solve_batched_segmented(
     A, data, cfg, params, params_p1, fname, two_phase, seg, cg=(0, 0.0)
 ):
@@ -365,14 +407,38 @@ def _solve_batched_segmented(
     long fused batched solves trip the ~60s limit on tunneled TPUs)."""
     B = A.shape[0]
     dtype = A.dtype
+    f32 = jnp.float32
     reg0 = jnp.asarray(cfg.reg_dual, dtype)
     mi = jnp.asarray(cfg.max_iter, jnp.int32)
     mr = jnp.asarray(cfg.max_refactor, jnp.int32)
     rg = jnp.asarray(cfg.reg_grow, dtype)
     cgi, cgt = cg
     A32 = (
-        A.astype(jnp.float32)
+        A.astype(f32)
         if (two_phase or fname == "float32" or cgi)
+        else None
+    )
+    # Phase 1 runs ENTIRELY in f32 — state, residuals, ratio tests,
+    # backoff, not just the factorization. Measured at the reference
+    # batched member shape (B=128 of 128×512): a full step with f64
+    # state costs 578 ms (f64-factor) / 121 ms (f32-factor) while the
+    # MXU dots in it are microseconds — the cost is ELEMENTWISE
+    # emulated-f64 arithmetic over the (B, n) vectors (~100 ns/element:
+    # divisions in scaling_d and the ratio tests, the (B, 24, n)
+    # backoff grid, residual updates). f32 elementwise is native VPU
+    # work, so the f32 phase's per-iteration cost drops by an order of
+    # magnitude, and the f64 finish only pays the emulation tax for the
+    # last 3 orders of magnitude. The f32 noise floor (~1e-6 relative)
+    # sits safely below the 3e-5 handoff tolerance that phase-1 params
+    # already encode.
+    data32 = (
+        jax.tree_util.tree_map(
+            lambda v: v.astype(f32)
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
+            else v,
+            data,
+        )
+        if two_phase
         else None
     )
     # Starting point at the resolved factor dtype (== full dtype under the
@@ -381,16 +447,16 @@ def _solve_batched_segmented(
     states0 = _batched_start_jit(A, data, reg0, params, fname)
 
     # Phase tuples: (step params, factor dtype, stall window, stall
-    # status, cg iters, keep-optimal-at-exit). The PCG middle phase runs
-    # at FULL tolerance, so its optimal verdicts survive the boundary;
-    # the f32 phase-1 verdicts are provisional and reset.
+    # status, cg iters, keep-optimal-at-exit, f32-state). The PCG middle
+    # phase runs at FULL tolerance, so its optimal verdicts survive the
+    # boundary; the f32 phase-1 verdicts are provisional and reset.
     w = cfg.stall_window
     phases = []
     if two_phase:
-        phases.append((params_p1, "float32", w, _RUNNING, 0, False))
+        phases.append((params_p1, "float32", w, _RUNNING, 0, False, True))
     if cgi:
-        phases.append((params, "float32", w, _RUNNING, cgi, True))
-    phases.append((params, fname, 2 * w if w else 0, _STALL, 0, False))
+        phases.append((params, "float32", w, _RUNNING, cgi, True, False))
+    phases.append((params, fname, 2 * w if w else 0, _STALL, 0, False, False))
     carry = _fresh_batch_carry(states0, jnp.zeros(B, jnp.int32), B, reg0, dtype)
     # Tail extraction: a handful of stragglers would otherwise keep the
     # full-batch masked loop running at whole-batch cost per iteration.
@@ -403,14 +469,30 @@ def _solve_batched_segmented(
     # problem is never left without its cleanup solve.
     tail = B // 32
     cleanup_cap = _cleanup_cap(B)
-    for pi, (p, f, win, wstat, pcgi, keep_opt) in enumerate(phases):
+    phase_report = []  # same shape as drive_phase_plan's report rows
+    for pi, (p, f, win, wstat, pcgi, keep_opt, f32_state) in enumerate(phases):
         final = pi == len(phases) - 1
+        t_ph = time.perf_counter()
+        if f32_state:
+            # Enter the all-f32 phase: cast the CARRY's state and float
+            # trackers down; the phase program then sees f32 arrays
+            # everywhere and every op in the step runs native-f32.
+            carry = _cast_batch_carry(carry, f32)
+            Ap, datap, A32p = A32, data32, None  # factor from Ap itself
+        else:
+            if carry[0].x.dtype != dtype:  # leaving the f32 phase
+                carry = _cast_batch_carry(carry, dtype)
+            Ap, datap = A, data
+            A32p = A32 if f == "float32" else None
 
-        def run_seg(c, stop, _a=(p, f, win, wstat, pcgi)):
-            pp, ff, w, ws, ci = _a
+        def run_seg(c, stop, _a=(p, f, win, wstat, pcgi, Ap, datap, A32p)):
+            pp, ff, w, ws, ci, Ax, dx, A32x = _a
+            # reg_grow cast to the PHASE dtype: an f64 scalar would
+            # promote the f32 carry's regs lane out of its while_loop
+            # carry type.
             return _batched_segment_jit(
-                A, data, c, jnp.asarray(stop, jnp.int32), mi, mr, rg, pp, ff,
-                w, ws, A32 if ff == "float32" else None, ci,
+                Ax, dx, c, jnp.asarray(stop, jnp.int32), mi, mr,
+                rg.astype(Ax.dtype), pp, ff, w, ws, A32x, ci,
                 cgt if ci else 0.0,
             )
 
@@ -429,9 +511,18 @@ def _solve_batched_segmented(
                 else None
             ),
         )
+        phase_report.append({
+            "phase": pi,
+            "mode": ("f32-state" if f32_state
+                     else ("pcg" if pcgi else f)),
+            "iters": int(carry[2]),  # phase-local iteration count
+            "wall_s": round(time.perf_counter() - t_ph, 3),
+        })
         if not final:
             # Phase boundary: iterates kept; verdicts reset — except a
             # full-tolerance phase's OPTIMAL members, which stay settled.
+            if carry[0].x.dtype != dtype:
+                carry = _cast_batch_carry(carry, dtype)
             carry = _fresh_batch_carry(
                 carry[0], carry[6], B, reg0, dtype,
                 status=carry[5] if keep_opt else None,
@@ -440,7 +531,7 @@ def _solve_batched_segmented(
     states, _, _, _, _, status, iters, _, _ = carry
     status = jnp.where(status == _RUNNING, _MAXITER, status)
     pinf, dinf, rel_gap, pobj = _batched_norms_jit(A, data, states, fname)
-    return states, status, iters, pinf, dinf, rel_gap, pobj
+    return states, status, iters, pinf, dinf, rel_gap, pobj, phase_report
 
 
 def member_interior_form(batch: BatchedLP, i: int):
@@ -474,6 +565,13 @@ def _concat_results(parts, solve_time, setup_time) -> BatchedResult:
         dinf=cat("dinf"),
         solve_time=solve_time,
         setup_time=setup_time,
+        # Flat rows with a chunk tag — same shape chunked or not, so
+        # consumers never branch on the solve's chunking.
+        phase_report=[
+            {**ph, "chunk": ci}
+            for ci, p in enumerate(parts)
+            for ph in (p.phase_report or [])
+        ],
     )
 
 
@@ -570,19 +668,21 @@ def solve_batched(
     setup_time = time.perf_counter() - t0
 
     t1 = time.perf_counter()
-    # PCG middle phase (full tolerance, f32 preconditioner + f64
-    # matrix-free CG): replaces most of the f64 finish's per-iteration
-    # emulated-f64 assembly+Cholesky — the batched phase-2 cost center —
-    # with MXU work. Auto-on wherever the two-phase schedule is (TPU);
-    # "direct" opts out, "pcg" opts in anywhere.
-    two_phase, use_pcg, n_phases = _phase_plan(cfg)
+    # Phase schedule (shared _phase_plan): phases are auto-gated on
+    # MEMBER size — at the reference batched shape single-phase f64 was
+    # measured fastest and PCG 5.6× worse (see _PHASED_MEMBER_ENTRIES),
+    # and the PCG chunk≥256 programs crash the current TPU worker;
+    # "pcg" still opts in explicitly.
+    two_phase, use_pcg, n_phases = _phase_plan(cfg, member_entries=m * n)
     params_p1 = cfg.phase1_params()
     cg = (cfg.cg_iters, cfg.cg_tol) if use_pcg else (0, 0.0)
     seg = cfg.segment_iters
     if seg is None:
         seg = 8 if jax.default_backend() == "tpu" else 0
+    phase_report = []
     if seg:
-        states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_segmented(
+        (states, status, iters, pinf, dinf, rel_gap, pobj,
+         phase_report) = _solve_batched_segmented(
             A, data, cfg, params, params_p1, fname, two_phase, seg, cg
         )
     else:
@@ -678,4 +778,5 @@ def solve_batched(
         dinf=dinf,
         solve_time=solve_time,
         setup_time=setup_time,
+        phase_report=phase_report,
     )

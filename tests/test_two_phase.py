@@ -238,12 +238,22 @@ def test_two_phase_sharded_on_mesh(monkeypatch):
 
 
 def test_two_phase_batched(monkeypatch):
+    # Force the phased schedule despite the tiny members: auto keys it on
+    # member size (measured single-phase win at the reference batched
+    # shape — see batched._PHASED_MEMBER_ENTRIES), so the all-f32 phase-1
+    # path would otherwise never run in CI.
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    from distributedlpsolver_tpu.backends import batched as bt
     from distributedlpsolver_tpu.backends.batched import solve_batched
     from distributedlpsolver_tpu.models.generators import random_batched_lp
 
+    monkeypatch.setattr(bt, "_PHASED_MEMBER_ENTRIES", 1)
     batch = random_batched_lp(8, 12, 30, seed=4)
-    res = solve_batched(batch)
+    res = solve_batched(batch, solve_mode="direct")
+    # the all-f32 phase must actually have run, then the f64 finish
+    assert res.phase_report is not None
+    modes = [ph["mode"] for ph in res.phase_report]
+    assert modes[0] == "f32-state" and modes[-1] == "float64", modes
     assert res.n_optimal == 8
     assert (res.rel_gap <= 1e-8).all()
     # oracle-check one member
