@@ -107,9 +107,16 @@ def _bench_one(problem, backend: str, baseline: str | None, **cfg):
     # small-max_iter warm-up compiles a never-reused bucket and the timed
     # solve pays the real compile (observed: storm-class row 74 s cold vs
     # 10 s warm). A full warm solve costs seconds; a cold compile in the
-    # timed region costs the row its meaning.
+    # timed region costs the row its meaning. The timed figure is the
+    # best of two: the tunneled worker shows occasional one-off ~8×
+    # slowness on a fully warm program (observed on the storm row
+    # mid-suite, unreproducible in isolation) and a single sample can't
+    # tell that from a regression.
     _solve_timed(problem, backend, **cfg)
     r = _solve_timed(problem, backend, **cfg)
+    r2 = _solve_timed(problem, backend, **cfg)
+    if r2.solve_time < r.solve_time:
+        r = r2
     _log(f"  {backend}: " + r.summary())
     row = {
         "backend": getattr(r, "backend", backend),
@@ -129,10 +136,14 @@ def _bench_one(problem, backend: str, baseline: str | None, **cfg):
     if baseline and baseline in available_backends() and baseline != backend:
         try:
             # Baselines are CPU paths (no segmented buffer_cap buckets to
-            # warm) — a tiny warm-up covers any lazy init without running
-            # the slowest solve in the row twice.
+            # warm) — a tiny warm-up covers any lazy init. The baseline is
+            # best-of-two like the backend figure: filtering noise from
+            # only one side of the ratio would bias vs_baseline upward.
             _solve_timed(problem, baseline, max_iter=3)
             rb = _solve_timed(problem, baseline)
+            rb2 = _solve_timed(problem, baseline)
+            if rb2.solve_time < rb.solve_time:
+                rb = rb2
             _log(f"  baseline {baseline}: " + rb.summary())
             if rb.solve_time > 0 and r.solve_time > 0:
                 row["baseline_backend"] = baseline
@@ -162,9 +173,12 @@ def _bench_batched(quick: bool):
     def batched_retry(**kw):
         # solve_batched with the same transient-retry the scalar rows get
         # (a TPU worker restart mid-batch sank a whole suite run once).
+        # Returns (result, attempts): a retried TIMED solve pays the lost
+        # worker's recompiles inside its own clock, so the caller re-runs
+        # once warm rather than recording a compile-contaminated figure.
         for attempt in range(3):
             try:
-                return solve_batched(batch, **kw)
+                return solve_batched(batch, **kw), attempt + 1
             except Exception as e:
                 if not _is_transient(e) or attempt == 2:
                     raise
@@ -193,11 +207,15 @@ def _bench_batched(quick: bool):
         from distributedlpsolver_tpu.ipm.driver import solve as _solo_solve
 
         _solo_solve(member_interior_form(batch, 0), backend=CLEANUP_BACKEND,
-                    max_iter=cleanup_solo_max_iter())
+                    max_iter=cleanup_solo_max_iter(member_entries=m * n))
     except Exception as e:
         _log(f"  solo-path warm-up failed (non-fatal): {e}")
     t0 = time.perf_counter()
-    res = batched_retry()
+    res, attempts = batched_retry()
+    if attempts > 1:  # worker restarted mid-solve: re-time on a warm cache
+        _log("  batched timed solve hit a worker restart; re-timing warm")
+        t0 = time.perf_counter()
+        res, _ = batched_retry()
     dt = time.perf_counter() - t0
     ok = sum(1 for s in res.status if s.value == "optimal")
     _log(f"  batched: {B} LPs in {res.solve_time:.3f}s, {ok}/{B} optimal")

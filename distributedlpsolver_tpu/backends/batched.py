@@ -349,15 +349,18 @@ def _phase_plan(cfg: SolverConfig, member_entries: Optional[int] = None):
 
 
 def cleanup_solo_max_iter(config: Optional[SolverConfig] = None,
+                          member_entries: Optional[int] = None,
                           typical_spent: int = 40) -> int:
     """The ``max_iter`` a typical solo-cleanup solve runs with (cleanup
     budget = n_phases·max_iter − iterations already spent in the batched
     loop, via the shared :func:`_phase_plan`). Compile-cache buckets
     (core.buffer_cap) are keyed by this figure, so a warm-up must use it —
     a hardcoded number silently compiles a never-reused executable
-    whenever the defaults move."""
+    whenever the defaults move. Pass the batch's ``member_entries``
+    (m·n of one member) so the phase count matches the member-gated
+    schedule the real solve will run."""
     cfg = config or SolverConfig()
-    _, _, n_phases = _phase_plan(cfg)
+    _, _, n_phases = _phase_plan(cfg, member_entries=member_entries)
     return max(1, n_phases * cfg.max_iter - typical_spent)
 
 
@@ -596,7 +599,6 @@ def solve_batched(
     no chunking — elsewhere); chunking preserves mesh divisibility by
     requiring chunk % mesh size == 0.
     """
-    import time
 
     cfg = config or SolverConfig()
     if config_overrides:

@@ -53,6 +53,7 @@ row = {
     "dinf": float(r.dinf),
     "setup_s": round(r.setup_time, 1),
     "wall_s": round(wall, 1),
+    "phase_report": list(getattr(be, "phase_report", [])),
     "endgame_timings": getattr(be, "endgame_timings", []),
 }
 with open("/root/repo/BENCH_10K.json", "w") as fh:
