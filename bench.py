@@ -210,13 +210,15 @@ def _bench_batched(quick: bool):
                     max_iter=cleanup_solo_max_iter(member_entries=m * n))
     except Exception as e:
         _log(f"  solo-path warm-up failed (non-fatal): {e}")
-    t0 = time.perf_counter()
-    res, attempts = batched_retry()
-    if attempts > 1:  # worker restarted mid-solve: re-time on a warm cache
-        _log("  batched timed solve hit a worker restart; re-timing warm")
+    # Re-time (bounded) until a run completes without a worker restart —
+    # a retried run's clock includes the lost worker's recompiles.
+    for _ in range(3):
         t0 = time.perf_counter()
-        res, _ = batched_retry()
-    dt = time.perf_counter() - t0
+        res, attempts = batched_retry()
+        dt = time.perf_counter() - t0
+        if attempts == 1:
+            break
+        _log("  batched timed solve hit a worker restart; re-timing warm")
     ok = sum(1 for s in res.status if s.value == "optimal")
     _log(f"  batched: {B} LPs in {res.solve_time:.3f}s, {ok}/{B} optimal")
     # Per-member status breakdown (VERDICT round 3 item 2: the artifact
