@@ -344,8 +344,9 @@ def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg,
     ``chunk=None`` sizes the chunk to the temp budget: the LARGEST chunk
     whose split temps fit _F64C_TEMP_BUDGET, floored at 128. Bigger
     chunks mean fewer, larger emulated-f64 dots — measured at the pds-20
-    class: 72.4 s vs 81.6 s full solve (1.13×) going from the old fixed
-    128 to budget-sized (480), identical iterations and result.
+    class: 70.7 s vs 81.6 s full solve (1.15×) going from the old fixed
+    128 to budget-sized (480), identical iterations and result
+    (SCALE_RUNS.json round4_improvement).
 
     Per-iteration cost at the pds-20 class (K=64, mb=432, nb≈1300,
     link=1600): ~5e11 emulated-f64 flops ≈ 2–3 s of MXU time — the

@@ -521,6 +521,39 @@ def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=1):
 # ----------------------------------------------------------------------
 
 
+def _fetch_symmetric(M, pieces: int = 8):
+    """Device→host transfer of a symmetric matrix by its LOWER TRIANGLE
+    only, in ``pieces`` equal-area row blocks (block k = rows
+    ``[m·√(k/p), m·√((k+1)/p))``, columns ``[:row_end)``) — ~0.53·m²
+    elements instead of m², then mirrored on host.
+
+    The d2h copy is the host endgame's single largest cost at 10k scale
+    (~45–73 s per iteration for the 800 MB M over the tunnel, vs ~11 s
+    assembly and ~15 s factorization — BENCH_10K.json timings), and M is
+    always symmetric here; halving the bytes takes ~40% off the whole
+    endgame iteration. Host mirror + block copies are ~0.5 s of numpy.
+    """
+    import math
+
+    m = M.shape[0]
+    out = np.empty((m, m), np.float64)
+    bounds = [round(m * math.sqrt(k / pieces)) for k in range(pieces + 1)]
+    bounds[-1] = m
+    for k in range(pieces):
+        i0, i1 = bounds[k], bounds[k + 1]
+        if i1 > i0:
+            out[i0:i1, :i1] = np.asarray(M[i0:i1, :i1])
+    # Mirror blockwise from the transferred lower part (each block already
+    # carries its own upper wedge since its columns run to the row end) —
+    # a triu_indices mirror would allocate ~1.2 GB of index/gather temps
+    # at m=10k, defeating the transfer saving.
+    for k in range(pieces):
+        i0, i1 = bounds[k], bounds[k + 1]
+        if i1 > i0 and i0 > 0:
+            out[:i0, i0:i1] = out[i0:i1, :i0].T
+    return out
+
+
 def _endgame_factor_host(Mh, reg):
     """True-f64 host (LAPACK) Cholesky of the Jacobi-scaled, regularized
     system: factors ``s·Mh·s + reg·I`` (unit diagonal — same scaling
@@ -656,7 +689,7 @@ def _build_host_projector(A, data, trace=False):
     t0 = _time.perf_counter()
     G = _normal_eq_chunked(A, ones)
     jax.block_until_ready(G)
-    Gh = np.asarray(G)
+    Gh = _fetch_symmetric(G)
     del G
     hostf = None
     reg = 1e-12
@@ -1492,13 +1525,15 @@ class DenseJaxBackend(SolverBackend):
             t_asm = _time.perf_counter() - t0
             Mh = None
             if host_mode:
-                # One d2h transfer per iterate (~62 s measured for the
-                # 800 MB 10k×10k over the tunnel, the host path's main
-                # cost); retries refactor from this SAME host copy, and
-                # the device M is freed immediately — the host path never
-                # holds M and L in HBM together.
+                # One d2h transfer per iterate — lower triangle only,
+                # mirrored on host (M is symmetric; see _fetch_symmetric:
+                # the full 800 MB copy measured ~45–73 s per iteration
+                # over the tunnel, the host path's main cost). Retries
+                # refactor from this SAME host copy, and the device M is
+                # freed immediately — the host path never holds M and L
+                # in HBM together.
                 t1 = _time.perf_counter()
-                Mh = np.asarray(M)
+                Mh = _fetch_symmetric(M)
                 t_xfer = _time.perf_counter() - t1
                 diagM_h = np.ascontiguousarray(np.diagonal(Mh))
                 diagM = jnp.asarray(diagM_h)
