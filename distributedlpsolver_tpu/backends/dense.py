@@ -521,11 +521,14 @@ def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=1):
 # ----------------------------------------------------------------------
 
 
-def _fetch_symmetric(M, pieces: int = 8):
+def _fetch_symmetric(M, pieces: int = 32):
     """Device→host transfer of a symmetric matrix by its LOWER TRIANGLE
     only, in ``pieces`` equal-area row blocks (block k = rows
-    ``[m·√(k/p), m·√((k+1)/p))``, columns ``[:row_end)``) — ~0.53·m²
-    elements instead of m², then mirrored on host.
+    ``[m·√(k/p), m·√((k+1)/p))``, columns ``[:row_end)``), then mirrored
+    on host. Each block over-fetches its upper wedge, so the transferred
+    fraction is ~(0.5 + 0.4/p)·m²: 0.60·m² at p=8, 0.53·m² at the
+    default 32 (measured at m=10000) — block-count host overhead is
+    negligible against the tunnel's MB/s.
 
     The d2h copy is the host endgame's single largest cost at 10k scale
     (~45–73 s per iteration for the 800 MB M over the tunnel, vs ~11 s
@@ -1806,20 +1809,30 @@ class DenseJaxBackend(SolverBackend):
             return (make_run_seg, window, patience, seg0)
 
         plan = self._phase_plan()
+        # Phase MODE from the plan spec itself (cg_iters > 0 = pcg, else
+        # the factor dtype) — utilization folding keys seed rates off
+        # this, never off positional index guesses. Extracted BEFORE the
+        # solve so `plan` (whose specs hold the ~2 GB Pallas-padded A32
+        # and the closure factor) can be dropped: holding it across the
+        # endgame kept those buffers alive through _endgame_loop's
+        # entry-time release and OOMed the projector's AAᵀ assembly at
+        # 10k×50k (observed 2026-07-31 — the same +2.4 GB failure the
+        # release exists to prevent).
+        modes = [
+            "pcg" if spec[7] else ("f32" if spec[1] == "float32" else "f64")
+            for spec in plan
+        ]
+        phases_built = [make_phase(s) for s in plan]
+        del plan
         self.phase_report = []  # per-phase iters/wall split (utilization)
         st, it, status, buf, reg_out = core.drive_phase_plan(
-            [make_phase(s) for s in plan],
+            phases_built,
             state, jnp.asarray(self._reg, dtype), cfg.max_iter, buf_cap, dtype,
             report=self.phase_report,
         )
-        # Phase MODE recorded from the plan spec itself (cg_iters > 0 =
-        # pcg, else the factor dtype) — utilization folding keys seed
-        # rates off this, never off positional index guesses.
-        for ph, spec in zip(self.phase_report, plan):
-            ph["mode"] = (
-                "pcg" if spec[7] else
-                ("f32" if spec[1] == "float32" else "f64")
-            )
+        del phases_built  # make_phase closures also reference A32
+        for ph, mode in zip(self.phase_report, modes):
+            ph["mode"] = mode
         m, n = self._A.shape
         # OPTIMAL re-enters the endgame ONLY when the two-phase plan
         # actually clamped the PCG phase to the looser handoff tol — then
