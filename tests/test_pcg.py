@@ -498,6 +498,24 @@ class TestHostEndgame:
         assert asm_calls["n"] == len(tm) - len(bad_rows)
 
 
+@pytest.mark.parametrize("m", [1, 5, 97, 256, 1000, 1023])
+def test_fetch_symmetric_exact(m):
+    """The lower-triangle d2h fetch (dense._fetch_symmetric) must
+    reconstruct a symmetric matrix EXACTLY (bitwise) — the host endgame
+    factors what it returns, so any mirroring defect becomes a silent
+    factorization of the wrong matrix."""
+    import jax.numpy as jnp
+
+    import distributedlpsolver_tpu.backends.dense as d
+
+    rng = np.random.default_rng(m)
+    G = rng.standard_normal((m, m))
+    S = G + G.T
+    got = d._fetch_symmetric(jnp.asarray(S))
+    assert got.dtype == np.float64
+    np.testing.assert_array_equal(got, S)
+
+
 def test_pure_centering_step_improves_centrality():
     """StepParams.center: a pure centering step on a badly off-center
     iterate must raise the worst product/μ ratio while staying feasible —
