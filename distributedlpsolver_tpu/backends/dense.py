@@ -767,6 +767,22 @@ def _build_host_projector(A, data, trace=False):
     return project
 
 
+def _use_chol_mxu(factor_dtype) -> bool:
+    """Route f64 factorizations to the GEMM-dominated panel
+    factor+inverse (ops/chol_mxu.py). Auto: exactly on TPU, where the
+    builtin emulated-f64 cholesky is ~10× slower (measured) — CPU/LAPACK
+    paths are left alone. TPULP_CHOL_MXU=1/0 overrides (tests exercise
+    the kernel on the CPU mesh with it)."""
+    import os
+
+    if jnp.dtype(factor_dtype) != jnp.dtype(jnp.float64):
+        return False
+    env = os.environ.get("TPULP_CHOL_MXU", "")
+    if env in ("0", "1"):
+        return env == "1"
+    return jax.default_backend() == "tpu"
+
+
 def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
     """Build factorize/solve closures over a (traced) matrix ``A``.
 
@@ -805,6 +821,15 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
         # norm-scaled) shift would swamp the small rows and wreck the
         # Newton direction's primal-residual reduction.
         M = M + jnp.diag(jnp.asarray(reg, M.dtype) * jnp.diagonal(M))
+        if inv_mxu:
+            # f64 on TPU: XLA's emulated-f64 cholesky/cho_solve lower to
+            # scalarized recurrences (~345 ms + ~20 ms/solve measured at
+            # the (128,128,128) batched shape) while emulated-f64 GEMM is
+            # fast and 2e-15-accurate — use the GEMM-dominated panel
+            # factor+inverse instead (ops/chol_mxu.py, ~10× measured).
+            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
+
+            return chol_inv_mxu(M.astype(factor_dtype)), M
         L = jnp.linalg.cholesky(M if M.dtype == factor_dtype else M.astype(factor_dtype))
         if explicit_inv:
             # Large-m f32 path on TPU: one paneled inverse per
@@ -820,9 +845,10 @@ def _cholesky_ops(A, factor_dtype, refine_steps, use_pallas=False, Af=None):
         and m_ >= 2048
         and jax.default_backend() == "tpu"
     )
+    inv_mxu = _use_chol_mxu(factor_dtype)
 
     def _apply_inv(factors, rhs32):
-        if explicit_inv:
+        if explicit_inv or inv_mxu:
             Linv, _ = factors
             return Linv.T @ (Linv @ rhs32)
         L, _ = factors
