@@ -402,12 +402,64 @@ def _cast_batch_carry(carry, dtype):
             cast(best), since)
 
 
+_COMPACT_FLOOR = 32  # smallest compacted program size
+
+
+def _compact_gather(carry, order, keep_idx, new_size, B):
+    """Gather the ``keep_idx`` members of a batched carry into a
+    ``new_size`` program (padding by repeating the first kept member,
+    padded entries forced inactive/settled with sentinel scatter target
+    ``B`` so they can never write back)."""
+    states, active, it, regs, badcount, status, iters, best, since = carry
+    k = len(keep_idx)
+    pad = np.full(new_size - k, keep_idx[0] if k else 0, np.int64)
+    sel = jnp.asarray(np.concatenate([keep_idx, pad]))
+    valid = jnp.arange(new_size) < k
+    g = lambda v: v[sel]
+    carry2 = (
+        jax.tree_util.tree_map(g, states),
+        g(active) & valid,
+        it,
+        g(regs),
+        g(badcount),
+        jnp.where(valid, g(status), _OPTIMAL),
+        g(iters),
+        g(best),
+        g(since),
+    )
+    order2 = jnp.where(valid, order[sel], B)
+    return carry2, order2, sel
+
+
+def _scatter_out(outs, order, carry):
+    """Scatter a (possibly compacted) carry's per-member lanes into the
+    full-size out buffers (one sentinel row at index B absorbs pads)."""
+    states_out, status_out, iters_out = outs
+    states, _, _, _, _, status, iters, _, _ = carry
+    states_out = jax.tree_util.tree_map(
+        lambda o, v: o.at[order].set(v), states_out, states
+    )
+    return states_out, status_out.at[order].set(status), iters_out.at[order].set(iters)
+
+
 def _solve_batched_segmented(
-    A, data, cfg, params, params_p1, fname, two_phase, seg, cg=(0, 0.0)
+    A, data, cfg, params, params_p1, fname, two_phase, seg, cg=(0, 0.0),
+    compact_ok=False,
 ):
     """Host-segmented batched solve: same phases as _solve_batched_jit but
     each device program is bounded to ~15s (execution-watchdog guard —
-    long fused batched solves trip the ~60s limit on tunneled TPUs)."""
+    long fused batched solves trip the ~60s limit on tunneled TPUs).
+
+    ``compact_ok`` additionally enables FINAL-phase compaction: whenever
+    the active-member count falls to half the current program size, the
+    still-active members are gathered into a half-size program
+    (B → B/2 → … → 32) and the loop continues there. Rationale
+    (measured, 2026-08-01): the masked whole-batch loop runs to the
+    slowest member — ~62 accepted steps per 256-chunk while the MEAN
+    member needs 16, so ~60% of step compute was spent advancing frozen
+    members. Program sizes are fixed halvings, so each size compiles
+    once and is reused by every chunk. Disabled under a mesh (the batch
+    axis is sharded; gathers would reshard it)."""
     B = A.shape[0]
     dtype = A.dtype
     f32 = jnp.float32
@@ -488,32 +540,42 @@ def _solve_batched_segmented(
             Ap, datap = A, data
             A32p = A32 if f == "float32" else None
 
-        def run_seg(c, stop, _a=(p, f, win, wstat, pcgi, Ap, datap, A32p)):
-            pp, ff, w, ws, ci, Ax, dx, A32x = _a
-            # reg_grow cast to the PHASE dtype: an f64 scalar would
-            # promote the f32 carry's regs lane out of its while_loop
-            # carry type.
-            return _batched_segment_jit(
-                Ax, dx, c, jnp.asarray(stop, jnp.int32), mi, mr,
-                rg.astype(Ax.dtype), pp, ff, w, ws, A32x, ci,
-                cgt if ci else 0.0,
-            )
+        def mk_run_seg(Ax, dx, A32x, _p=(p, f, win, wstat, pcgi)):
+            pp, ff, w, ws, ci = _p
+
+            def run_seg(c, stop):
+                # reg_grow cast to the PHASE dtype: an f64 scalar would
+                # promote the f32 carry's regs lane out of its
+                # while_loop carry type.
+                return _batched_segment_jit(
+                    Ax, dx, c, jnp.asarray(stop, jnp.int32), mi, mr,
+                    rg.astype(Ax.dtype), pp, ff, w, ws, A32x, ci,
+                    cgt if ci else 0.0,
+                )
+
+            return run_seg
 
         # Batch-level stall/status live per problem inside the device loop;
         # the driver only watches the all-settled predicate (window 0).
-        carry, _ = core.drive_segments(
-            run_seg, carry, cfg.max_iter, 0, seg,
-            early_stop=(
-                (
-                    lambda it, status, n_active, n_unfinished: 0
-                    < n_active
-                    <= tail
-                    and n_unfinished <= cleanup_cap
-                )
-                if final and tail
-                else None
-            ),
-        )
+        if final and compact_ok and B >= 2 * _COMPACT_FLOOR:
+            carry = _drive_compacting(
+                mk_run_seg, carry, Ap, datap, A32p, cfg, seg, B, tail,
+                cleanup_cap, dtype,
+            )
+        else:
+            carry, _ = core.drive_segments(
+                mk_run_seg(Ap, datap, A32p), carry, cfg.max_iter, 0, seg,
+                early_stop=(
+                    (
+                        lambda it, status, n_active, n_unfinished: 0
+                        < n_active
+                        <= tail
+                        and n_unfinished <= cleanup_cap
+                    )
+                    if final and tail
+                    else None
+                ),
+            )
         phase_report.append({
             "phase": pi,
             "mode": ("f32-state" if f32_state
@@ -535,6 +597,93 @@ def _solve_batched_segmented(
     status = jnp.where(status == _RUNNING, _MAXITER, status)
     pinf, dinf, rel_gap, pobj = _batched_norms_jit(A, data, states, fname)
     return states, status, iters, pinf, dinf, rel_gap, pobj, phase_report
+
+
+def _drive_compacting(
+    mk_run_seg, carry, A, data, A32, cfg, seg, B, tail, cleanup_cap, dtype
+):
+    """Final-phase segment drive with program compaction (see
+    _solve_batched_segmented). Returns a FULL-SIZE carry whose states /
+    status / iters lanes hold every member's final values (the only
+    lanes the caller consumes after the final phase)."""
+    states_out = jax.tree_util.tree_map(
+        lambda v: jnp.zeros((B + 1,) + v.shape[1:], v.dtype), carry[0]
+    )
+    status_out = jnp.full(B + 1, _OPTIMAL, jnp.int32)
+    iters_out = jnp.zeros(B + 1, jnp.int32)
+    order = jnp.arange(B)
+    size = B
+    out_nonopt = 0  # non-optimal members already scattered out
+    it_g, status_g = 0, core.STATUS_RUNNING
+    run_seg = mk_run_seg(A, data, A32)
+    while True:
+        def early(it, status, n_active, n_unfinished, _size=size,
+                  _out=out_nonopt):
+            if (
+                tail
+                and 0 < n_active <= max(1, _size // 32)
+                and n_unfinished + _out <= cleanup_cap
+            ):
+                return True
+            return _size > _COMPACT_FLOOR and n_active <= _size // 2
+
+        prev_it = it_g
+        # Short segments (4 s target, ≤8 iterations) keep boundaries —
+        # the only points compaction can act — frequent; the ~0.1 s
+        # meta fetch per segment is noise against the step cost.
+        carry, (it_g, status_g, n_act, n_unf) = core.drive_segments(
+            run_seg, carry, cfg.max_iter, 0, min(seg, 8), target_s=4.0,
+            early_stop=early, it0_status0=(it_g, status_g), seg_cap=8,
+        )
+        n_act, n_unf = int(n_act), int(n_unf)
+        if (
+            status_g != core.STATUS_RUNNING
+            or it_g >= cfg.max_iter
+            or n_act == 0
+            or (
+                tail
+                and n_act <= max(1, size // 32)
+                and n_unf + out_nonopt <= cleanup_cap
+            )
+            or size <= _COMPACT_FLOOR
+            or it_g == prev_it  # spin guard: drive made no progress
+        ):
+            break
+        # Shrink: gather actives into the smallest half-size that fits.
+        act = np.asarray(carry[1])
+        stat_host = np.asarray(carry[5])
+        keep = np.flatnonzero(act)
+        new_size = size // 2
+        while new_size > _COMPACT_FLOOR and len(keep) <= new_size // 2:
+            new_size //= 2
+        if len(keep) > new_size:
+            break  # defensive: actives cannot exceed the early trigger
+        out_nonopt += int(np.sum(~act & (stat_host != _OPTIMAL)))
+        states_out, status_out, iters_out = _scatter_out(
+            (states_out, status_out, iters_out), order, carry
+        )
+        carry, order, sel = _compact_gather(carry, order, keep, new_size, B)
+        A = A[sel]
+        A32 = A32[sel] if A32 is not None else None
+        data = jax.tree_util.tree_map(lambda v: v[sel], data)
+        size = new_size
+        run_seg = mk_run_seg(A, data, A32)
+    states_out, status_out, iters_out = _scatter_out(
+        (states_out, status_out, iters_out), order, carry
+    )
+    states = jax.tree_util.tree_map(lambda v: v[:B], states_out)
+    zi = jnp.zeros(B, jnp.int32)
+    return (
+        states,
+        jnp.zeros(B, bool),
+        carry[2],
+        jnp.full(B, cfg.reg_dual, dtype),
+        zi,
+        status_out[:B],
+        iters_out[:B],
+        jnp.full(B, jnp.inf, dtype),
+        zi,
+    )
 
 
 def member_interior_form(batch: BatchedLP, i: int):
@@ -691,7 +840,8 @@ def solve_batched(
     if seg:
         (states, status, iters, pinf, dinf, rel_gap, pobj,
          phase_report) = _solve_batched_segmented(
-            A, data, cfg, params, params_p1, fname, two_phase, seg, cg
+            A, data, cfg, params, params_p1, fname, two_phase, seg, cg,
+            compact_ok=mesh is None,
         )
     else:
         states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_jit(

@@ -198,8 +198,35 @@ def _ew_block(t: "BlockTensors") -> bool:
     )
 
 
-def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
-    """LinOps over the arrow structure (shared-core seam)."""
+def _chol_mxu_here(dtype) -> bool:
+    """Shared routing predicate (defined in dense.py so the env override
+    and platform rule cannot diverge between backends)."""
+    from distributedlpsolver_tpu.backends.dense import _use_chol_mxu
+
+    return _use_chol_mxu(dtype)
+
+
+def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False):
+    """LinOps over the arrow structure (shared-core seam).
+
+    ``gram_s`` switches the linking Schur complement's assembly to the
+    cancellation-free GRAM form — the fix for the diagnosed f32 floor
+    (SCALE_RUNS round-4 utilization_analysis: direct f32
+    ``S = MLL − Σ Gₖ Mₖₖ⁻¹ Gₖᵀ`` subtracts two near-equal PSD matrices,
+    so S's relative error grows as ε₃₂·‖MLL‖/‖S‖ and the f32 phases die
+    at err ≈ 2e-2, handing 19 of 31 iterations to the 3.3 s/iter f64c
+    finisher). Algebra: with weighted tensors ``Bw = B·D^½``,
+    ``Lw = L·D^½`` and ``Cₖ = Lₖ⁻¹Bw`` (so ``CₖCₖᵀ = I`` exactly),
+
+        S = Σₖ Zₖ Zₖᵀ,   Zₖ = Lw − (Cₖᵀ·(Cₖ·Lwᵀ))ᵀ
+
+    Z is formed EXPLICITLY — the cancellation lands in Z's entries,
+    which sit at the square root of S's scale, so only half the digits
+    are lost — and Z·Zᵀ is a clean positive Gram product. Error drops
+    from ε₃₂·(‖MLL‖/‖S‖) to ~ε₃₂·√(‖MLL‖/‖S‖): at a 1e10 scale ratio
+    that is 6e-3 instead of garbage. Intended for the f32 phase-1 /
+    preconditioner instances (the f64 direct path keeps the one-GEMM
+    difference form — ε₆₄ absorbs the cancellation there)."""
     K, mb, nb, link, n0, n, m = lay
     ew = _ew_block(t)
 
@@ -237,7 +264,42 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
             out = out.at[t.border_idx].add(t.A0.T @ yL)
         return out
 
+    # f64 factorizations on TPU route through the GEMM-dominated panel
+    # factor+inverse (ops/chol_mxu.py): the builtin emulated-f64
+    # cholesky/cho_solve lower to scalarized recurrences ~10× slower
+    # (measured, scripts/probe_chol_mxu.py). Inverse-based factors turn
+    # every solve into batched GEMVs; the f32 instances (phase-1 /
+    # preconditioner ops) keep the fast native builtins, and the gram
+    # factorize returns plain cholesky factors, never inverses.
+    use_mxu = _chol_mxu_here(t.B_all.dtype) and not gram_s
+
+    def factorize_gram(d):
+        dB = pad(d)[t.col_idx]  # (K, nb); padded cols get d=0, sq=0
+        sq = jnp.sqrt(dB)
+        Bw = t.B_all * sq[:, None, :]  # (K, mb, nb)
+        Lw = t.L_all * sq[:, None, :]  # (K, link, nb)
+        Mkk = jnp.einsum("kmn,kpn->kmp", Bw, Bw)
+        pad_diag = (t.row_idx == m).astype(Mkk.dtype)
+        Mkk = Mkk + jnp.zeros_like(Mkk).at[
+            :, jnp.arange(mb), jnp.arange(mb)
+        ].set(pad_diag)
+        Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg))
+        Ck = jax.scipy.linalg.solve_triangular(Lk, Bw, lower=True)
+        Uk = jnp.einsum("kmn,kln->kml", Ck, Lw)  # (K, mb, link)
+        Zk = Lw - jnp.einsum("kml,kmn->kln", Uk, Ck)
+        S = jnp.einsum("kln,kpn->lp", Zk, Zk)
+        if n0:
+            # Border columns touch only linking rows — a pure Gram
+            # addition, no block coupling to cancel against.
+            A0w = t.A0 * jnp.sqrt(d[t.border_idx])[None, :]
+            S = S + A0w @ A0w.T
+        Gk = jnp.einsum("kln,kmn->klm", Lw, Bw)  # = L·D·Bᵀ (sq·sq = dB)
+        Ls = jnp.linalg.cholesky(_rel_diag_reg(S, reg))
+        return Lk, Ls, Gk
+
     def factorize(d):
+        if gram_s:
+            return factorize_gram(d)
         dB = pad(d)[t.col_idx]  # (K, nb); padded cols get d=0
         Bd = t.B_all * dB[:, None, :]
         Mkk = jnp.einsum("kmn,kpn->kmp", Bd, t.B_all)
@@ -249,10 +311,19 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
         Mkk = Mkk + jnp.zeros_like(Mkk).at[
             :, jnp.arange(mb), jnp.arange(mb)
         ].set(pad_diag)
-        Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg))
         Gk = jnp.einsum("kln,kmn->klm", t.L_all * dB[:, None, :], t.B_all)
-        # H_k = M_kk⁻¹ G_kᵀ (batched two-triangular-solve), (K, mb, link)
-        Hk = jax.scipy.linalg.cho_solve((Lk, True), jnp.swapaxes(Gk, 1, 2))
+        if use_mxu:
+            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
+
+            Lki = jax.vmap(chol_inv_mxu)(_rel_diag_reg(Mkk, reg))
+            # H_k = M_kk⁻¹ G_kᵀ via two batched GEMMs with Lk⁻¹
+            Hk = jnp.einsum(
+                "kpm,kpl->kml", Lki, jnp.einsum("kmp,klp->kml", Lki, Gk)
+            )
+        else:
+            Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg))
+            # H_k = M_kk⁻¹ G_kᵀ (batched two-triangular-solve), (K, mb, link)
+            Hk = jax.scipy.linalg.cho_solve((Lk, True), jnp.swapaxes(Gk, 1, 2))
         # Contract K INSIDE the einsum: the two-step form
         # einsum("kln,kpn->klp").sum(0) materializes a (K, link, link)
         # intermediate — 10.5 GB in f64 at the pds-20 class (K=64,
@@ -269,18 +340,32 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype):
         # reference's MPI_Allreduce of Schur blocks (BASELINE.json:5) —
         # an XLA all-reduce when the K axis is mesh-sharded.
         S = MLL - jnp.einsum("klm,kmp->lp", Gk, Hk)
-        Ls = jnp.linalg.cholesky(_rel_diag_reg(S, reg))
-        return Lk, Ls, Gk
+        if use_mxu:
+            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
+
+            return Lki, chol_inv_mxu(_rel_diag_reg(S, reg)), Gk
+        return Lk, jnp.linalg.cholesky(_rel_diag_reg(S, reg)), Gk
 
     def solve(factors, r):
         Lk, Ls, Gk = factors
         rb = pad(r)[t.row_idx]  # (K, mb); padded rows read 0
         rL = r[t.link_idx]
-        tmp = jax.scipy.linalg.cho_solve((Lk, True), rb[..., None])[..., 0]
+        if use_mxu:
+            # factors hold EXPLICIT inverses: every solve is GEMVs.
+            blk = lambda v: jnp.einsum(
+                "kpm,kp->km", Lk, jnp.einsum("kmp,kp->km", Lk, v)
+            )
+            lnk = lambda v: Ls.T @ (Ls @ v)
+        else:
+            blk = lambda v: jax.scipy.linalg.cho_solve(
+                (Lk, True), v[..., None]
+            )[..., 0]
+            lnk = lambda v: jax.scipy.linalg.cho_solve((Ls, True), v)
+        tmp = blk(rb)
         rS = rL - jnp.einsum("klm,km->l", Gk, tmp)
-        yL = jax.scipy.linalg.cho_solve((Ls, True), rS)
+        yL = lnk(rS)
         rb2 = rb - jnp.einsum("klm,l->km", Gk, yL)
-        yb = jax.scipy.linalg.cho_solve((Lk, True), rb2[..., None])[..., 0]
+        yb = blk(rb2)
         out = jnp.zeros(m + 1, dtype=r.dtype).at[t.row_idx].add(yb)
         return out.at[t.link_idx].add(yL)[:m]
 
@@ -298,7 +383,10 @@ def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout,
     f64."""
     base = _block_ops(t64, lay, reg, None)
     f32 = jnp.float32
-    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None)
+    # Gram-form S (see _block_ops): keeps the f32 phase's factor quality
+    # from collapsing to the ε₃₂·‖MLL‖/‖S‖ cancellation floor, so phase 1
+    # carries iterations the f64 finisher otherwise owns.
+    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None, gram_s=True)
 
     def factorize(d):
         return ops32.factorize(d.astype(f32))
@@ -358,6 +446,7 @@ def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg,
         chunk = max(128, int(_F64C_TEMP_BUDGET / (32.0 * K * (link + mb))))
     chunk = min(chunk, nb)  # small shapes: fori body must trace in-bounds
     base = _block_ops(t, lay, reg, None)  # ew-f64 mat/rmatvec shared
+    use_mxu = _chol_mxu_here(t.B_all.dtype)
 
     def factorize(d):
         dB = jnp.concatenate([d, jnp.zeros(1, d.dtype)])[t.col_idx]
@@ -408,20 +497,35 @@ def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg,
         Mkk = Mkk + jnp.zeros_like(Mkk).at[
             :, jnp.arange(mb), jnp.arange(mb)
         ].set(pad_diag)
-        Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg))
-        # Explicit batched inverse of the small per-block factors: the
-        # link-many-rhs TRSM this replaces is exactly the lowering that
-        # blows temps; GEMVs against Lk⁻¹ are clean batched dots.
-        eye_b = jnp.broadcast_to(jnp.eye(mb, dtype=dt), (K, mb, mb))
-        Lki = jax.scipy.linalg.solve_triangular(Lk, eye_b, lower=True)
+        # Explicit inverse factors: the link-many-rhs TRSM these replace
+        # is exactly the lowering that blows temps; GEMVs against Lk⁻¹
+        # are clean batched dots. On TPU the factor+inverse itself runs
+        # through the GEMM-dominated panel kernel (ops/chol_mxu.py) —
+        # XLA's emulated-f64 cholesky/solve_triangular lower to
+        # scalarized recurrences ~10× slower (measured, probe_chol_mxu).
+        if use_mxu:
+            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
+
+            Lki = jax.vmap(chol_inv_mxu)(_rel_diag_reg(Mkk, reg))
+        else:
+            eye_b = jnp.broadcast_to(jnp.eye(mb, dtype=dt), (K, mb, mb))
+            Lki = jax.scipy.linalg.solve_triangular(
+                jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg)), eye_b,
+                lower=True,
+            )
         # H_k = M_kk⁻¹ G_kᵀ via two batched GEMMs with Lk⁻¹
         tmp = jnp.einsum("kmp,klp->kml", Lki, Gk)  # Lk⁻¹ Gkᵀ
         Hk = jnp.einsum("kpm,kpl->kml", Lki, tmp)  # Lk⁻ᵀ (…)
         S = MLL - jnp.einsum("klm,kmp->lp", Gk, Hk)
-        Ls = jnp.linalg.cholesky(_rel_diag_reg(S, reg))
-        Lsi = jax.scipy.linalg.solve_triangular(
-            Ls, jnp.eye(link, dtype=dt), lower=True
-        )
+        if use_mxu:
+            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
+
+            Lsi = chol_inv_mxu(_rel_diag_reg(S, reg))
+        else:
+            Lsi = jax.scipy.linalg.solve_triangular(
+                jnp.linalg.cholesky(_rel_diag_reg(S, reg)),
+                jnp.eye(link, dtype=dt), lower=True,
+            )
         return Lki, Lsi, Gk
 
     def solve(factors, r):
@@ -475,7 +579,11 @@ def _block_pcg_ops(t64, t32, lay, reg, cg_tol, cg_iters):
     Same design as dense._pcg_ops; shares core.pcg_solve."""
     base = _block_ops(t64, lay, reg, None)
     f32 = jnp.float32
-    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None)
+    # Gram-form S for the preconditioner too (same rationale as
+    # _block_ops_mixed): the round-4 run's PCG phase executed ZERO
+    # iterations because its f32-assembled S was cancellation garbage
+    # by handoff time.
+    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None, gram_s=True)
 
     def factorize(d):
         factors32 = ops32.factorize(d.astype(f32))
@@ -502,15 +610,26 @@ def _block_pcg_ops(t64, t32, lay, reg, cg_tol, cg_iters):
     )
 
 
+def _ops_for(mode, tensors, tensors32, lay, reg, cg_iters=0, cg_tol=0.0):
+    """One mode→LinOps map shared by the per-call entry points and the
+    segment driver ("direct" | "f64c" | "mixed" | "pcg")."""
+    if mode == "pcg":
+        return _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+    if mode == "f64c":
+        return _block_ops_f64c(tensors, lay, reg)
+    if mode == "mixed":
+        return _block_ops_mixed(tensors, tensors32, lay, reg)
+    return _block_ops(tensors, lay, reg, None)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("lay", "params", "cg_iters", "cg_tol")
+    jax.jit, static_argnames=("lay", "params", "cg_iters", "cg_tol", "mode")
 )
 def _block_step(tensors, lay, data, state, reg, params, tensors32=None,
-                cg_iters=0, cg_tol=0.0):
-    if cg_iters > 0:
-        ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
-    else:
-        ops = _block_ops(tensors, lay, reg, None)
+                cg_iters=0, cg_tol=0.0, mode="direct"):
+    if mode == "direct" and cg_iters > 0:
+        mode = "pcg"
+    ops = _ops_for(mode, tensors, tensors32, lay, reg, cg_iters, cg_tol)
     return core.mehrotra_step(ops, data, params, state)
 
 
@@ -598,14 +717,13 @@ def _block_solve_two_phase(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lay", "params", "cg_iters", "cg_tol")
+    jax.jit, static_argnames=("lay", "params", "cg_iters", "cg_tol", "mode")
 )
 def _block_start(tensors, lay, data, reg, params, tensors32=None,
-                 cg_iters=0, cg_tol=0.0):
-    if cg_iters > 0:
-        ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
-    else:
-        ops = _block_ops(tensors, lay, reg, None)
+                 cg_iters=0, cg_tol=0.0, mode="direct"):
+    if mode == "direct" and cg_iters > 0:
+        mode = "pcg"
+    ops = _ops_for(mode, tensors, tensors32, lay, reg, cg_iters, cg_tol)
     return core.starting_point(ops, data, params)
 
 
@@ -694,37 +812,52 @@ class BlockAngularBackend(SolverBackend):
         self._f64_flops = K * (2.0 * mb * mb * nb + mb**3 / 3.0) + (
             2.0 * link * link * (K * nb + n0) + link**3 / 3.0
         )
-        if config.solve_mode == "pcg":
-            self._pcg = True
-        elif config.solve_mode is None:
-            self._pcg = (
-                self._two_phase and self._f64_flops >= 2e11
-            )
-        else:
-            self._pcg = False
+        # PCG only on explicit request — it is OFF in the auto plan
+        # (round-5 measurement at the pds-20 class): the CG operator
+        # here is the elementwise-f64 matvec pair over the padded block
+        # tensors (~0.35 s per application at K=64·nb=1300·link=1600),
+        # so ONE PCG iteration cost 37.5 s against the chunked-f64
+        # direct finisher's 3.4 s — and with the gram-form f32 phase
+        # carrying the early orders, the preconditioner's edge never
+        # pays for its matvecs.
+        self._pcg = config.solve_mode == "pcg"
         self._cg_iters = config.cg_iters if self._pcg else 0
         self._cg_tol = config.cg_tol if self._pcg else 0.0
+        # Above this operand-split budget the one-shot f64 assembly is
+        # un-lowerable on TPU (8×-f32 split temps of the full tensors —
+        # observed 3.91 G for one Gk einsum at the pds-20 class); every
+        # full-precision entry point must then take the n-chunked f64c
+        # route, INCLUDING the starting point and per-iteration path.
+        self._huge_f64 = (
+            dtype == jnp.float64
+            and jax.default_backend() == "tpu"
+            and 32.0 * (K * link * nb + K * mb * nb) > _F64_SPLIT_BUDGET
+        )
 
     def _point_args(self):
-        """(tensors32, cg_iters, cg_tol) for the per-call entry points."""
+        """(tensors32, cg_iters, cg_tol, mode) for per-call entry points."""
         if self._pcg:
-            return self._get_tensors32(), self._cg_iters, self._cg_tol
-        return None, 0, 0.0
+            return self._get_tensors32(), self._cg_iters, self._cg_tol, "pcg"
+        if self._huge_f64:
+            return None, 0, 0.0, "f64c"
+        return None, 0, 0.0, "direct"
 
     def starting_point(self) -> IPMState:
-        t32, cgi, cgt = self._point_args()
+        t32, cgi, cgt, mode = self._point_args()
         st = _block_start(
             self._tensors, self._lay, self._data,
             jnp.asarray(self._reg, self._dtype), self._params, t32, cgi, cgt,
+            mode,
         )
         jax.block_until_ready(st)
         return st
 
     def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
-        t32, cgi, cgt = self._point_args()
+        t32, cgi, cgt, mode = self._point_args()
         return _block_step(
             self._tensors, self._lay, self._data, state,
             jnp.asarray(self._reg, self._dtype), self._params, t32, cgi, cgt,
+            mode,
         )
 
     def bump_regularization(self) -> bool:
@@ -765,16 +898,11 @@ class BlockAngularBackend(SolverBackend):
         # shapes on TPU: XLA's emulated-f64 dot_generals materialize
         # 8×-f32 operand-split temps of the full (K, link, nb) /
         # (K, mb, nb) tensors (observed OOM at pds-20 scale: 19.4 G
-        # needed of 15.75 G). Above that budget the full-precision
-        # phase runs n-CHUNKED ("f64c", the block analogue of the dense
-        # endgame) — same f64 arithmetic, bounded per-chunk temps.
-        split_bytes = 32.0 * (K * link * nb + K * mb * nb)
-        huge_f64 = (
-            self._dtype == jnp.float64
-            and jax.default_backend() == "tpu"
-            and split_bytes > _F64_SPLIT_BUDGET
-        )
-        finish_mode = "f64c" if huge_f64 else "f64"
+        # needed of 15.75 G). Above that budget (setup-computed
+        # self._huge_f64) the full-precision phase runs n-CHUNKED
+        # ("f64c", the block analogue of the dense endgame) — same f64
+        # arithmetic, bounded per-chunk temps.
+        finish_mode = "f64c" if self._huge_f64 else "f64"
         full_mode = "pcg" if self._pcg else finish_mode
         full_t32 = self._get_tensors32() if full_mode == "pcg" else None
         if self._two_phase:
@@ -856,16 +984,10 @@ class BlockAngularBackend(SolverBackend):
         # f64 shapes route there too regardless of segment settings —
         # the fused one-shot programs would hit the operand-split OOM
         # the segmented plan's "f64c" mode exists to avoid.
-        K, mb, nb, link, n0, n, m = self._lay
-        huge_f64 = (
-            self._dtype == jnp.float64
-            and jax.default_backend() == "tpu"
-            and 32.0 * (K * link * nb + K * mb * nb) > _F64_SPLIT_BUDGET
-        )
         if (
             core.use_segments(self._cfg.segment_iters, jax.default_backend())
             or (self._pcg and self._two_phase)
-            or huge_f64
+            or self._huge_f64
         ):
             return self._solve_segmented(state)
         if self._pcg and not self._two_phase:

@@ -772,7 +772,10 @@ def _use_chol_mxu(factor_dtype) -> bool:
     factor+inverse (ops/chol_mxu.py). Auto: exactly on TPU, where the
     builtin emulated-f64 cholesky is ~10× slower (measured) — CPU/LAPACK
     paths are left alone. TPULP_CHOL_MXU=1/0 overrides (tests exercise
-    the kernel on the CPU mesh with it)."""
+    the kernel on the CPU mesh with it). The flag is read at TRACE time
+    and is not part of any jit cache key: set it at process start (or
+    jax.clear_caches() after changing it) — flipping it mid-process
+    leaves already-compiled shapes on their old route."""
     import os
 
     if jnp.dtype(factor_dtype) != jnp.dtype(jnp.float64):

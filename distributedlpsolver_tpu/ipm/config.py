@@ -159,10 +159,15 @@ class SolverConfig:
         """Step params of the two-phase f32 phase: tol loosened to the
         handoff tolerance (single source of the handoff rule — the
         loosened tol also keys the μ-floor that keeps the handoff iterate
-        centered)."""
-        return self.replace(tol=max(self.tol, self.phase1_tol)).step_params()
+        centered), plus the μ-vs-pinf balance floor — an f32 phase's
+        directions bound how fast pinf can fall, and letting μ race
+        orders of magnitude below that bound hands the full-precision
+        phase an injured iterate (StepParams.mu_pinf_floor)."""
+        return self.replace(tol=max(self.tol, self.phase1_tol)).step_params(
+            mu_pinf_floor=0.03
+        )
 
-    def step_params(self) -> "StepParams":
+    def step_params(self, mu_pinf_floor: float = 0.0) -> "StepParams":
         return StepParams(
             tol=self.tol,
             eta=self.eta,
@@ -172,6 +177,7 @@ class SolverConfig:
             gamma_cent=self.gamma_cent,
             reg_primal=self.reg_primal,
             kkt_refine=self.kkt_refine,
+            mu_pinf_floor=mu_pinf_floor,
         )
 
 
@@ -199,3 +205,12 @@ class StepParams:
     # centering direction is admissible by construction and restores the
     # step room the next Mehrotra iteration needs.
     center: bool = False
+    # μ-vs-feasibility balance floor (0 disables): keep the centering
+    # target μ ≥ this · pinf_rel · (1+|pobj|)/ncomp, so complementarity
+    # cannot run arbitrarily far below the remaining primal
+    # infeasibility. Exists for LIMITED-PRECISION phases: the gram-form
+    # f32 block phase drove rel_gap to 2e-4 while its f32 directions
+    # floored pinf at 3e-3 (μ ~1e5× below pinf) — an injured iterate
+    # the f64 finisher could not repair and the divergence heuristic
+    # misread as PRIMAL_INFEASIBLE (observed, pds-20-class 2026-08-01).
+    mu_pinf_floor: float = 0.0

@@ -332,6 +332,16 @@ def mehrotra_step(
     # keeps a safe 30× margin below the gap test.
     pobj_now = c @ x
     mu_floor = 0.03 * cfg.tol * (1.0 + xp.abs(pobj_now)) / data.ncomp
+    if cfg.mu_pinf_floor:
+        # Balance floor for limited-precision phases (StepParams
+        # docstring): μ may trail the remaining primal infeasibility by
+        # at most 1/mu_pinf_floor — same unit construction as the tol
+        # floor, with pinf_rel in tol's place.
+        pinf_now = xp.sqrt(xp.sum(r_p * r_p) + xp.sum(r_u * r_u)) / data.norm_b
+        mu_floor = xp.maximum(
+            mu_floor,
+            cfg.mu_pinf_floor * pinf_now * (1.0 + xp.abs(pobj_now)) / data.ncomp,
+        )
 
     if cfg.center:
         # Pure centering step (StepParams.center): one KKT solve aiming
@@ -452,7 +462,14 @@ def classify_divergence(mu, pinf, dinf, rel_gap, pobj, dobj):
     # primal-dive leg mid-solve while the dual still lags near zero.
     scale_p = 1.0 + abs(pobj)
     scale_d = 1.0 + abs(dobj)
-    pinfeas = ((mu < 1e-8 * scale_p) & (pinf > 1e-3)) | (
+    # μ-converged threshold 1e-11·scale, NOT 1e-8: μ is per-pair, so on
+    # a large problem 1e-8·scale still describes a mid-solve iterate —
+    # observed at the pds-20 class (ncomp≈1.2e5): μ=2.4e-4 < 1e-8·scale
+    # with rel_gap still 6e-4 fired a false PRIMAL_INFEASIBLE one
+    # iteration into the f64 finisher. A rel_gap conjunct cannot fix it
+    # (a genuine Farkas point has HUGE rel_gap — the dual runs away);
+    # the real Farkas signature sits orders lower (μ/scale ~1e-13).
+    pinfeas = ((mu < 1e-11 * scale_p) & (pinf > 1e-3)) | (
         dobj > 1e12 * scale_p
     )
     dinfeas = ((dinf > 1e-3) & (pobj < -1e8 * scale_d) & (rel_gap > 0.99)) | (
@@ -639,7 +656,7 @@ def seg_trace_enabled() -> bool:
 def drive_segments(
     run_seg, carry0, max_iter, stall_window, seg_init=16, target_s=15.0,
     stall_patience_floor=0.0, it0_status0=(0, STATUS_RUNNING),
-    early_stop=None,
+    early_stop=None, seg_cap=256,
 ):
     """Host loop over bounded fused-solve segments.
 
@@ -705,7 +722,9 @@ def drive_segments(
         if not first:  # first call's wall time includes compile — don't adapt
             # Jump straight to the measured rate (dt is clean post-compile);
             # the cap keeps one segment well under the watchdog either way.
-            seg = max(1, min(256, int(seg * target_s / max(dt, 1e-3))))
+            # ``seg_cap`` lets callers that act at segment boundaries
+            # (the batched compaction drive) keep boundaries frequent.
+            seg = max(1, min(seg_cap, int(seg * target_s / max(dt, 1e-3))))
         first = False
     return carry, (it, status, best_err, since)
 

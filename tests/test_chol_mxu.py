@@ -76,7 +76,13 @@ def test_dense_backend_through_mxu_route(monkeypatch):
     p = random_dense_lp(24, 60, seed=7)
     monkeypatch.setenv("TPULP_CHOL_MXU", "0")
     r0 = solve(p, backend="tpu")
+    # The override is read at TRACE time; without clearing the jit cache
+    # the second solve is a pure cache hit of the first (same shapes,
+    # same static args) and the MXU route never traces — verified by
+    # instrumentation (round-5 review finding).
+    jax.clear_caches()
     monkeypatch.setenv("TPULP_CHOL_MXU", "1")
     r1 = solve(p, backend="tpu")
+    jax.clear_caches()  # don't leak mxu-route executables to other tests
     assert r0.status.value == "optimal" and r1.status.value == "optimal"
     np.testing.assert_allclose(r1.objective, r0.objective, rtol=1e-8)
