@@ -258,32 +258,63 @@ def _bench_batched(quick: bool):
         "vs_baseline": None,
     }
     try:
-        sample = min(16, B) if quick else min(128, B)
-        rng = __import__("numpy").random.default_rng(7)
-        idx = rng.choice(B, size=sample, replace=False)
-        probs = [batch.problem(int(i)) for i in idx]
-        _solve_timed(probs[0], "cpu-native")  # warm any lazy init
-        t0 = time.perf_counter()
-        base_ok = 0
-        for p in probs:
-            rb = _solve_timed(p, "cpu-native")
-            base_ok += rb.status.value == "optimal"
-        t_sample = time.perf_counter() - t0
-        per = t_sample / sample
-        est = per * B
-        row.update(
-            baseline_backend="cpu-native (loop, one LP at a time)",
-            baseline_sample=sample,
-            baseline_sample_optimal=base_ok,
-            baseline_per_problem_s=round(per, 4),
-            baseline_time_est_s=round(est, 2),
-            vs_baseline=round(est / max(res.solve_time, 1e-9), 2),
-        )
-        _log(
-            f"  baseline cpu-native loop: {sample} sampled, "
-            f"{per:.3f}s/problem -> est {est:.1f}s for {B} "
-            f"({row['vs_baseline']}x)"
-        )
+        # MEASURED full-loop baseline first (VERDICT round-4 item 1: no
+        # sampling/extrapolation): scripts/run_batched_cpu_loop.py solves
+        # all 1024 members one at a time through cpu-native on a quiet
+        # host and records the artifact consumed here. Falls back to the
+        # sampled estimate only when the artifact is absent or doesn't
+        # match this row's config.
+        import json as _json
+
+        loop_art = os.path.join(_REPO, ".batched_cpu_loop.json")
+        used_artifact = False
+        if not quick and os.path.exists(loop_art):
+            art = _json.load(open(loop_art))
+            # the full config string must match — B alone would accept a
+            # stale artifact measured on a different shape/seed
+            expected_cfg = f"{B} x ({m}x{n}) seed=0 looped cpu-native"
+            if art.get("config") == expected_cfg and art.get("n_optimal", 0) == B:
+                base_s = art["sum_solve_s"]  # per-solve sum: contention-free
+                row.update(
+                    baseline_backend="cpu-native (loop, one LP at a time)",
+                    baseline_sample=B,
+                    baseline_measured_full_loop=True,
+                    baseline_time_s=base_s,
+                    baseline_artifact=".batched_cpu_loop.json",
+                    vs_baseline=round(base_s / max(res.solve_time, 1e-9), 2),
+                )
+                _log(
+                    f"  baseline cpu-native loop (MEASURED, all {B}): "
+                    f"{base_s:.1f}s ({row['vs_baseline']}x)"
+                )
+                used_artifact = True
+        if not used_artifact:
+            sample = min(16, B) if quick else min(128, B)
+            rng = __import__("numpy").random.default_rng(7)
+            idx = rng.choice(B, size=sample, replace=False)
+            probs = [batch.problem(int(i)) for i in idx]
+            _solve_timed(probs[0], "cpu-native")  # warm any lazy init
+            t0 = time.perf_counter()
+            base_ok = 0
+            for p in probs:
+                rb = _solve_timed(p, "cpu-native")
+                base_ok += rb.status.value == "optimal"
+            t_sample = time.perf_counter() - t0
+            per = t_sample / sample
+            est = per * B
+            row.update(
+                baseline_backend="cpu-native (loop, one LP at a time)",
+                baseline_sample=sample,
+                baseline_sample_optimal=base_ok,
+                baseline_per_problem_s=round(per, 4),
+                baseline_time_est_s=round(est, 2),
+                vs_baseline=round(est / max(res.solve_time, 1e-9), 2),
+            )
+            _log(
+                f"  baseline cpu-native loop: {sample} sampled, "
+                f"{per:.3f}s/problem -> est {est:.1f}s for {B} "
+                f"({row['vs_baseline']}x)"
+            )
     except Exception as e:  # baseline must never sink the bench
         _log(f"  batched baseline failed: {e}")
     return row
@@ -453,6 +484,27 @@ def run_suite(args) -> list:
         row = _bench_one(sparse_lp, "cpu-sparse", "cpu")
     add(f"stormG2-like sparse block_angular{shape} (hint-less)", row)
 
+    # 4b. UNSTRUCTURED sparse (BASELINE.json:10, the neos3 half of the
+    # class): a uniformly random pattern defeats detection, and the
+    # measured routing decision (scripts/run_neos3.py) sends it to the
+    # sparse-direct host backend. The row exercises exactly that route
+    # through auto so a routing regression shows up as a changed
+    # backend name. No baseline: the only honest comparator would be
+    # the dense-LAPACK host path, whose m²n-per-iteration cost at this
+    # shape is hours — the cross-executor measurement at 1e-8 lives in
+    # scripts/run_neos3.py's artifact instead.
+    _log("[4b] unstructured sparse, detection-defeating (auto -> cpu-sparse)")
+    from distributedlpsolver_tpu.models.generators import random_sparse_lp
+
+    ushape = (400, 800, 0.01) if q else (8000, 16000, 0.001)
+    add(
+        f"neos3-like unstructured sparse {ushape[0]}x{ushape[1]}",
+        _bench_one(
+            random_sparse_lp(ushape[0], ushape[1], density=ushape[2], seed=0),
+            "auto", None,
+        ),
+    )
+
     # 5. Batched concurrent LPs (BASELINE.json:11).
     _log("[5/6] batched 1024x(128,512) vmap solve")
     add("batched 1024x(128x512)" if not q else "batched 32x(16x40)", _bench_batched(q))
@@ -501,8 +553,14 @@ def run_scale(args) -> list:
     # warm-up would compile a different (never reused) bucket and the
     # timed solve would pay the real compile inside its 3 s envelope.
     # _solve_timed: one tunnel drop must not crash the whole tier.
+    # Best-of-two like the suite rows (ADVICE round 4): the tunneled
+    # worker shows one-off ~8× slowness on warm programs, and a single
+    # sample against a 3 s envelope would fail the tier spuriously.
     _solve_timed(p, args.backend)
     r = _solve_timed(p, args.backend)
+    r2 = _solve_timed(p, args.backend)
+    if r2.solve_time < r.solve_time:
+        r = r2
     row = {
         "check": "dense_2048x10240",
         "status": r.status.value,
@@ -552,7 +610,12 @@ def run_scale(args) -> list:
         "rel_gap": float(r2.rel_gap),
         "pinf": float(r2.pinf),
         "dinf": float(r2.dinf),
-        "endgame_iters": len(getattr(be, "endgame_timings", [])),
+        # Accepted endgame iterations only — a raw row count would also
+        # count bad-step retry attempts (ADVICE round 4).
+        "endgame_iters": sum(
+            1 for t in getattr(be, "endgame_timings", [])
+            if "t_step" in t and not t.get("bad")
+        ),
         "envelope": {"status": "optimal", "pinf_max": 1e-12},
         "pass": bool(r2.status.value == "optimal" and r2.pinf <= 1e-12),
     }

@@ -843,6 +843,9 @@ def solve_batched(
             A, data, cfg, params, params_p1, fname, two_phase, seg, cg,
             compact_ok=mesh is None,
         )
+        # Same row shape chunked or not (the chunked path tags rows in
+        # _concat_results) — consumers never branch on chunking.
+        phase_report = [{**ph, "chunk": 0} for ph in phase_report]
     else:
         states, status, iters, pinf, dinf, rel_gap, pobj = _solve_batched_jit(
             A,

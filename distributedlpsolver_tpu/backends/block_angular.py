@@ -206,7 +206,8 @@ def _chol_mxu_here(dtype) -> bool:
     return _use_chol_mxu(dtype)
 
 
-def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False):
+def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False,
+               link_shard=None):
     """LinOps over the arrow structure (shared-core seam).
 
     ``gram_s`` switches the linking Schur complement's assembly to the
@@ -272,6 +273,27 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False):
     # preconditioner ops) keep the fast native builtins, and the gram
     # factorize returns plain cholesky factors, never inverses.
     use_mxu = _chol_mxu_here(t.B_all.dtype) and not gram_s
+    # ``link_shard`` (a NamedSharding, mesh runs only) distributes the
+    # link×link Schur factorization: chol_tri_inv_mesh never
+    # materializes a replicated factor, and its input constraint turns
+    # the K-contraction all-reduce into a reduce-scatter (VERDICT
+    # round-4 item 5/7 — the replicated linking factor was the
+    # per-device HBM floor at link=1600). Solves then apply the
+    # column-sharded L⁻¹ as two sharded GEMVs.
+    ls_inv = use_mxu or link_shard is not None
+
+    def _link_factor(S):
+        if link_shard is not None:
+            from distributedlpsolver_tpu.ops.dist_chol import (
+                chol_tri_inv_mesh,
+            )
+
+            return chol_tri_inv_mesh(_rel_diag_reg(S, reg), link_shard)
+        if use_mxu:
+            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
+
+            return chol_inv_mxu(_rel_diag_reg(S, reg))
+        return jnp.linalg.cholesky(_rel_diag_reg(S, reg))
 
     def factorize_gram(d):
         dB = pad(d)[t.col_idx]  # (K, nb); padded cols get d=0, sq=0
@@ -294,8 +316,7 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False):
             A0w = t.A0 * jnp.sqrt(d[t.border_idx])[None, :]
             S = S + A0w @ A0w.T
         Gk = jnp.einsum("kln,kmn->klm", Lw, Bw)  # = L·D·Bᵀ (sq·sq = dB)
-        Ls = jnp.linalg.cholesky(_rel_diag_reg(S, reg))
-        return Lk, Ls, Gk
+        return Lk, _link_factor(S), Gk
 
     def factorize(d):
         if gram_s:
@@ -341,10 +362,8 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False):
         # an XLA all-reduce when the K axis is mesh-sharded.
         S = MLL - jnp.einsum("klm,kmp->lp", Gk, Hk)
         if use_mxu:
-            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
-
-            return Lki, chol_inv_mxu(_rel_diag_reg(S, reg)), Gk
-        return Lk, jnp.linalg.cholesky(_rel_diag_reg(S, reg)), Gk
+            return Lki, _link_factor(S), Gk
+        return Lk, _link_factor(S), Gk
 
     def solve(factors, r):
         Lk, Ls, Gk = factors
@@ -355,11 +374,13 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False):
             blk = lambda v: jnp.einsum(
                 "kpm,kp->km", Lk, jnp.einsum("kmp,kp->km", Lk, v)
             )
-            lnk = lambda v: Ls.T @ (Ls @ v)
         else:
             blk = lambda v: jax.scipy.linalg.cho_solve(
                 (Lk, True), v[..., None]
             )[..., 0]
+        if ls_inv:
+            lnk = lambda v: Ls.T @ (Ls @ v)
+        else:
             lnk = lambda v: jax.scipy.linalg.cho_solve((Ls, True), v)
         tmp = blk(rb)
         rS = rL - jnp.einsum("klm,km->l", Gk, tmp)
@@ -375,7 +396,7 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False):
 
 
 def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout,
-                     reg):
+                     reg, link_shard=None):
     """Phase-1 LinOps: residual matvecs in full precision against the f64
     tensors, factorizations/solves through the f32 tensor stack on the MXU
     (the dense backend's two-phase split, restated for the arrow
@@ -386,7 +407,8 @@ def _block_ops_mixed(t64: BlockTensors, t32: BlockTensors, lay: BlockLayout,
     # Gram-form S (see _block_ops): keeps the f32 phase's factor quality
     # from collapsing to the ε₃₂·‖MLL‖/‖S‖ cancellation floor, so phase 1
     # carries iterations the f64 finisher otherwise owns.
-    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None, gram_s=True)
+    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None, gram_s=True,
+                       link_shard=link_shard)
 
     def factorize(d):
         return ops32.factorize(d.astype(f32))
@@ -419,7 +441,7 @@ _F64C_TEMP_BUDGET = 2e9
 
 
 def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg,
-                    chunk: Optional[int] = None):
+                    chunk: Optional[int] = None, link_shard=None):
     """Full-precision direct Schur LinOps for HUGE shapes (the block
     analogue of the dense endgame): the f64 assembly einsums run
     n-CHUNKED inside a fori_loop, so XLA's emulated-f64 dot_generals see
@@ -517,7 +539,13 @@ def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg,
         tmp = jnp.einsum("kmp,klp->kml", Lki, Gk)  # Lk⁻¹ Gkᵀ
         Hk = jnp.einsum("kpm,kpl->kml", Lki, tmp)  # Lk⁻ᵀ (…)
         S = MLL - jnp.einsum("klm,kmp->lp", Gk, Hk)
-        if use_mxu:
+        if link_shard is not None:
+            from distributedlpsolver_tpu.ops.dist_chol import (
+                chol_tri_inv_mesh,
+            )
+
+            Lsi = chol_tri_inv_mesh(_rel_diag_reg(S, reg), link_shard)
+        elif use_mxu:
             from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
 
             Lsi = chol_inv_mxu(_rel_diag_reg(S, reg))
@@ -569,7 +597,7 @@ def _block_diag_m(t: BlockTensors, lay: BlockLayout, d):
     return out.at[t.link_idx].add(diag_link)[:m]
 
 
-def _block_pcg_ops(t64, t32, lay, reg, cg_tol, cg_iters):
+def _block_pcg_ops(t64, t32, lay, reg, cg_tol, cg_iters, link_shard=None):
     """PCG LinOps for the arrow structure: the f32 Schur factorization
     (per-block Choleskys + linking-system Cholesky, all MXU work) is only
     a PRECONDITIONER; accuracy comes from CG whose operator applies
@@ -583,7 +611,8 @@ def _block_pcg_ops(t64, t32, lay, reg, cg_tol, cg_iters):
     # _block_ops_mixed): the round-4 run's PCG phase executed ZERO
     # iterations because its f32-assembled S was cancellation garbage
     # by handoff time.
-    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None, gram_s=True)
+    ops32 = _block_ops(t32, lay, jnp.asarray(reg, f32), None, gram_s=True,
+                       link_shard=link_shard)
 
     def factorize(d):
         factors32 = ops32.factorize(d.astype(f32))
@@ -610,26 +639,31 @@ def _block_pcg_ops(t64, t32, lay, reg, cg_tol, cg_iters):
     )
 
 
-def _ops_for(mode, tensors, tensors32, lay, reg, cg_iters=0, cg_tol=0.0):
+def _ops_for(mode, tensors, tensors32, lay, reg, cg_iters=0, cg_tol=0.0,
+             link_shard=None):
     """One mode→LinOps map shared by the per-call entry points and the
     segment driver ("direct" | "f64c" | "mixed" | "pcg")."""
     if mode == "pcg":
-        return _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+        return _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters,
+                              link_shard)
     if mode == "f64c":
-        return _block_ops_f64c(tensors, lay, reg)
+        return _block_ops_f64c(tensors, lay, reg, link_shard=link_shard)
     if mode == "mixed":
-        return _block_ops_mixed(tensors, tensors32, lay, reg)
-    return _block_ops(tensors, lay, reg, None)
+        return _block_ops_mixed(tensors, tensors32, lay, reg, link_shard)
+    return _block_ops(tensors, lay, reg, None, link_shard=link_shard)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lay", "params", "cg_iters", "cg_tol", "mode")
+    jax.jit,
+    static_argnames=("lay", "params", "cg_iters", "cg_tol", "mode",
+                     "link_shard"),
 )
 def _block_step(tensors, lay, data, state, reg, params, tensors32=None,
-                cg_iters=0, cg_tol=0.0, mode="direct"):
+                cg_iters=0, cg_tol=0.0, mode="direct", link_shard=None):
     if mode == "direct" and cg_iters > 0:
         mode = "pcg"
-    ops = _ops_for(mode, tensors, tensors32, lay, reg, cg_iters, cg_tol)
+    ops = _ops_for(mode, tensors, tensors32, lay, reg, cg_iters, cg_tol,
+                   link_shard)
     return core.mehrotra_step(ops, data, params, state)
 
 
@@ -637,13 +671,13 @@ def _block_step(tensors, lay, data, state, reg, params, tensors32=None,
     jax.jit,
     static_argnames=(
         "lay", "params", "buf_cap", "stall_window", "patience", "mode",
-        "cg_iters", "cg_tol",
+        "cg_iters", "cg_tol", "link_shard",
     ),
 )
 def _block_segment(
     tensors, tensors32, lay, data, carry, it_stop, max_iter, max_refactor,
     reg_grow, params, buf_cap, stall_window=0, patience=0.0, mode="f64",
-    cg_iters=0, cg_tol=0.0,
+    cg_iters=0, cg_tol=0.0, link_shard=None,
 ):
     """One bounded continuation of the fused Schur loop (host segmentation
     against the device execution watchdog — see core.drive_segments and
@@ -655,13 +689,14 @@ def _block_segment(
 
     def step(state, reg):
         if mode == "mixed":
-            ops = _block_ops_mixed(tensors, tensors32, lay, reg)
+            ops = _block_ops_mixed(tensors, tensors32, lay, reg, link_shard)
         elif mode == "pcg":
-            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol,
+                                 cg_iters, link_shard)
         elif mode == "f64c":
-            ops = _block_ops_f64c(tensors, lay, reg)
+            ops = _block_ops_f64c(tensors, lay, reg, link_shard=link_shard)
         else:
-            ops = _block_ops(tensors, lay, reg, None)
+            ops = _block_ops(tensors, lay, reg, None, link_shard=link_shard)
         return core.mehrotra_step(ops, data, params, state)
 
     out = core.fused_solve(
@@ -676,13 +711,13 @@ def _block_segment(
     jax.jit,
     static_argnames=(
         "lay", "params", "params_p1", "buf_cap", "stall_window", "cg_iters",
-        "cg_tol",
+        "cg_tol", "link_shard",
     ),
 )
 def _block_solve_two_phase(
     tensors, tensors32, lay, data, state0, reg0, params, params_p1,
     max_iter, max_refactor, reg_grow, buf_cap, stall_window,
-    cg_iters=0, cg_tol=0.0,
+    cg_iters=0, cg_tol=0.0, link_shard=None,
 ):
     """Mixed-precision fused Schur solve: f32 per-block factorizations and
     linking-system Cholesky down to the handoff tolerance, then the
@@ -693,14 +728,15 @@ def _block_solve_two_phase(
     provisional-verdict reset at the phase boundary)."""
 
     def step32(state, reg):
-        ops = _block_ops_mixed(tensors, tensors32, lay, reg)
+        ops = _block_ops_mixed(tensors, tensors32, lay, reg, link_shard)
         return core.mehrotra_step(ops, data, params_p1, state)
 
     def step64(state, reg):
         if cg_iters > 0:
-            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol,
+                                 cg_iters, link_shard)
         else:
-            ops = _block_ops(tensors, lay, reg, None)
+            ops = _block_ops(tensors, lay, reg, None, link_shard=link_shard)
         return core.mehrotra_step(ops, data, params, state)
 
     st1, it1, status1, buf = core.fused_solve(
@@ -717,24 +753,28 @@ def _block_solve_two_phase(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lay", "params", "cg_iters", "cg_tol", "mode")
+    jax.jit,
+    static_argnames=("lay", "params", "cg_iters", "cg_tol", "mode",
+                     "link_shard"),
 )
 def _block_start(tensors, lay, data, reg, params, tensors32=None,
-                 cg_iters=0, cg_tol=0.0, mode="direct"):
+                 cg_iters=0, cg_tol=0.0, mode="direct", link_shard=None):
     if mode == "direct" and cg_iters > 0:
         mode = "pcg"
-    ops = _ops_for(mode, tensors, tensors32, lay, reg, cg_iters, cg_tol)
+    ops = _ops_for(mode, tensors, tensors32, lay, reg, cg_iters, cg_tol,
+                   link_shard)
     return core.starting_point(ops, data, params)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("lay", "params", "buf_cap", "stall_window", "cg_iters",
-                     "cg_tol"),
+                     "cg_tol", "link_shard"),
 )
 def _block_solve_full(
     tensors, lay, data, state0, reg0, params, max_iter, max_refactor, reg_grow,
     buf_cap, stall_window=0, tensors32=None, cg_iters=0, cg_tol=0.0,
+    link_shard=None,
 ):
     # max_iter / max_refactor / reg_grow are traced — no recompile across
     # iteration-limit configs (see dense._dense_solve_full). Stall
@@ -742,9 +782,10 @@ def _block_solve_full(
     # so termination status cannot depend on whether segmentation is on.
     def step(state, reg):
         if cg_iters > 0:
-            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol, cg_iters)
+            ops = _block_pcg_ops(tensors, tensors32, lay, reg, cg_tol,
+                                 cg_iters, link_shard)
         else:
-            ops = _block_ops(tensors, lay, reg, None)
+            ops = _block_ops(tensors, lay, reg, None, link_shard=link_shard)
         return core.mehrotra_step(ops, data, params, state)
 
     return core.fused_solve(
@@ -794,6 +835,20 @@ class BlockAngularBackend(SolverBackend):
                 return jax.device_put(arr, NamedSharding(self._mesh, spec))
 
         self._tensors, self._lay = build_tensors(inf, dtype, shard_put)
+        # Distributed linking-system factorization (VERDICT round-4 item
+        # 7): with a mesh, the link×link Schur complement factors through
+        # ops/dist_chol.py column-sharded over the LAST mesh axis (ICI on
+        # a hybrid mesh) instead of replicated on every device — the
+        # replicated factor was the per-device HBM floor at link=1600.
+        # chol_tri_inv_mesh pads ragged link sizes itself.
+        if self._mesh is not None and self._lay.link > 0:
+            from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
+
+            self._link_shard = _NS(
+                self._mesh, _P(None, self._mesh.axis_names[-1])
+            )
+        else:
+            self._link_shard = None
         self._data = core.make_problem_data(jnp, inf.c, inf.b, inf.u, dtype)
         # Two-phase (f32→f64) schedule: "auto" factor dtype on TPU, exactly
         # as the dense backend — phase 1 runs every per-block factorization
@@ -847,7 +902,7 @@ class BlockAngularBackend(SolverBackend):
         st = _block_start(
             self._tensors, self._lay, self._data,
             jnp.asarray(self._reg, self._dtype), self._params, t32, cgi, cgt,
-            mode,
+            mode, self._link_shard,
         )
         jax.block_until_ready(st)
         return st
@@ -857,7 +912,7 @@ class BlockAngularBackend(SolverBackend):
         return _block_step(
             self._tensors, self._lay, self._data, state,
             jnp.asarray(self._reg, self._dtype), self._params, t32, cgi, cgt,
-            mode,
+            mode, self._link_shard,
         )
 
     def bump_regularization(self) -> bool:
@@ -905,6 +960,16 @@ class BlockAngularBackend(SolverBackend):
         finish_mode = "f64c" if self._huge_f64 else "f64"
         full_mode = "pcg" if self._pcg else finish_mode
         full_t32 = self._get_tensors32() if full_mode == "pcg" else None
+        # The chunked-f64 finisher gets Gondzio correctors (same knob as
+        # the dense endgame): each f64c factorization costs ~3 s at the
+        # pds-20 class while an extra solve against its INVERSE factors
+        # is GEMV noise — exactly the economics StepParams.mcc exists
+        # for. The one-shot "f64" mode at small shapes keeps mcc off
+        # (its factorizations are cheap; extra solves only add latency).
+        params_finish = (
+            cfg.step_params(mcc=cfg.endgame_mcc)
+            if finish_mode == "f64c" else self._params
+        )
         if self._two_phase:
             plan = [
                 (cfg.phase1_params(), "mixed", self._get_tensors32(), w, 0.0),
@@ -920,18 +985,19 @@ class BlockAngularBackend(SolverBackend):
                     (params_pcg, "pcg", self._get_tensors32(), w, 0.0)
                 )
                 plan.append(
-                    (self._params, finish_mode, None,
+                    (params_finish, finish_mode, None,
                      2 * w if w else 0, patience)
                 )
             else:
                 plan.append(
-                    (self._params, full_mode, full_t32, 2 * w if w else 0,
-                     patience)
+                    (params_finish if full_mode == finish_mode
+                     else self._params,
+                     full_mode, full_t32, 2 * w if w else 0, patience)
                 )
         else:
             plan = [
-                (self._params, full_mode, full_t32, 2 * w if w else 0,
-                 patience)
+                (params_finish if full_mode == finish_mode else self._params,
+                 full_mode, full_t32, 2 * w if w else 0, patience)
             ]
 
         def make_phase(spec):
@@ -951,6 +1017,7 @@ class BlockAngularBackend(SolverBackend):
                         self._tensors, t32, self._lay, self._data, c,
                         jnp.asarray(stop, jnp.int32), mi, mr, rg, params,
                         buf_cap, window, patience_now, mode, cgi, cgt,
+                        self._link_shard,
                     )
 
                 return run_seg
@@ -1009,6 +1076,7 @@ class BlockAngularBackend(SolverBackend):
                 self._get_tensors32(),
                 self._cg_iters,
                 self._cg_tol,
+                self._link_shard,
             )
         if self._two_phase:
             return _block_solve_two_phase(
@@ -1027,6 +1095,7 @@ class BlockAngularBackend(SolverBackend):
                 self._cfg.stall_window,
                 self._cg_iters,
                 self._cg_tol,
+                self._link_shard,
             )
         return _block_solve_full(
             self._tensors,
@@ -1040,6 +1109,7 @@ class BlockAngularBackend(SolverBackend):
             jnp.asarray(self._cfg.reg_grow, self._dtype),
             core.buffer_cap(self._cfg.max_iter),
             2 * self._cfg.stall_window if self._cfg.stall_window else 0,
+            link_shard=self._link_shard,
         )
 
     def block_until_ready(self, obj) -> None:

@@ -456,6 +456,99 @@ def _endgame_factor(M, reg):
     return jnp.linalg.cholesky(Ms), s
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _eg_scale_reg(M, reg):
+    """Jacobi scale + diagonal reg shift (M donated — its buffer feeds
+    the scaled copy; the shift is a diagonal scatter, not ``+ reg·eye``,
+    which would materialize another m² buffer)."""
+    diagM = jnp.diagonal(M)
+    s = jax.lax.rsqrt(jnp.maximum(diagM, jnp.finfo(M.dtype).tiny))
+    Ms = M * s[:, None] * s[None, :]
+    rng_ = jnp.arange(M.shape[0])
+    return Ms.at[rng_, rng_].add(jnp.asarray(reg, M.dtype)), s
+
+
+def _endgame_factor_mxu(M, reg):
+    """On-device Jacobi-scaled factor + EXPLICIT inverse through the
+    GEMM-dominated panel kernels (ops/chol_mxu.py) — the round-5 endgame
+    mode. Same scaling/reg convention as :func:`_endgame_factor`;
+    returns ``(Linv, s)`` with ``(s·M·s + reg·I)⁻¹ = Linvᵀ·Linv``.
+    Measured at m=10240: ~10 s warm on the chip — against the host
+    path's ~20–33 s symmetric d2h transfer PLUS ~20–38 s LAPACK factor
+    per iteration it replaces. Solve quality: effective ε ≈ 1.5e-13 at
+    the degenerate-spectrum probe (double-double class; LAPACK is
+    2.2e-16) — the step's true-operator refinement sweeps carry the
+    difference (each sweep contracts by the solve's relres).
+
+    Returns ``(L, Winv, s)`` — the padded in-place panel factor, its
+    per-panel diagonal-block inverses, and the Jacobi scale; solves run
+    as panel substitutions (ops/chol_mxu.py: panel_cho_solve). NO m×m
+    inverse is ever formed: the fused factor+inverse's (T, X) while
+    carry (~4 m² live under XLA double-buffering) and then even the
+    stand-alone inversion's X/eye buffers OOM'd at 10k next to the
+    resident 4 GB constraint matrix (observed three times, 2026-08-01).
+    Peak here is ~2 m² (scale copy + factor carry), and M is donated
+    into the scale stage; callers re-assemble on the rare bad-step
+    retry instead of holding M across the factor."""
+    from distributedlpsolver_tpu.ops.chol_mxu import chol_mxu_factor
+
+    Ms, s = _eg_scale_reg(M, reg)
+    L, Winv = chol_mxu_factor(Ms)
+    return L, Winv, s
+
+
+@functools.partial(jax.jit, static_argnames=("params", "refine", "closure_sweeps"))
+def _endgame_step_mxu(A, data, state, Linv_s, reg, diagM, params, refine=2,
+                      closure=None, closure_sweeps=1):
+    """One Mehrotra step with the on-device panel factor injected:
+    every solve is a pair of panel triangular substitutions plus
+    ``refine`` true-operator sweeps (matrix-free exact f64 residual —
+    never forms M). ``closure``
+    (the f32 AAᵀ factor pair from _closure_factors) feeds the
+    direction-level primal closure exactly as in the PCG phases —
+    pure-jax, so unlike the host endgame the whole step stays ONE device
+    program (no eager per-op tunnel hops, no host round trips at all).
+    KKT-level refinement stays off for program size (same constraint as
+    _endgame_step); the solve-level sweeps own accuracy recovery."""
+    from distributedlpsolver_tpu.ops.chol_mxu import panel_cho_solve
+
+    d_scale = core.scaling_d(state, data, params)
+
+    def solve(Lf, rhs):
+        L, Winv, s = Lf  # panel factor of s·M·s + reg·I (scaled space)
+        x = s * panel_cho_solve(L, Winv, s * rhs)
+        for _ in range(refine):
+            Mx = _matvec_chunked(A, d_scale * _rmatvec_chunked(A, x))
+            r = rhs - Mx - reg * diagM * x
+            x = x + s * panel_cho_solve(L, Winv, s * r)
+        return x
+
+    pp = None
+    if closure is not None:
+        LinvG, sG = closure
+
+        def prec(r):
+            z = LinvG @ (sG * r).astype(LinvG.dtype)
+            return sG * (LinvG.T @ z).astype(sG.dtype)
+
+        def pp(rv):
+            t = prec(rv)
+            for _ in range(closure_sweeps):
+                rr = rv - _matvec_chunked(A, _rmatvec_chunked(A, t))
+                t = t + prec(rr)
+            return _rmatvec_chunked(A, t)
+
+    ops = core.LinOps(
+        xp=jnp,
+        matvec=lambda v: _matvec_chunked(A, v),
+        rmatvec=lambda v: _rmatvec_chunked(A, v),
+        factorize=lambda d: Linv_s,
+        solve=solve,
+        primal_project=pp,
+    )
+    return core.mehrotra_step(ops, data, params, state)
+
+
 @functools.partial(jax.jit, static_argnames=("params", "refine"))
 def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=1):
     """One Mehrotra step with the factorization INJECTED (computed by the
@@ -1449,7 +1542,9 @@ class DenseJaxBackend(SolverBackend):
         # M-level refinement (see _endgame_step), and the KKT-refinement
         # solve sites would ~3× the emulated-f64 program — whose compile
         # must stay under the tunnel's ~55-minute response drop.
-        params = cfg.replace(kkt_refine=0).step_params()
+        params = cfg.replace(kkt_refine=0).step_params(
+            mcc=cfg.endgame_mcc
+        )
         trace = core.seg_trace_enabled()
         buf = np.asarray(buf)[:it0] if it0 else np.zeros((0, core.N_STAT))
         rows = []
@@ -1462,32 +1557,65 @@ class DenseJaxBackend(SolverBackend):
         state = _endgame_recenter(self._data, state, params)
         reg_fail_floor = 0.0  # smallest reg observed to fail a factor
         good_streak = 0  # consecutive good steps since the last bad one
+        # Endgame factor mode. Auto (endgame_host=None) on TPU is now
+        # the on-device "mxu" mode (round 5): the GEMM-dominated panel
+        # factor+inverse (ops/chol_mxu.py) factors the Jacobi-scaled
+        # system in 10.0 s at m=10240 — against the host path's
+        # ~20–33 s symmetric transfer PLUS ~20–38 s LAPACK factor per
+        # iteration — and the whole step stays one jitted device
+        # program (the host mode ran mehrotra_step eagerly). The host
+        # mode remains behind endgame_host=True: LAPACK's true-f64
+        # ε = 2.2e-16 and guarded pivots are the escape hatch if a
+        # problem's late spectrum defeats the emulated-f64 kernel
+        # (probe: mxu factors the degenerate cond-1e19 spectrum to
+        # reg 1e-12 with effective ε ≈ 1.5e-13, double-double class).
+        # endgame_host=False keeps the legacy builtin device mode.
+        import os as _os
+
+        eg_env = _os.environ.get("TPULP_ENDGAME", "")
+        if eg_env in ("mxu", "host", "device"):
+            eg_mode = eg_env  # test hook / A-B escape hatch
+        elif cfg.endgame_host is None:
+            eg_mode = "mxu" if jax.default_backend() == "tpu" else "device"
+        else:
+            eg_mode = "host" if cfg.endgame_host else "device"
+        host_mode = eg_mode == "host"
+        mxu_mode = eg_mode == "mxu"
+        closure = None
+        if mxu_mode:
+            # The mxu step reuses the phases' pure-jax AAᵀ closure for
+            # the direction-level primal restoration — build it BEFORE
+            # A32 is dropped (it factors from the f32 copy).
+            closure = self._ensure_closure()
         # The endgame never touches the f32 copy the PCG phases
         # preconditioned with — drop it before the first f64 assembly:
         # at 10k×50k the (Pallas-padded) A32 is ~2 GB of HBM, and with it
         # resident the SECOND endgame iteration's assembly hit
         # RESOURCE_EXHAUSTED (observed 2026-07-30; iteration 1 fit only
         # because no previous factor L was alive yet). The device-side
-        # closure factor goes with it (~m²·4 bytes) — the host endgame
-        # uses the exact host AAᵀ closure instead.
+        # closure factor goes with it (~m²·4 bytes; KEPT in mxu mode,
+        # which feeds it to the step's primal_project) — the host
+        # endgame uses the exact host AAᵀ closure instead.
         self._A32 = None
-        self._closure = None
+        # The Pallas-padded phase-1 assembly copy (~2 GB at 10k) is dead
+        # too — the endgame's assembly is the chunked f64 contraction.
+        # Host mode never needed the headroom (no factor lives in HBM);
+        # the mxu factor's T/X panel buffers do (observed runtime
+        # RESOURCE_EXHAUSTED with Af resident, 2026-08-01).
+        self._Af = None
+        if not mxu_mode:
+            self._closure = None
         budget = cfg.max_iter
         refactor = 0
         self.endgame_timings = timings = []
-        # Host-factor mode (cfg.endgame_host; auto = on under emulated
-        # f64): LAPACK factorization + triangular solves on host, assembly
-        # and refinement matvecs on device. The same mode builds the AAᵀ
-        # host factor whose restore() closure makes every Newton dx
-        # exactly primal-feasible — with the phases' device closure, the
-        # two mechanisms that break the round-3 terminal wall
+        # Host-factor mode (cfg.endgame_host=True): LAPACK factorization
+        # + triangular solves on host, assembly and refinement matvecs on
+        # device. The same mode builds the AAᵀ host factor whose
+        # restore() closure makes every Newton dx exactly
+        # primal-feasible — with the phases' device closure, the two
+        # mechanisms that broke the round-3 terminal wall
         # (BENCH_10K.json analysis): a four-orders-smaller factorable
         # reg, and feasibility that never leaks into the iterate.
-        host_mode = (
-            cfg.endgame_host
-            if cfg.endgame_host is not None
-            else jax.default_backend() == "tpu"
-        )
         project = None
         restore = None
         if host_mode:
@@ -1497,7 +1625,9 @@ class DenseJaxBackend(SolverBackend):
             # full host solve + device residual pair against a direction
             # already operator-refined inside solve() — see the
             # endgame_host note in ipm/config.py.
-            params = cfg.replace(kkt_refine=min(cfg.kkt_refine, 1)).step_params()
+            params = cfg.replace(kkt_refine=min(cfg.kkt_refine, 1)).step_params(
+                mcc=cfg.endgame_mcc
+            )
             # The AAᵀ factor powers the DIRECTION-level primal closure
             # (restore → ops.primal_project): every Newton dx is made
             # exactly primal-feasible, so pinf decays as (1−α) per
@@ -1517,7 +1647,10 @@ class DenseJaxBackend(SolverBackend):
         # m ≳ 24k where two f64 m×m buffers alone approach the chip.
         # Above the cutoff, fall back to re-assembling on (rare) retries.
         m = self._A.shape[0]
-        hold_m = m <= 16384
+        # mxu mode DONATES M into the factor program (HBM headroom — see
+        # _endgame_factor_mxu), so holding it for retries is impossible
+        # there; retries re-assemble (~11 s at 10k, rare).
+        hold_m = m <= 16384 and not mxu_mode
         # Anti-stagnation ladder for the BLOCKED-STEP mode (first observed
         # 2026-07-31 at 10k×50k: pinf/dinf at ~9e-15 but μ frozen at
         # 3.7e-8 with α pinned to the backoff grid's floor — the Mehrotra
@@ -1620,17 +1753,25 @@ class DenseJaxBackend(SolverBackend):
                     t_step = _time.perf_counter() - t1
                     L_finite = True
                 else:
-                    L = _endgame_factor(M, jnp.asarray(reg, self._dtype))
+                    fac_fn = _endgame_factor_mxu if mxu_mode else _endgame_factor
+                    L = fac_fn(M, jnp.asarray(reg, self._dtype))
                     jax.block_until_ready(L)
                     t_fac = _time.perf_counter() - t1
                     if not hold_m:
                         del M
                         M = None
                     t1 = _time.perf_counter()
-                    new_state, stats = _endgame_step(
-                        self._A, self._data, state, L,
-                        jnp.asarray(reg, self._dtype), diagM, step_par,
-                    )
+                    if mxu_mode:
+                        new_state, stats = _endgame_step_mxu(
+                            self._A, self._data, state, L,
+                            jnp.asarray(reg, self._dtype), diagM, step_par,
+                            closure=closure,
+                        )
+                    else:
+                        new_state, stats = _endgame_step(
+                            self._A, self._data, state, L,
+                            jnp.asarray(reg, self._dtype), diagM, step_par,
+                        )
                     bad = bool(stats.bad)  # blocks on the step dispatch
                     t_step = _time.perf_counter() - t1
                     L_finite = bool(
@@ -1653,6 +1794,7 @@ class DenseJaxBackend(SolverBackend):
                     "sigma": float(np.asarray(stats.sigma)),
                     "L_finite": L_finite,
                     "host": host_mode,
+                    "mode": eg_mode,
                     # blocked-step-mode diagnostics (entry state): a stall
                     # with cent_ratio ≪ γ is a guard-limited deadlock, one
                     # with ratio ≈ γ and tiny α a ratio-test block.

@@ -94,6 +94,11 @@ class SolverConfig:
     # refines against the true operator internally; one round restores
     # the cancellation digits, more only adds host↔device latency.
     endgame_host: Optional[bool] = None
+    # Gondzio correctors in the ENDGAME only (StepParams.mcc): there the
+    # factorization dwarfs a solve (10k×50k: ~10 s mxu factor vs ~2 s
+    # extra solve), so extra centrality correctors that lengthen
+    # collapsed steps are nearly free per saved iteration. 0 disables.
+    endgame_mcc: int = 2
     # Ruiz-equilibrate the interior form before solving (presolve scaling;
     # convergence is then tested in the scaled space, standard practice).
     scale: bool = True
@@ -167,7 +172,8 @@ class SolverConfig:
             mu_pinf_floor=0.03
         )
 
-    def step_params(self, mu_pinf_floor: float = 0.0) -> "StepParams":
+    def step_params(self, mu_pinf_floor: float = 0.0,
+                    mcc: int = 0) -> "StepParams":
         return StepParams(
             tol=self.tol,
             eta=self.eta,
@@ -178,6 +184,7 @@ class SolverConfig:
             reg_primal=self.reg_primal,
             kkt_refine=self.kkt_refine,
             mu_pinf_floor=mu_pinf_floor,
+            mcc=mcc,
         )
 
 
@@ -214,3 +221,13 @@ class StepParams:
     # the f64 finisher could not repair and the divergence heuristic
     # misread as PRIMAL_INFEASIBLE (observed, pds-20-class 2026-08-01).
     mu_pinf_floor: float = 0.0
+    # Gondzio-style multiple centrality correctors: up to this many
+    # extra complementarity-only solves per iteration, each reusing the
+    # factorization to pull outlier pair products back into a band
+    # around the centering target and re-testing the step lengths — a
+    # candidate is kept only if it lengthens the step. Exists for
+    # phases where the factorization dwarfs a solve (the 10k endgame:
+    # BENCH_10K.json round 4 shows α collapsing to 0.03–0.18 with
+    # near-pure-centering σ across its 41–48 — the textbook signature
+    # these correctors fix). 0 = off (every non-endgame path).
+    mcc: int = 0

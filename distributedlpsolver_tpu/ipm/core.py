@@ -379,8 +379,40 @@ def mehrotra_step(
         ops, state, hub, d, factors, r_p, r_u, r_d, rxs, rwz, cfg.kkt_refine
     )
 
-    alpha_p = xp.minimum(1.0, cfg.eta * _max_step(xp, x, dx, w, dw, hub))
-    alpha_d = xp.minimum(1.0, cfg.eta * _max_step(xp, s, ds, z, dz, hub))
+    ap_raw = _max_step(xp, x, dx, w, dw, hub)
+    ad_raw = _max_step(xp, s, ds, z, dz, hub)
+    if cfg.mcc and not cfg.center:
+        # Gondzio multiple centrality correctors (StepParams.mcc): each
+        # round solves ONCE more on the held factorization with a
+        # complementarity-only RHS that pulls the TRIAL point's outlier
+        # products back into a [0.1, 10]·target band, and keeps the
+        # corrected direction only if it lengthens the combined step.
+        # Feasibility RHS is zero, so an accepted correction never
+        # perturbs r_p/r_u/r_d reduction — pure recentering.
+        zm = xp.zeros_like(b)
+        zn = xp.zeros_like(x)
+        for _ in range(cfg.mcc):
+            ap_t = xp.minimum(1.0, 1.3 * ap_raw + 0.1)
+            ad_t = xp.minimum(1.0, 1.3 * ad_raw + 0.1)
+            v_xs = (x + ap_t * dx) * (s + ad_t * ds)
+            v_wz = hub * ((w + ap_t * dw) * (z + ad_t * dz))
+            cxs = xp.clip(v_xs, 0.1 * target, 10.0 * target) - v_xs
+            cwz = hub * (xp.clip(v_wz, 0.1 * target, 10.0 * target) - v_wz)
+            gx, gy, gs, gw, gz = _solve_kkt_once(
+                ops, state, hub, d, factors, zm, zn, zn, cxs, cwz
+            )
+            dx2, dy2, ds2, dw2, dz2 = dx + gx, dy + gy, ds + gs, dw + gw, dz + gz
+            ap2 = _max_step(xp, x, dx2, w, dw2, hub)
+            ad2 = _max_step(xp, s, ds2, z, dz2, hub)
+            better = (ap2 + ad2) > (ap_raw + ad_raw) + 0.01
+            keep = lambda new, old: xp.where(better, new, old)
+            dx, dy, ds = keep(dx2, dx), keep(dy2, dy), keep(ds2, ds)
+            dw, dz = keep(dw2, dw), keep(dz2, dz)
+            ap_raw = keep(ap2, ap_raw)
+            ad_raw = keep(ad2, ad_raw)
+
+    alpha_p = xp.minimum(1.0, cfg.eta * ap_raw)
+    alpha_d = xp.minimum(1.0, cfg.eta * ad_raw)
     alpha_p, alpha_d = _centrality_backoff(
         xp, state, hub, (dx, ds, dw, dz), alpha_p, alpha_d, data.ncomp, cfg.gamma_cent
     )

@@ -47,6 +47,41 @@ def random_dense_lp(m: int, n: int, seed: int = 0, sigma: float = 1.0) -> LPProb
     )
 
 
+def random_sparse_lp(
+    m: int, n: int, density: float = 0.002, seed: int = 0
+) -> LPProblem:
+    """Random UNSTRUCTURED sparse standard-form LP (neos3-class stand-in,
+    BASELINE.json:10): a uniformly random sparsity pattern, so
+    ``models/structure.py``'s block-angular detection legitimately finds
+    nothing (every row couples random column subsets — no permutation
+    exposes an arrow form). Feasible + bounded by the same primal/dual
+    witness construction as :func:`random_dense_lp`; every row is given
+    ≥2 nonzeros so no singleton row lets presolve trivially shrink it.
+    """
+    rng = np.random.default_rng(seed)
+    nnz = max(int(density * m * n), 2 * m)
+    rows = rng.integers(0, m, nnz)
+    cols = rng.integers(0, n, nnz)
+    vals = rng.standard_normal(nnz)
+    # guarantee ≥2 entries per row (pattern stays random elsewhere)
+    rows = np.concatenate([rows, np.arange(m), np.arange(m)])
+    cols = np.concatenate(
+        [cols, rng.integers(0, n, m), rng.integers(0, n, m)]
+    )
+    vals = np.concatenate([vals, rng.standard_normal(2 * m)])
+    A = sp.coo_matrix((vals, (rows, cols)), shape=(m, n)).tocsr()
+    A.sum_duplicates()
+    x0 = rng.uniform(0.5, 2.0, size=n)
+    b = A @ x0
+    y0 = rng.standard_normal(m)
+    s0 = rng.uniform(0.5, 2.0, size=n)
+    c = A.T @ y0 + s0
+    return LPProblem(
+        c=c, A=A, rlb=b, rub=b, lb=np.zeros(n), ub=np.full(n, _INF),
+        name=f"random_sparse_{m}x{n}_d{density}_s{seed}",
+    )
+
+
 def random_general_lp(
     m: int, n: int, seed: int = 0, frac_eq: float = 0.3, frac_box: float = 0.5
 ) -> LPProblem:

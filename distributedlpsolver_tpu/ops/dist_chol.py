@@ -76,16 +76,23 @@ def chol_tri_inv_mesh(Ms, shard: NamedSharding, panel: int = 256):
     mp = w * K
     P = mp // pb  # global panel count
 
+    sharding = NamedSharding(mesh, PartitionSpec(None, axis))
     if mp != m:
         pad = mp - m
-        Mp = jnp.zeros((mp, mp), Ms.dtype)
-        Mp = Mp.at[:m, :m].set(Ms)
+        # Constrain the PADDED buffer before the identity-tail scatter:
+        # building it via zeros+set and constraining only at the end let
+        # GSPMD materialize an unconstrained replicated (mp, mp)
+        # intermediate — exactly the buffer this module promises never
+        # exists (ADVICE round 4). The raw m is not divisible by the
+        # mesh axis (that is what the pad is for), so the constraint can
+        # only attach from the padded shape onward; the diagonal scatter
+        # preserves it.
+        Ms = jax.lax.with_sharding_constraint(
+            jnp.pad(Ms, ((0, pad), (0, pad))), sharding
+        )
         # Identity tail: pad rows factor to L=I there and stay inert.
-        Mp = Mp.at[jnp.arange(m, mp), jnp.arange(m, mp)].set(1.0)
-        Ms = Mp
-    Ms = jax.lax.with_sharding_constraint(
-        Ms, NamedSharding(mesh, PartitionSpec(None, axis))
-    )
+        Ms = Ms.at[jnp.arange(m, mp), jnp.arange(m, mp)].set(1.0)
+    Ms = jax.lax.with_sharding_constraint(Ms, sharding)
 
     rows = jnp.arange(mp)
 

@@ -92,3 +92,73 @@ def test_preconditioner_path_end_to_end(mesh8):
     r = solve(p, backend=be, solve_mode="pcg")
     assert r.status.value == "optimal"
     assert r.objective == pytest.approx(r_ref.objective, rel=1e-6)
+
+
+def test_memory_ragged_m_stays_sharded(mesh8):
+    """Ragged m (padding path): the identity-tail construction must not
+    materialize an unconstrained replicated (mp, mp) buffer (ADVICE
+    round 4). Envelope: the ragged case's compiled peak stays within 40%
+    of the divisible case at comparable size (the pad itself adds rows,
+    so exact equality is not expected — a replicated intermediate would
+    roughly DOUBLE it)."""
+    sh = NamedSharding(mesh8, P(None, "cols"))
+
+    def peak(m, panel):
+        Ms = jnp.asarray(_spd(m), jnp.float32)
+        comp = jax.jit(
+            lambda M: chol_tri_inv_mesh(M, sh, panel=panel)
+        ).lower(Ms).compile()
+        return comp.memory_analysis().temp_size_in_bytes
+
+    ragged = peak(1000, 128)   # 1000 -> slab 125 -> pad to 128*8 = 1024
+    exact = peak(1024, 128)
+    assert ragged < 1.4 * exact, (ragged, exact)
+    # and the math survives the pad (oracle check at the ragged size)
+    m = 1000
+    Ms = jnp.asarray(_spd(m), jnp.float64)
+    Linv = np.asarray(chol_tri_inv_mesh(Ms, sh, panel=128))
+    err = np.abs(Linv.T @ Linv @ np.asarray(Ms) - np.eye(m)).max()
+    assert err < 1e-6, err
+
+
+def test_block_linking_factor_distributes_over_mesh(mesh8):
+    """VERDICT round-4 item 7: with a mesh, the block backend's
+    link x link Schur factorization must route through chol_tri_inv_mesh
+    (column-sharded factor) instead of replicating it on every device.
+    Compile-time per-device temp peak of one f64c segment program at
+    link=1600 must drop measurably vs the replicated route."""
+    import jax.numpy as jnp
+    from distributedlpsolver_tpu.backends import block_angular as B
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.ipm import core as C
+    from distributedlpsolver_tpu.models.generators import block_angular_lp
+    from distributedlpsolver_tpu.models.problem import to_interior_form
+
+    link = 1600
+    p = block_angular_lp(8, 24, 48, link, seed=0, sparse=True, density=0.02)
+    inf = to_interior_form(p)
+
+    def peak(link_shard):
+        be = B.BlockAngularBackend(mesh=mesh8 if link_shard else None)
+        be.setup(inf, SolverConfig())
+        lay, t = be._lay, be._tensors
+        data = be._data
+        params = SolverConfig().step_params()
+        buf_cap = C.buffer_cap(200)
+        state = be.starting_point()
+        carry = C.fresh_segment_carry(
+            state, jnp.asarray(1e-10, jnp.float64), buf_cap, jnp.float64
+        )
+        lowered = B._block_segment.lower(
+            t, None, lay, data, carry, jnp.asarray(4, jnp.int32),
+            jnp.asarray(8, jnp.int32), jnp.asarray(3, jnp.int32),
+            jnp.asarray(100.0, jnp.float64), params, buf_cap,
+            mode="f64c", link_shard=be._link_shard,
+        )
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    sharded = peak(True)
+    replicated = peak(False)
+    # the replicated link x link f64 factor alone is link^2*8 bytes on
+    # every device; demand at least half of that as the margin
+    assert sharded < replicated - 4 * link * link, (sharded, replicated)

@@ -416,12 +416,23 @@ class TestHostEndgame:
     emulated-f64 Cholesky NaN floor and the reg-filtered pinf floor
     (BENCH_10K.json analysis) — pinned here at toy scale on CPU."""
 
-    def test_host_endgame_finishes(self, monkeypatch):
+    def test_auto_endgame_is_mxu_and_finishes(self, monkeypatch):
         # auto-resolution: endgame_host=None on (monkeypatched) TPU ->
-        # host mode. Must reach 1e-8 with host step rows in the timing
-        # record; the AAᵀ direction-level primal closure keeps the final
-        # iterate essentially on Ax=b (far below the 1e-8 test above).
+        # the on-device mxu mode (round 5). Must reach 1e-8 with mxu
+        # step rows in the timing record; the pure-jax AAᵀ closure keeps
+        # the final iterate essentially on Ax=b.
         be, r, p = _force_endgame(monkeypatch)
+        _check_optimal(r, p)
+        tm = be.endgame_timings
+        assert all(row.get("mode") == "mxu" for row in tm)
+        assert not any(row.get("host") for row in tm)
+        assert r.pinf < 1e-10
+
+    def test_host_endgame_finishes(self, monkeypatch):
+        # Explicit endgame_host=True keeps the LAPACK escape hatch: host
+        # step rows (with a transfer phase) in the timing record, same
+        # 1e-8 finish, pinf pinned by the host AAᵀ closure.
+        be, r, p = _force_endgame(monkeypatch, endgame_host=True)
         _check_optimal(r, p)
         tm = be.endgame_timings
         assert any(row.get("host") for row in tm)
@@ -449,7 +460,7 @@ class TestHostEndgame:
             return real_fac(Mh, reg)
 
         monkeypatch.setattr(d, "_endgame_factor_host", flaky)
-        be, r, p = _force_endgame(monkeypatch)
+        be, r, p = _force_endgame(monkeypatch, endgame_host=True)
         _check_optimal(r, p)
         tm = [row for row in be.endgame_timings if "t_step" in row]
         assert [row["bad"] for row in tm[:3]] == [True, True, False]
@@ -486,7 +497,7 @@ class TestHostEndgame:
 
         monkeypatch.setattr(d, "_endgame_step_host", bad_once)
         monkeypatch.setattr(d, "_endgame_assemble", counting_asm)
-        be, r, p = _force_endgame(monkeypatch)
+        be, r, p = _force_endgame(monkeypatch, endgame_host=True)
         _check_optimal(r, p)
         tm = [row for row in be.endgame_timings if "t_step" in row]
         bad_rows = [row for row in tm if row["bad"]]
@@ -594,7 +605,7 @@ def test_endgame_stagnation_fires_centering_ladder(monkeypatch):
 
     monkeypatch.setattr(d, "_endgame_step_host", blocked_then_real)
     monkeypatch.setattr(d, "_endgame_recenter", counting_recenter)
-    be, r, p = _force_endgame(monkeypatch)
+    be, r, p = _force_endgame(monkeypatch, endgame_host=True)
     _check_optimal(r, p)
     tm = [row for row in be.endgame_timings if "t_step" in row]
     # the ladder fired at least one centering step, flagged in the rows

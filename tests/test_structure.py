@@ -228,3 +228,33 @@ def test_imbalanced_natural_partition_falls_back_to_packed_k():
     assert K >= 2 and sizes.min() > 0
     # the accepted packing satisfies the balance bound it was tested with
     assert K * sizes.max() / sizes.sum() <= 1.5
+
+
+def test_unstructured_sparse_routes_to_cpu_sparse():
+    # neos3-class (BASELINE.json:10): a uniformly random sparse pattern
+    # must defeat detection, and auto must route it to the sparse-direct
+    # host backend (the measured routing decision, scripts/run_neos3.py
+    # -> .neos3_sparse.json). Pinning the route keeps a future detector
+    # change from silently densifying a Mittelmann-scale problem.
+    from distributedlpsolver_tpu.backends.auto import choose_backend_name
+    from distributedlpsolver_tpu.models.generators import random_sparse_lp
+    from distributedlpsolver_tpu.models.problem import to_interior_form
+
+    p = random_sparse_lp(800, 1600, density=0.004, seed=0)
+    inf = to_interior_form(p)
+    hint = detect_block_structure(inf.A)
+    assert hint is None, f"random pattern detected as {hint}"
+    name, hint2 = choose_backend_name(inf, "tpu", detect=True)
+    assert name == "cpu-sparse" and hint2 is None
+
+
+def test_random_sparse_lp_solvable_to_1em8():
+    # feasibility/boundedness of the generator's witness construction,
+    # end to end through the sparse-direct backend at full tolerance
+    from distributedlpsolver_tpu.ipm import solve
+    from distributedlpsolver_tpu.models.generators import random_sparse_lp
+
+    p = random_sparse_lp(300, 600, density=0.01, seed=1)
+    r = solve(p, backend="cpu-sparse")
+    assert r.status.value == "optimal"
+    assert r.rel_gap <= 1e-8 and r.pinf <= 1e-8
