@@ -446,14 +446,34 @@ def run_suite(args) -> list:
     # forcing single-phase f32 here stalls short of the 1e-8 gap.
     m, n = (128, 320) if q else ((10_000, 50_000) if args.full else (2_048, 10_240))
     _log(f"[3/6] random dense {m}x{n} (two-phase mixed precision)")
-    add(
-        f"random dense {m}x{n}",
-        _bench_one(
-            random_dense_lp(m, n, seed=2),
-            accel,
-            "cpu-native" if q else None,  # dense CPU baseline is hours at full size
-        ),
+    row3 = _bench_one(
+        random_dense_lp(m, n, seed=2),
+        accel,
+        "cpu-native" if q else None,  # in-suite CPU solve only at quick size
     )
+    if (m, n) == (2048, 10240) and row3.get("vs_baseline") is None:
+        # MEASURED end-to-end dense baseline (VERDICT round-4 item 3):
+        # scripts/run_dense2k_cpu.py solved this exact instance
+        # (seed=2) through cpu-native on a quiet host — 839 s, 26
+        # iters, OPTIMAL — far too long to re-run inside every suite,
+        # so the artifact is consumed like the batched loop baseline.
+        art_p = os.path.join(_REPO, ".dense2k_cpu.json")
+        if os.path.exists(art_p):
+            art = json.load(open(art_p))
+            if (
+                art.get("config") == f"random dense {m}x{n} seed=2"
+                and art.get("status") == "optimal"
+            ):
+                row3.update(
+                    baseline_backend="cpu-native (end-to-end measured)",
+                    baseline_time_s=art["solve_s"],
+                    baseline_process_cpu_s=art["process_cpu_s"],
+                    baseline_artifact=".dense2k_cpu.json",
+                    vs_baseline=round(
+                        art["solve_s"] / max(row3["time_s"], 1e-9), 1
+                    ),
+                )
+    add(f"random dense {m}x{n}", row3)
 
     # 4. Large-sparse class (BASELINE.json:10, neos3/stormG2-like):
     # stormG2 IS block-angular (stochastic program). The stand-in arrives
@@ -496,7 +516,12 @@ def run_suite(args) -> list:
     _log("[4b] unstructured sparse, detection-defeating (auto -> cpu-sparse)")
     from distributedlpsolver_tpu.models.generators import random_sparse_lp
 
-    ushape = (400, 800, 0.01) if q else (8000, 16000, 0.001)
+    # Sized for the suite budget: _bench_one runs THREE full solves
+    # (warm-up + best-of-two), and the sparse-direct factorization's
+    # fill-in makes an 8000x16000 instance a ~20-minute-per-solve row
+    # (observed) — the scale-class record lives in .neos3_sparse.json,
+    # this row pins the ROUTE end-to-end.
+    ushape = (400, 800, 0.01) if q else (2000, 4000, 0.002)
     add(
         f"neos3-like unstructured sparse {ushape[0]}x{ushape[1]}",
         _bench_one(

@@ -187,7 +187,13 @@ _EW_F64_BLOCK_ENTRIES = 1 << 24
 
 # HBM budget for the 8×-f32 operand-split temps of a ONE-SHOT f64 Schur
 # assembly; above it the full-precision phase runs n-chunked ("f64c").
-_F64_SPLIT_BUDGET = 4e9
+# 2e9, not the round-4 4e9: the storm100k-class instance (K=256 merged,
+# mb=384, nb=768 — split_bytes 2.8e9) reliably CRASHED the TPU worker in
+# its one-shot f64 phase while its f32 phase and the chunked programs
+# run clean (2026-08-01; same workload-correlated crash class as the
+# round-4 batched chunk≥256 PCG programs). pds-10-class (1.6e9) stays
+# direct and is measured healthy.
+_F64_SPLIT_BUDGET = 2e9
 
 
 def _ew_block(t: "BlockTensors") -> bool:

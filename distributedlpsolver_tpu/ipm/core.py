@@ -391,9 +391,15 @@ def mehrotra_step(
         # perturbs r_p/r_u/r_d reduction — pure recentering.
         zm = xp.zeros_like(b)
         zn = xp.zeros_like(x)
-        for _ in range(cfg.mcc):
-            ap_t = xp.minimum(1.0, 1.3 * ap_raw + 0.1)
-            ad_t = xp.minimum(1.0, 1.3 * ad_raw + 0.1)
+        for mc in range(cfg.mcc):
+            # Progressively enlarged trial step per round (Gondzio's own
+            # escalation): without it a REJECTED round makes every later
+            # round bit-identical — the same trial point, band, RHS, and
+            # solve, deterministically rejected again (round-5 review
+            # finding: a guaranteed-useless KKT solve per extra round).
+            grow = 1.3 + 0.25 * mc
+            ap_t = xp.minimum(1.0, grow * ap_raw + 0.1 * (mc + 1))
+            ad_t = xp.minimum(1.0, grow * ad_raw + 0.1 * (mc + 1))
             v_xs = (x + ap_t * dx) * (s + ad_t * ds)
             v_wz = hub * ((w + ap_t * dw) * (z + ad_t * dz))
             cxs = xp.clip(v_xs, 0.1 * target, 10.0 * target) - v_xs

@@ -37,6 +37,30 @@ import jax
 import jax.numpy as jnp
 
 
+def _tri_inv_block(C):
+    """(p, p) lower-triangular → C⁻¹ masked fori forward substitution on
+    the identity — ONE definition, also used for `_factor_diag_block`'s W."""
+    p = C.shape[0]
+    eye = jnp.eye(p, dtype=C.dtype)
+
+    def sub_body(i, W):
+        row = (eye[i] - C[i] @ W) / C[i, i]
+        return W.at[i].set(row)
+
+    return jax.lax.fori_loop(0, p, sub_body, jnp.zeros_like(C))
+
+
+def _pad_spd(M, p):
+    """Pad an (m, m) SPD matrix to a panel multiple with an inert
+    identity tail; returns (padded, mp)."""
+    m = M.shape[0]
+    mp = -(-m // p) * p
+    if mp != m:
+        M = jnp.pad(M, ((0, mp - m), (0, mp - m)))
+        M = M.at[jnp.arange(m, mp), jnp.arange(m, mp)].set(1.0)
+    return M, mp
+
+
 def _factor_diag_block(D):
     """(p, p) SPD block → (C, W) with ``C = chol(D)`` and ``W = C⁻¹``.
 
@@ -53,7 +77,6 @@ def _factor_diag_block(D):
     """
     p = D.shape[0]
     rows = jnp.arange(p)
-    eye = jnp.eye(p, dtype=D.dtype)
 
     def fac_body(i, carry):
         D, Ct = carry
@@ -66,15 +89,7 @@ def _factor_diag_block(D):
 
     _, Ct = jax.lax.fori_loop(0, p, fac_body, (D, jnp.zeros_like(D)))
     C = Ct.T
-
-    def sub_body(i, W):
-        # W rows ≥ i are still zero, so the full contraction reads only
-        # the already-substituted prefix — no column masking needed.
-        row = (eye[i] - C[i] @ W) / C[i, i]
-        return W.at[i].set(row)
-
-    W = jax.lax.fori_loop(0, p, sub_body, jnp.zeros_like(C))
-    return C, W
+    return C, _tri_inv_block(C)
 
 
 def _panel_for(m: int) -> int:
@@ -116,11 +131,7 @@ def chol_inv_mxu(M, panel: int | None = None):
     m = M.shape[0]
     p = panel if panel is not None else _panel_for(m)
     p = min(p, m)
-    mp = -(-m // p) * p
-    if mp != m:
-        pad = mp - m
-        M = jnp.pad(M, ((0, pad), (0, pad)))
-        M = M.at[jnp.arange(m, mp), jnp.arange(m, mp)].set(1.0)
+    M, mp = _pad_spd(M, p)
     P = mp // p
     rows = jnp.arange(mp)
     X0 = jnp.eye(mp, dtype=M.dtype)
@@ -144,30 +155,6 @@ def chol_inv_mxu(M, panel: int | None = None):
 
     _, X = jax.lax.fori_loop(0, P, body, (M, X0))
     return X[:m, :m] if mp != m else X
-
-
-def _tri_inv_block(C):
-    """(p, p) lower-triangular → C⁻¹ by the same masked fori forward
-    substitution `_factor_diag_block` uses for its W."""
-    p = C.shape[0]
-    eye = jnp.eye(p, dtype=C.dtype)
-
-    def sub_body(i, W):
-        row = (eye[i] - C[i] @ W) / C[i, i]
-        return W.at[i].set(row)
-
-    return jax.lax.fori_loop(0, p, sub_body, jnp.zeros_like(C))
-
-
-def _pad_spd(M, p):
-    """Pad an (m, m) SPD matrix to a panel multiple with an inert
-    identity tail; returns (padded, mp)."""
-    m = M.shape[0]
-    mp = -(-m // p) * p
-    if mp != m:
-        M = jnp.pad(M, ((0, mp - m), (0, mp - m)))
-        M = M.at[jnp.arange(m, mp), jnp.arange(m, mp)].set(1.0)
-    return M, mp
 
 
 @functools.partial(jax.jit, static_argnames=("panel",))
