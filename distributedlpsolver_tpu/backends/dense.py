@@ -1657,7 +1657,7 @@ class DenseJaxBackend(SolverBackend):
         # direction anti-centers the minimum pair, every N₋∞ candidate is
         # inadmissible, and σ stays tiny because the AFFINE step keeps
         # predicting progress the guard can't accept). Remedy ladder:
-        # after 2 consecutive μ-stagnant steps, run ONE pure centering
+        # after ONE sub-10%-μ step, run ONE pure centering
         # step (StepParams.center: one KKT solve aiming every product at
         # the current μ — admissible by construction, restores the step
         # room the next Mehrotra iteration needs); if stagnation persists,
@@ -1910,13 +1910,26 @@ class DenseJaxBackend(SolverBackend):
             mu_new = row[0]
             was_center = center_next
             center_next = False
-            if prev_mu is not None and mu_new > 0.98 * prev_mu:
+            # A step that cuts μ by less than 10% is stagnant. The old
+            # scheme (0.98 threshold + TWO-strike trigger) needed a ~−3%
+            # step miscounted as progress AND two further strike-counting
+            # near-zero-α steps before centering — the recorded terminal
+            # cycle (BENCH_10K.json rows, its 31–77) fires CENTER only
+            # every ~5 iterations, wasting 2–3 ~15 s steps per cycle.
+            # The −10% line with a ONE-strike trigger centers on the
+            # first weak step; partial telemetry from the tightened
+            # re-run (cut short 2 iterations from optimal by a hung
+            # tunnel dispatch) showed the expected 3-step cycle with
+            # post-center α 0.37–0.52. Healthy steps cut μ 3–5× and
+            # never count; the ``since > 0`` gate keeps the μ-floor
+            # polish regime (pinf still improving) exempt.
+            if prev_mu is not None and mu_new > 0.90 * prev_mu:
                 stag += 1
             else:
                 stag = 0
             prev_mu = mu_new
-            if stag >= 2 and since > 0 and not was_center:
-                if stag >= 4 and recenters == 0:
+            if stag >= 1 and since > 0 and not was_center:
+                if stag >= 3 and recenters == 0:
                     state = _endgame_recenter(self._data, state, params)
                     recenters += 1
                     if trace:

@@ -568,9 +568,10 @@ def test_pure_centering_step_improves_centrality():
 
 def test_endgame_stagnation_fires_centering_ladder(monkeypatch):
     """μ-stagnant accepted steps must trigger the anti-stagnation ladder:
-    a pure centering step after 2 stagnant iterations (center=True param
-    reaching the step, row flagged), the collapsed-pair lift after 4, and
-    the run still finishing OPTIMAL once the (simulated) blockage lifts."""
+    a pure centering step after ONE sub-10%-μ step (round-5 one-strike
+    trigger; center=True param reaching the step, row flagged), the
+    collapsed-pair lift after three consecutive strikes, and the run
+    still finishing OPTIMAL once the (simulated) blockage lifts."""
     import distributedlpsolver_tpu.backends.dense as d
 
     real_step = d._endgame_step_host
@@ -591,8 +592,8 @@ def test_endgame_stagnation_fires_centering_ladder(monkeypatch):
             return new_state, stats  # blockage lifted — run real
         # Simulate the blocked-step mode: the iterate does not move and
         # μ reports a CONSTANT, so the loop's stagnation counter climbs
-        # deterministically through the whole ladder (2 → center,
-        # 4 → recenter + center) before the real solve resumes.
+        # deterministically through the whole ladder (1 strike → center,
+        # 3 strikes → recenter + center) before the real solve resumes.
         sim["blocked"] += 1
         return state, stats._replace(
             alpha_p=jnp.asarray(0.005), alpha_d=jnp.asarray(0.01),
@@ -611,6 +612,10 @@ def test_endgame_stagnation_fires_centering_ladder(monkeypatch):
     # the ladder fired at least one centering step, flagged in the rows
     assert sim["centers"] >= 1
     assert any(row["center"] for row in tm)
+    # ONE-strike trigger pinned: with constant-μ blocked steps the first
+    # CENTER row must land by the third step (blocked, strike → center).
+    # The old two-strike scheme centers one step later and fails this.
+    assert any(row["center"] for row in tm[:3]), [r["center"] for r in tm[:5]]
     # entry recenter always runs once; the ladder's mid-loop lift adds one
     assert sim["recenters"] >= 2
     # every row carries the blocked-step diagnostics
