@@ -30,6 +30,29 @@ from distributedlpsolver_tpu.models.problem import InteriorForm
 # dispatch (measured: 27×51 → ~10 ms CPU vs ~0.5 s tunneled-TPU).
 _SMALL_ENTRIES = 200_000
 
+# Supervisor degradation order (supervisor/supervisor.py): each step trades
+# throughput for independence from whatever the faulting layer was —
+# multi-device sharding → single-device dense → CPU sparse-direct → plain
+# CPU numpy, which shares no device runtime at all.
+DEGRADATION_CHAIN = ("sharded", "tpu", "cpu-sparse", "cpu")
+
+
+def degradation_chain(name: str) -> list:
+    """Fallback backend names strictly *after* ``name`` in the degradation
+    order. Aliases resolve through the registry ("dense" → "tpu"); names
+    outside the chain ("auto", "block", custom backends) get the full
+    chain minus themselves — any rung is a degradation from a specialized
+    or unknown backend."""
+    from distributedlpsolver_tpu.backends.base import _REGISTRY
+
+    key = (name or "").lower()
+    cls = _REGISTRY.get(key)
+    primary = cls.name if cls is not None else key
+    if primary in DEGRADATION_CHAIN:
+        i = DEGRADATION_CHAIN.index(primary)
+        return list(DEGRADATION_CHAIN[i + 1:])
+    return [n for n in DEGRADATION_CHAIN if n != primary]
+
 
 def choose_backend_name(
     inf: InteriorForm, platform: str, detect: bool = False

@@ -48,6 +48,30 @@ def _add_solver_flags(ap: argparse.ArgumentParser) -> None:
     )
     ap.add_argument("--json", action="store_true", help="print result as one JSON object")
     ap.add_argument("--x-out", default=None, help="write solution vector as .npy")
+    ap.add_argument(
+        "--log-fsync",
+        action="store_true",
+        help="fsync the JSONL log after each record (crash-proof telemetry)",
+    )
+    ap.add_argument(
+        "--supervise",
+        action="store_true",
+        help="run under the solve supervisor (watchdog + rollback + "
+        "backend degradation; see README 'Fault tolerance')",
+    )
+    ap.add_argument(
+        "--step-timeout",
+        type=float,
+        default=0.0,
+        help="watchdog deadline per device step in seconds (0 = no "
+        "watchdog; implies --supervise when set)",
+    )
+    ap.add_argument(
+        "--max-retries",
+        type=int,
+        default=6,
+        help="supervisor recovery attempts before a structured failure",
+    )
 
 
 def _config_from(args) -> "SolverConfig":
@@ -64,6 +88,7 @@ def _config_from(args) -> "SolverConfig":
         factor_dtype=args.factor_dtype,
         presolve=not args.no_presolve,
         scale=not args.no_scale,
+        log_fsync=args.log_fsync,
     )
 
 
@@ -87,6 +112,7 @@ def _report(result, as_json: bool, x_out: Optional[str]) -> int:
                     "setup_time_s": result.setup_time,
                     "iters_per_sec": result.iters_per_sec,
                     "backend": result.backend,
+                    "faults": [f.asdict() for f in result.faults],
                 }
             )
         )
@@ -99,10 +125,40 @@ def _report(result, as_json: bool, x_out: Optional[str]) -> int:
 
 def cmd_solve(args) -> int:
     from distributedlpsolver_tpu.io.mps import read_mps
-    from distributedlpsolver_tpu.ipm import solve
 
     problem = read_mps(args.file)
-    result = solve(problem, backend=args.backend, config=_config_from(args))
+    cfg = _config_from(args)
+    if args.supervise or args.step_timeout > 0:
+        from distributedlpsolver_tpu.supervisor import (
+            SolveFailure,
+            SupervisorConfig,
+            supervised_solve,
+        )
+
+        sup = SupervisorConfig(
+            step_timeout=args.step_timeout or None,
+            max_retries=args.max_retries,
+        )
+        try:
+            result = supervised_solve(
+                problem, backend=args.backend, config=cfg, supervisor=sup
+            )
+        except SolveFailure as e:
+            payload = {
+                "name": problem.name,
+                "status": e.status.value,
+                "error": str(e),
+                "faults": [f.asdict() for f in e.faults],
+            }
+            if args.json:
+                print(json.dumps(payload))
+            else:
+                print(f"{problem.name}: FAILED — {e}", file=sys.stderr)
+            return 3
+    else:
+        from distributedlpsolver_tpu.ipm import solve
+
+        result = solve(problem, backend=args.backend, config=cfg)
     return _report(result, args.json, args.x_out)
 
 

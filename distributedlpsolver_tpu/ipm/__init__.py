@@ -1,5 +1,24 @@
 from distributedlpsolver_tpu.ipm.config import SolverConfig
-from distributedlpsolver_tpu.ipm.state import IPMResult, IPMState, IterRecord, Status, StepStats
-from distributedlpsolver_tpu.ipm.driver import solve
+from distributedlpsolver_tpu.ipm.state import (
+    FaultKind,
+    FaultRecord,
+    IPMResult,
+    IPMState,
+    IterRecord,
+    Status,
+    StepStats,
+)
+from distributedlpsolver_tpu.ipm.driver import SolveHooks, solve
 
-__all__ = ["SolverConfig", "IPMResult", "IPMState", "IterRecord", "Status", "StepStats", "solve"]
+__all__ = [
+    "FaultKind",
+    "FaultRecord",
+    "IPMResult",
+    "IPMState",
+    "IterRecord",
+    "SolveHooks",
+    "SolverConfig",
+    "Status",
+    "StepStats",
+    "solve",
+]

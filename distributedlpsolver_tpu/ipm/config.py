@@ -123,6 +123,11 @@ class SolverConfig:
     # diagnostics
     verbose: bool = False
     log_jsonl: Optional[str] = None  # per-iteration JSONL path (SURVEY.md §5.5)
+    # fsync the JSONL stream after every record: telemetry survives a
+    # machine crash, not just a process crash (flush alone covers the
+    # latter). Off by default — a per-iteration syscall is noise next to a
+    # device step but not next to a 10ms CPU solve.
+    log_fsync: bool = False
     checkpoint_path: Optional[str] = None  # iterate checkpoint (SURVEY.md §5.4)
     checkpoint_every: int = 0  # 0 = disabled
     profile_dir: Optional[str] = None  # jax.profiler trace dir (SURVEY.md §5.1)

@@ -41,11 +41,34 @@ from distributedlpsolver_tpu.utils.logging import IterLogger
 _DIVERGE = 1e30
 
 
+class SolveHooks:
+    """Per-iteration instrumentation seam of the host loop.
+
+    The supervisor (supervisor/supervisor.py) subclasses this to run each
+    device step under a watchdog deadline and to health-check the
+    convergence scalars the moment they land on the host. Both methods may
+    raise; an exception aborts the solve (the logger still closes) and
+    propagates to the caller for classification. Hooks force the
+    per-iteration host loop — the fused on-device path has no iteration
+    boundaries to instrument.
+    """
+
+    def run_step(self, step_fn, iteration: int):
+        """Execute one device step (``step_fn`` returns (state, stats))."""
+        return step_fn()
+
+    def on_iterate(self, iteration: int, scalars: dict) -> None:
+        """Inspect the host-side scalar dict after iteration ``iteration``.
+        Called BEFORE the iterate is checkpointed, so a raising guard keeps
+        a poisoned state off disk."""
+
+
 def solve(
     problem: Union[LPProblem, InteriorForm],
     backend: Union[str, "SolverBackend"] = "tpu",
     config: Optional[SolverConfig] = None,
     warm_start: Optional[IPMState] = None,
+    hooks: Optional[SolveHooks] = None,
     **config_overrides,
 ) -> IPMResult:
     """Solve an LP to the configured duality-gap tolerance.
@@ -86,7 +109,7 @@ def solve(
         inf_solve, scaling = equilibrate(inf)
 
     be = get_backend(backend) if isinstance(backend, str) else backend
-    logger = IterLogger(cfg.verbose, cfg.log_jsonl)
+    logger = IterLogger(cfg.verbose, cfg.log_jsonl, fsync=cfg.log_fsync)
 
     def to_solver_space(host_state):
         return be.from_host(
@@ -95,7 +118,12 @@ def solve(
 
     t_setup0 = time.perf_counter()
     be.setup(inf_solve, cfg)
-    resumed = ckpt.maybe_load(cfg.checkpoint_path) if warm_start is None else None
+    fingerprint = ckpt.problem_fingerprint(inf) if cfg.checkpoint_path else ""
+    resumed = (
+        ckpt.maybe_load(cfg.checkpoint_path, fingerprint)
+        if warm_start is None
+        else None
+    )
     if warm_start is not None:
         state, start_iter = to_solver_space(warm_start), 0
     elif (
@@ -112,6 +140,8 @@ def solve(
     use_fused = cfg.fused_loop
     if use_fused is None:
         use_fused = not (cfg.checkpoint_every and cfg.checkpoint_path)
+    if hooks is not None:
+        use_fused = False  # hooks need iteration boundaries on the host
     if use_fused:
         fused = _try_fused(be, state, cfg, logger)
         if fused is not None:
@@ -134,8 +164,13 @@ def solve(
             t_it0 = time.perf_counter()
             refactor = 0
             while True:
-                new_state, stats = be.iterate(state)
-                be.block_until_ready(stats.mu)
+                if hooks is None:
+                    new_state, stats = _step_once(be, state)
+                else:
+                    step_state = state  # freeze for the deferred closure
+                    new_state, stats = hooks.run_step(
+                        lambda: _step_once(be, step_state), it + 1
+                    )
                 bad = bool(stats.bad)
                 if not bad:
                     break
@@ -152,11 +187,15 @@ def solve(
             rec = IterRecord(iter=it, t_iter=t_it, **last)
             history.append(rec)
             logger.log(rec)
+            if hooks is not None:
+                hooks.on_iterate(it, last)
             if cfg.checkpoint_every and it % cfg.checkpoint_every == 0 and cfg.checkpoint_path:
                 host_state = be.to_host(state)
                 if scaling is not None:
                     host_state = scaling.unscale_state(host_state)
-                ckpt.save_state(cfg.checkpoint_path, host_state, it, inf.name)
+                ckpt.save_state(
+                    cfg.checkpoint_path, host_state, it, inf.name, fingerprint
+                )
             if (
                 last["rel_gap"] <= cfg.tol
                 and last["pinf"] <= cfg.tol
@@ -189,6 +228,15 @@ def solve(
         inf, original, backend, start_iter, extra_iters=it - start_iter,
         scaling=scaling, presolve_info=presolve_info,
     )
+
+
+def _step_once(be, state):
+    """One synchronized device step — the unit of work the supervisor's
+    watchdog deadlines (the ``block_until_ready`` is where a hung dispatch
+    actually blocks)."""
+    new_state, stats = be.iterate(state)
+    be.block_until_ready(stats.mu)
+    return new_state, stats
 
 
 _STAT_FIELDS = (

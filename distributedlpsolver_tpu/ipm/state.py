@@ -53,6 +53,39 @@ class Status(enum.Enum):
     PRIMAL_INFEASIBLE = "primal_infeasible"
     DUAL_INFEASIBLE = "dual_infeasible"  # == primal unbounded
     STALLED = "stalled"  # no progress over the stall window (fused loop)
+    FAILED = "failed"  # supervisor exhausted its recovery ladder (supervisor/)
+
+
+class FaultKind(enum.Enum):
+    """Classification of a solve fault observed by the supervisor.
+
+    The taxonomy mirrors the production failure classes: a device dispatch
+    that never returns (``HANG``, the watchdog's deadline fired), an
+    iterate whose host-side convergence scalars went non-finite or μ
+    exploded (``NUMERICAL``), and a backend step that raised outright
+    (``CRASH``).
+    """
+
+    HANG = "hang"
+    NUMERICAL = "numerical"
+    CRASH = "crash"
+
+
+@dataclasses.dataclass
+class FaultRecord:
+    """One observed fault plus the recovery action the supervisor took."""
+
+    kind: FaultKind
+    iteration: int  # driver iteration at which the fault surfaced (-1 unknown)
+    backend: str  # backend name active when the fault occurred
+    detail: str  # human-readable cause (exception text / guard values)
+    action: str = ""  # recovery applied: rollback / reg_bump / recenter / degrade:<name> / give_up
+    at_time: float = 0.0  # unix timestamp when classified
+
+    def asdict(self):
+        d = dataclasses.asdict(self)
+        d["kind"] = self.kind.value
+        return d
 
 
 @dataclasses.dataclass
@@ -104,15 +137,21 @@ class IPMResult:
     # ray was extractable. ``certificate.certified`` distinguishes a
     # checkable proof from the divergence heuristic alone.
     certificate: Optional[object] = None
+    # Faults survived en route to this result (supervised solves only —
+    # supervisor/supervisor.py appends one FaultRecord per recovery).
+    faults: List["FaultRecord"] = dataclasses.field(default_factory=list)
 
     @property
     def iters_per_sec(self) -> float:
         return self.iterations / self.solve_time if self.solve_time > 0 else 0.0
 
     def summary(self) -> str:
-        return (
+        s = (
             f"{self.name or 'LP'}: {self.status.value} obj={self.objective:.10g} "
             f"iters={self.iterations} gap={self.rel_gap:.2e} pinf={self.pinf:.2e} "
             f"dinf={self.dinf:.2e} time={self.solve_time:.3f}s "
             f"({self.iters_per_sec:.1f} it/s) backend={self.backend}"
         )
+        if self.faults:
+            s += f" faults={len(self.faults)}"
+        return s

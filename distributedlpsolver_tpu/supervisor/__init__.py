@@ -1,0 +1,38 @@
+"""Fault-tolerant solve supervision (watchdog, rollback, degradation).
+
+Public surface: :func:`supervised_solve` wraps ``ipm.solve`` with the
+recovery ladder; :class:`SupervisorConfig` tunes it; :class:`SolveFailure`
+is the structured terminal failure; ``faults`` provides the deterministic
+injection harness that makes every recovery path CPU-testable.
+"""
+
+from distributedlpsolver_tpu.ipm.state import FaultKind, FaultRecord
+from distributedlpsolver_tpu.supervisor.faults import (
+    FaultInjector,
+    InjectedCrash,
+    InjectedFault,
+)
+from distributedlpsolver_tpu.supervisor.supervisor import (
+    IterateHealthFault,
+    SolveFailure,
+    SupervisorConfig,
+    supervised_solve,
+)
+from distributedlpsolver_tpu.supervisor.watchdog import (
+    StepDeadlineExceeded,
+    run_with_deadline,
+)
+
+__all__ = [
+    "FaultInjector",
+    "FaultKind",
+    "FaultRecord",
+    "InjectedCrash",
+    "InjectedFault",
+    "IterateHealthFault",
+    "SolveFailure",
+    "StepDeadlineExceeded",
+    "SupervisorConfig",
+    "run_with_deadline",
+    "supervised_solve",
+]

@@ -1,0 +1,109 @@
+"""Deterministic fault injection for the solve supervisor.
+
+Every recovery path in supervisor/supervisor.py must be testable on CPU in
+tier-1 — a recovery ladder that is only exercised when a real TPU wedges
+is untested code on the critical path. The injector sits at the
+supervisor's step boundary (it wraps the same ``step_fn`` the watchdog
+deadlines), so injection needs no backend cooperation and works with any
+backend:
+
+- ``FaultKind.HANG``: the wrapped step sleeps ``hang_seconds`` before
+  dispatching, so the watchdog's deadline fires exactly as it would on a
+  wedged device (the abandoned thread finishes its nap and runs the real
+  step into the void — same as an eventually-completing hung dispatch).
+- ``FaultKind.NUMERICAL``: the real step runs, then its host-bound scalars
+  are poisoned to NaN — what a silently-diverged factorization looks like
+  from the host.
+- ``FaultKind.CRASH``: the step raises :class:`InjectedCrash` — the
+  "whole program class crashes the worker" failure (ROUND5_NOTES.md:
+  batched PCG chunk≥256, storm ≥100k).
+
+Injection is keyed on the driver iteration number (1-based, as logged) and
+optionally on the backend name, and each fault fires a bounded number of
+``times`` — counts persist across the supervisor's retries, which is what
+makes "NaN at iteration 5, once" produce exactly one fault and a clean
+re-solve, while ``times=None`` models a persistently broken backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, List, Optional
+
+from distributedlpsolver_tpu.ipm.state import FaultKind
+
+
+class InjectedCrash(RuntimeError):
+    """Raised by an injected CRASH fault (stands in for a worker crash)."""
+
+
+@dataclasses.dataclass
+class InjectedFault:
+    """One scheduled fault."""
+
+    kind: FaultKind
+    iteration: int  # driver iteration (1-based) at which to fire
+    backend: Optional[str] = None  # only fire when this backend is active
+    times: Optional[int] = 1  # firings allowed; None = every time it matches
+    hang_seconds: float = 30.0  # HANG: how long the dispatch blocks
+
+
+class FaultInjector:
+    """Stateful executor of a fault plan (the plan is just the list).
+
+    One injector instance lives for the whole supervised solve, so
+    ``times`` budgets span retries and backend degradations.
+    """
+
+    def __init__(self, plan: List[InjectedFault]):
+        self._plan = list(plan)
+        self._fired: List[int] = [0] * len(self._plan)
+
+    def _match(self, iteration: int, backend: str) -> Optional[int]:
+        for i, f in enumerate(self._plan):
+            if f.iteration != iteration:
+                continue
+            if f.backend is not None and f.backend != backend:
+                continue
+            if f.times is not None and self._fired[i] >= f.times:
+                continue
+            return i
+        return None
+
+    def wrap_step(
+        self, step_fn: Callable, iteration: int, backend: str
+    ) -> Callable:
+        """Return ``step_fn`` or a faulting wrapper of it, and consume one
+        firing from the matched fault's budget."""
+        i = self._match(iteration, backend)
+        if i is None:
+            return step_fn
+        self._fired[i] += 1
+        fault = self._plan[i]
+        if fault.kind is FaultKind.CRASH:
+
+            def _crash():
+                err = InjectedCrash(
+                    f"injected step crash at iteration {iteration} "
+                    f"on backend {backend!r}"
+                )
+                err.iteration = iteration  # supervisor reads it for FaultRecord
+                raise err
+
+            return _crash
+        if fault.kind is FaultKind.HANG:
+
+            def _hang():
+                time.sleep(fault.hang_seconds)
+                return step_fn()
+
+            return _hang
+
+        def _poison():
+            new_state, stats = step_fn()
+            nan = math.nan
+            return new_state, stats._replace(mu=nan, gap=nan, rel_gap=nan)
+
+        return _poison

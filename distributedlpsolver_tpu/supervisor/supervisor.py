@@ -1,0 +1,284 @@
+"""Solve supervisor: watchdog + health guards + rollback-and-degrade.
+
+Wraps ``ipm.driver.solve`` in a fault-tolerance loop so a solve survives
+the failure classes a benchmark artifact can ignore but a serving system
+cannot (ROUND5_NOTES.md: a hung dispatch wedging a worker for ≥1h two
+iterations from optimal; program classes that crash the worker outright):
+
+1. **Dispatch watchdog** — every device step runs under a deadline
+   (supervisor/watchdog.py); a step that blows it is ``FaultKind.HANG``.
+2. **Iterate health guards** — the host-side convergence scalars are
+   checked every iteration; non-finite values or exploding μ are
+   ``FaultKind.NUMERICAL`` before the driver grinds on a poisoned iterate.
+3. **Recovery ladder** — on any fault the supervisor rolls back to the
+   last good checkpoint and retries with exponential backoff, escalating
+   per backend: plain rollback → rollback + regularization bump →
+   re-center (fresh well-centered starting point) → degrade to the next
+   backend in ``backends.auto.DEGRADATION_CHAIN``. When the ladder and the
+   retry budget are both exhausted it raises a structured
+   :class:`SolveFailure` carrying the ordered fault history — never a
+   silent wedge, never a bare traceback.
+
+Rollback reuses the existing checkpoint machinery (utils/checkpoint.py):
+the supervisor forces per-iteration checkpointing to a (temp, unless
+configured) path, and each retry resumes through the driver's normal
+checkpoint-resume path — fingerprint-guarded, so a rollback can never
+resume into a different problem's iterate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import time
+from typing import List, Optional, Union
+
+import numpy as np
+
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.driver import SolveHooks, solve
+from distributedlpsolver_tpu.ipm.state import (
+    FaultKind,
+    FaultRecord,
+    IPMResult,
+    Status,
+)
+from distributedlpsolver_tpu.supervisor.faults import FaultInjector, InjectedFault
+from distributedlpsolver_tpu.supervisor.watchdog import (
+    StepDeadlineExceeded,
+    run_with_deadline,
+)
+
+
+class IterateHealthFault(RuntimeError):
+    """An iterate's host-side scalars failed the health guard."""
+
+    def __init__(self, iteration: int, detail: str):
+        self.iteration = iteration
+        super().__init__(f"iteration {iteration}: {detail}")
+
+
+class SolveFailure(RuntimeError):
+    """Terminal supervisor outcome: recovery exhausted.
+
+    Carries the full ordered fault history (``faults``) so a post-mortem
+    reads what happened and what was tried without log spelunking.
+    """
+
+    def __init__(self, faults: List[FaultRecord], detail: str):
+        self.faults = list(faults)
+        self.status = Status.FAILED
+        trail = " -> ".join(
+            f"{f.kind.value}@it{f.iteration}[{f.backend}]" for f in faults
+        )
+        super().__init__(f"{detail}; fault history: {trail or '(none)'}")
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """Tunables of the fault-tolerance loop (CLI: --supervise flags)."""
+
+    # Watchdog deadline per device step, seconds. None/0 disables the
+    # watchdog (guards and crash recovery still run). Size it ~10× the
+    # expected step time: a 15 s/iter 10k endgame wants ~180 s, a CPU test
+    # problem 0.5 s.
+    step_timeout: Optional[float] = None
+    max_retries: int = 6  # total recovery attempts before SolveFailure
+    snapshot_every: int = 1  # rollback checkpoint cadence (iterations)
+    backoff_base: float = 0.05  # seconds; doubles per fault
+    backoff_max: float = 5.0
+    mu_limit: float = 1e30  # exploding-μ guard threshold
+    reg_bump: float = 1e4  # regularization multiplier on the bump rung
+    degrade: bool = True  # allow backend degradation
+    # Rollback checkpoint path; None = a temp file, removed on success.
+    checkpoint_path: Optional[str] = None
+    # Deterministic fault injection (tests): a list of InjectedFault.
+    fault_plan: Optional[List[InjectedFault]] = None
+
+
+# Ladder rungs per backend, in escalation order.
+_RUNG_ROLLBACK, _RUNG_REG_BUMP, _RUNG_RECENTER = 0, 1, 2
+
+_GUARDED_SCALARS = ("mu", "gap", "rel_gap", "pinf", "dinf", "pobj", "dobj")
+
+
+class _SupervisorHooks(SolveHooks):
+    """Watchdog + health guard + injection at the driver's step seam."""
+
+    def __init__(
+        self,
+        backend: str,
+        step_timeout: Optional[float],
+        mu_limit: float,
+        injector: Optional[FaultInjector],
+    ):
+        self.backend = backend
+        self.step_timeout = step_timeout
+        self.mu_limit = mu_limit
+        self.injector = injector
+
+    def run_step(self, step_fn, iteration: int):
+        if self.injector is not None:
+            step_fn = self.injector.wrap_step(step_fn, iteration, self.backend)
+        return run_with_deadline(step_fn, self.step_timeout, iteration)
+
+    def on_iterate(self, iteration: int, scalars: dict) -> None:
+        bad = [
+            k
+            for k in _GUARDED_SCALARS
+            if not np.isfinite(scalars.get(k, np.nan))
+        ]
+        if bad:
+            raise IterateHealthFault(
+                iteration,
+                f"non-finite scalars {bad} "
+                f"(mu={scalars.get('mu')!r})",
+            )
+        if scalars["mu"] > self.mu_limit:
+            raise IterateHealthFault(
+                iteration, f"mu={scalars['mu']:.3e} exceeds {self.mu_limit:g}"
+            )
+
+
+def supervised_solve(
+    problem,
+    backend: Union[str, object] = "auto",
+    config: Optional[SolverConfig] = None,
+    supervisor: Optional[SupervisorConfig] = None,
+    warm_start=None,
+    **config_overrides,
+) -> IPMResult:
+    """Solve under the supervisor; same contract as ``ipm.solve`` plus
+    fault tolerance. Returns an :class:`IPMResult` whose ``faults`` lists
+    every fault survived, or raises :class:`SolveFailure` when the
+    recovery ladder and retry budget are exhausted. Terminal non-OPTIMAL
+    statuses that are *answers* (infeasible, unbounded, iteration limit)
+    return as-is — only faults trigger recovery.
+    """
+    sup = supervisor or SupervisorConfig()
+    base_cfg = config or SolverConfig()
+    if config_overrides:
+        base_cfg = base_cfg.replace(**config_overrides)
+
+    tmpdir = None
+    ckpt_path = sup.checkpoint_path or base_cfg.checkpoint_path
+    if not ckpt_path:
+        tmpdir = tempfile.mkdtemp(prefix="dlps-supervisor-")
+        ckpt_path = os.path.join(tmpdir, "rollback.npz")
+    base_cfg = base_cfg.replace(
+        checkpoint_path=ckpt_path,
+        checkpoint_every=base_cfg.checkpoint_every or sup.snapshot_every,
+        fused_loop=False,  # supervision needs per-iteration boundaries
+    )
+
+    current = backend if isinstance(backend, str) else getattr(backend, "name", "custom")
+    injector = FaultInjector(sup.fault_plan) if sup.fault_plan else None
+    faults: List[FaultRecord] = []
+    attempt_cfg = base_cfg
+    rung = 0
+
+    try:
+        while True:
+            hooks = _SupervisorHooks(
+                current, sup.step_timeout, sup.mu_limit, injector
+            )
+            fault = None
+            try:
+                result = solve(
+                    problem,
+                    backend=current,
+                    config=attempt_cfg,
+                    warm_start=warm_start,
+                    hooks=hooks,
+                )
+                if result.status is not Status.NUMERICAL_ERROR:
+                    result.faults = faults
+                    return result
+                fault = FaultRecord(
+                    FaultKind.NUMERICAL,
+                    result.iterations,
+                    current,
+                    "driver returned numerical_error "
+                    "(regularization headroom exhausted)",
+                )
+            except StepDeadlineExceeded as e:
+                fault = FaultRecord(FaultKind.HANG, e.iteration, current, str(e))
+            except IterateHealthFault as e:
+                fault = FaultRecord(
+                    FaultKind.NUMERICAL, e.iteration, current, str(e)
+                )
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                fault = FaultRecord(
+                    FaultKind.CRASH,
+                    getattr(e, "iteration", -1),
+                    current,
+                    f"{type(e).__name__}: {e}",
+                )
+            fault.at_time = time.time()
+            faults.append(fault)
+            warm_start = None  # retries resume via the rollback checkpoint
+
+            if len(faults) > sup.max_retries:
+                fault.action = "give_up"
+                raise SolveFailure(
+                    faults, f"retry budget ({sup.max_retries}) exhausted"
+                )
+
+            # Escalation ladder for the current backend.
+            if rung == _RUNG_ROLLBACK:
+                fault.action = "rollback"
+            elif rung == _RUNG_REG_BUMP:
+                fault.action = "rollback+reg_bump"
+                attempt_cfg = attempt_cfg.replace(
+                    reg_primal=attempt_cfg.reg_primal * sup.reg_bump,
+                    reg_dual=attempt_cfg.reg_dual * sup.reg_bump,
+                )
+            elif rung == _RUNG_RECENTER:
+                fault.action = "recenter"
+                _remove_quiet(ckpt_path)  # fresh, well-centered start
+            else:
+                nxt = _next_backend(current, faults) if sup.degrade else None
+                if nxt is None:
+                    fault.action = "give_up"
+                    raise SolveFailure(
+                        faults,
+                        f"recovery ladder exhausted on backend {current!r} "
+                        "and no degradation target remains",
+                    )
+                fault.action = f"degrade:{nxt}"
+                current = nxt
+                attempt_cfg = base_cfg  # reset reg escalation on a new backend
+                rung = -1  # restart the ladder for the new backend
+            rung += 1
+            _backoff(sup, len(faults))
+    finally:
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _next_backend(current: str, faults: List[FaultRecord]) -> Optional[str]:
+    from distributedlpsolver_tpu.backends.auto import degradation_chain
+
+    tried = {f.backend for f in faults} | {current}
+    for name in degradation_chain(current):
+        if name not in tried:
+            return name
+    return None
+
+
+def _backoff(sup: SupervisorConfig, n_faults: int) -> None:
+    if sup.backoff_base > 0:
+        time.sleep(
+            min(sup.backoff_max, sup.backoff_base * 2 ** (n_faults - 1))
+        )
+
+
+def _remove_quiet(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass

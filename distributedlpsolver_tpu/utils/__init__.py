@@ -1,4 +1,17 @@
-from distributedlpsolver_tpu.utils.checkpoint import load_state, save_state
+from distributedlpsolver_tpu.utils.checkpoint import (
+    CheckpointMismatch,
+    load_state,
+    maybe_load,
+    problem_fingerprint,
+    save_state,
+)
 from distributedlpsolver_tpu.utils.logging import IterLogger
 
-__all__ = ["IterLogger", "save_state", "load_state"]
+__all__ = [
+    "CheckpointMismatch",
+    "IterLogger",
+    "load_state",
+    "maybe_load",
+    "problem_fingerprint",
+    "save_state",
+]

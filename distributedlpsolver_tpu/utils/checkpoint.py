@@ -5,27 +5,68 @@ plain ``.npz`` with atomic rename is the honest mechanism; no Orbax
 machinery is warranted for five vectors. The driver writes every
 ``config.checkpoint_every`` iterations and :func:`load_state` lets a solve
 resume with ``warm_start=``.
+
+Format v2 hardening: each checkpoint carries a format version and a
+*problem fingerprint* (shapes + a hash of the c/b bytes of the interior
+form it was taken from). :func:`load_state` refuses to hand a checkpoint
+from a different problem to a resume — the failure mode it closes is a
+stale ``--checkpoint`` path silently seeding a solve with another LP's
+iterate (shape-coincident garbage converges to the wrong answer; a shape
+mismatch merely crashes later and uglier). v1 checkpoints (no
+version/fingerprint fields) still load.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
+import warnings
 from typing import Optional, Tuple
 
 import numpy as np
 
 from distributedlpsolver_tpu.ipm.state import IPMState
 
+CKPT_FORMAT_VERSION = 2
 
-def save_state(path: str, state: IPMState, iteration: int, name: str = "") -> None:
+
+class CheckpointMismatch(RuntimeError):
+    """Checkpoint belongs to a different problem (fingerprint conflict) or
+    was written by a newer, unreadable format version."""
+
+
+def problem_fingerprint(inf) -> str:
+    """Stable identity of an interior-form problem: (m, n) plus a SHA-256
+    over the c and b bytes (f64-normalized so dtype does not perturb it)."""
+    h = hashlib.sha256()
+    h.update(f"{int(inf.m)}x{int(inf.n)}".encode())
+    for v in (inf.c, inf.b):
+        h.update(np.ascontiguousarray(np.asarray(v, dtype=np.float64)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def save_state(
+    path: str,
+    state: IPMState,
+    iteration: int,
+    name: str = "",
+    fingerprint: str = "",
+) -> None:
     arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as fh:
-            np.savez(fh, iteration=iteration, name=name, **arrays)
+            np.savez(
+                fh,
+                iteration=iteration,
+                name=name,
+                version=CKPT_FORMAT_VERSION,
+                fingerprint=fingerprint,
+                **arrays,
+            )
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -33,13 +74,40 @@ def save_state(path: str, state: IPMState, iteration: int, name: str = "") -> No
         raise
 
 
-def load_state(path: str) -> Tuple[IPMState, int, str]:
+def load_state(
+    path: str, expected_fingerprint: Optional[str] = None
+) -> Tuple[IPMState, int, str]:
+    """Load a checkpoint; raises :class:`CheckpointMismatch` when
+    ``expected_fingerprint`` is given and conflicts with the stored one.
+    A v1 checkpoint has no fingerprint and is accepted as-is."""
     with np.load(path, allow_pickle=False) as data:
+        version = int(data["version"]) if "version" in data else 1
+        if version > CKPT_FORMAT_VERSION:
+            raise CheckpointMismatch(
+                f"{path}: checkpoint format v{version} is newer than this "
+                f"reader (v{CKPT_FORMAT_VERSION})"
+            )
+        stored = str(data["fingerprint"]) if "fingerprint" in data else ""
+        if expected_fingerprint and stored and stored != expected_fingerprint:
+            raise CheckpointMismatch(
+                f"{path}: checkpoint fingerprint {stored} does not match the "
+                f"problem being solved ({expected_fingerprint}) — refusing to "
+                f"resume from a different problem's iterate"
+            )
         state = IPMState(*(data[f] for f in IPMState._fields))
         return state, int(data["iteration"]), str(data["name"])
 
 
-def maybe_load(path: Optional[str]) -> Optional[Tuple[IPMState, int, str]]:
+def maybe_load(
+    path: Optional[str], expected_fingerprint: Optional[str] = None
+) -> Optional[Tuple[IPMState, int, str]]:
+    """Resume helper: None when no checkpoint exists; a fingerprint
+    mismatch warns and returns None (fresh start, the path is about to be
+    overwritten by this solve's own checkpoints) rather than raising."""
     if path and os.path.exists(path):
-        return load_state(path)
+        try:
+            return load_state(path, expected_fingerprint)
+        except CheckpointMismatch as e:
+            warnings.warn(f"ignoring checkpoint: {e}", stacklevel=2)
+            return None
     return None

@@ -9,6 +9,7 @@ and an optional JSONL stream, one record per iteration.
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional, TextIO
 
 from distributedlpsolver_tpu.ipm.state import IterRecord
@@ -20,9 +21,27 @@ _HEADER = (
 
 
 class IterLogger:
-    def __init__(self, verbose: bool = False, jsonl_path: Optional[str] = None):
+    """Per-iteration metric emitter.
+
+    Each JSONL record is written as ONE ``write`` call and flushed
+    immediately, so a solve killed mid-iteration (watchdog timeout, OOM
+    kill, SIGKILL) leaves a complete, parseable telemetry file for
+    post-mortem — the one consumer that matters for the crash log is the
+    run that did NOT reach ``close()``. ``fsync=True`` additionally forces
+    each record to stable storage (survives a machine crash, not just a
+    process crash) at a per-iteration syscall cost that is noise next to a
+    device step.
+    """
+
+    def __init__(
+        self,
+        verbose: bool = False,
+        jsonl_path: Optional[str] = None,
+        fsync: bool = False,
+    ):
         self.verbose = verbose
         self._fh: Optional[TextIO] = open(jsonl_path, "w") if jsonl_path else None
+        self._fsync = fsync
         self._printed_header = False
 
     def log(self, rec: IterRecord) -> None:
@@ -39,8 +58,11 @@ class IterLogger:
         if self._fh:
             self._fh.write(json.dumps(rec.asdict()) + "\n")
             self._fh.flush()
+            if self._fsync:
+                os.fsync(self._fh.fileno())
 
     def close(self) -> None:
         if self._fh:
+            self._fh.flush()
             self._fh.close()
             self._fh = None

@@ -1,0 +1,145 @@
+"""Checkpoint hardening and resume-equivalence coverage.
+
+Satellites of the supervisor PR: (1) a checkpoint carries a format version
+and a problem fingerprint, and refuses to resume a different problem;
+(2) interrupt-at-k + resume reproduces the uninterrupted solve's final
+objective and status to 1e-10 — the property the supervisor's rollback
+correctness rests on.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import SolverConfig, Status, solve
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+from distributedlpsolver_tpu.models.problem import to_interior_form
+from distributedlpsolver_tpu.utils import checkpoint as ckpt
+from distributedlpsolver_tpu.utils.logging import IterLogger
+
+
+def _solve_kwargs(path=None):
+    kw = dict(backend="cpu", fused_loop=False)
+    if path:
+        kw.update(checkpoint_path=str(path), checkpoint_every=1)
+    return kw
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_resume_matches_uninterrupted_to_1e10(tmp_path, backend):
+    """Solve to iteration k, checkpoint, resume in a fresh driver: the
+    final objective and status match the uninterrupted solve to 1e-10."""
+    p = random_dense_lp(25, 60, seed=9)
+    full = solve(p, backend=backend, fused_loop=False)
+    assert full.status == Status.OPTIMAL
+
+    ck = str(tmp_path / f"resume-{backend}.npz")
+    k = max(2, full.iterations // 2)
+    interrupted = solve(
+        p,
+        backend=backend,
+        fused_loop=False,
+        checkpoint_path=ck,
+        checkpoint_every=1,
+        max_iter=k,
+    )
+    assert interrupted.status == Status.ITERATION_LIMIT
+    assert interrupted.iterations == k
+
+    resumed = solve(
+        p,
+        backend=backend,
+        fused_loop=False,
+        checkpoint_path=ck,
+        checkpoint_every=1,
+    )
+    assert resumed.status == full.status
+    assert abs(resumed.objective - full.objective) <= 1e-10 * (
+        1.0 + abs(full.objective)
+    )
+    # The resumed run continued from k rather than restarting.
+    assert resumed.iterations < full.iterations
+
+
+def test_checkpoint_carries_version_and_fingerprint(tmp_path):
+    p = random_dense_lp(20, 45, seed=3)
+    ck = str(tmp_path / "c.npz")
+    solve(p, max_iter=3, **_solve_kwargs(ck))
+    with np.load(ck, allow_pickle=False) as data:
+        assert int(data["version"]) == ckpt.CKPT_FORMAT_VERSION
+        fp = str(data["fingerprint"])
+    assert fp == ckpt.problem_fingerprint(to_interior_form(p))
+    # load_state accepts the matching fingerprint...
+    state, it, name = ckpt.load_state(ck, expected_fingerprint=fp)
+    assert it == 3
+    # ...and rejects a conflicting one.
+    with pytest.raises(ckpt.CheckpointMismatch):
+        ckpt.load_state(ck, expected_fingerprint="deadbeefdeadbeef")
+
+
+def test_driver_ignores_checkpoint_from_different_problem(tmp_path):
+    """A stale --checkpoint path from another LP must not seed the solve:
+    the driver warns, starts fresh, and still reaches the right optimum."""
+    ck = str(tmp_path / "stale.npz")
+    solve(random_dense_lp(20, 45, seed=3), max_iter=4, **_solve_kwargs(ck))
+
+    other = random_dense_lp(20, 45, seed=4)  # same shapes, different problem
+    reference = solve(other, **_solve_kwargs())
+    with pytest.warns(UserWarning, match="fingerprint"):
+        r = solve(other, **_solve_kwargs(ck))
+    assert r.status == Status.OPTIMAL
+    np.testing.assert_allclose(r.objective, reference.objective, rtol=1e-8)
+    # The run overwrote the stale file with its own fingerprint.
+    with np.load(ck, allow_pickle=False) as data:
+        assert str(data["fingerprint"]) == ckpt.problem_fingerprint(
+            to_interior_form(other)
+        )
+
+
+def test_v1_checkpoint_still_loads(tmp_path):
+    """Pre-hardening checkpoints (no version/fingerprint) stay readable."""
+    from distributedlpsolver_tpu.ipm.state import IPMState
+
+    state = IPMState(*(np.full(4, float(i + 1)) for i in range(5)))
+    path = tmp_path / "v1.npz"
+    np.savez(
+        path,
+        iteration=7,
+        name="legacy",
+        **{f: np.asarray(getattr(state, f)) for f in state._fields},
+    )
+    loaded, it, name = ckpt.load_state(
+        str(path), expected_fingerprint="anything"
+    )
+    assert it == 7 and name == "legacy"
+    np.testing.assert_array_equal(loaded.x, state.x)
+
+
+def test_future_version_rejected(tmp_path):
+    path = tmp_path / "future.npz"
+    np.savez(path, iteration=1, name="n", version=99, fingerprint="ab")
+    with pytest.raises(ckpt.CheckpointMismatch, match="newer"):
+        ckpt.load_state(str(path))
+
+
+def test_jsonl_complete_without_close(tmp_path):
+    """Every record is flushed as it is written: a logger that never
+    reaches close() (crashed/killed solve) still leaves complete JSONL."""
+    from distributedlpsolver_tpu.ipm.state import IterRecord
+
+    path = tmp_path / "log.jsonl"
+    logger = IterLogger(verbose=False, jsonl_path=str(path), fsync=True)
+    for i in range(3):
+        logger.log(
+            IterRecord(
+                iter=i + 1, mu=1.0, gap=1.0, rel_gap=1.0, pinf=0.0,
+                dinf=0.0, alpha_p=0.5, alpha_d=0.5, sigma=0.1,
+                pobj=1.0, dobj=0.0, t_iter=0.01,
+            )
+        )
+    # Read back BEFORE close: all three records must be on disk, parseable.
+    lines = path.read_text().splitlines()
+    assert len(lines) == 3
+    assert [json.loads(l)["iter"] for l in lines] == [1, 2, 3]
+    logger.close()

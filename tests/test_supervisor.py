@@ -1,0 +1,218 @@
+"""Supervisor recovery-ladder tests via deterministic fault injection.
+
+Every recovery path runs end-to-end on CPU (ISSUE acceptance): hang →
+watchdog timeout → retry; NaN → rollback → re-center → OPTIMAL; persistent
+backend crash → degradation chain → OPTIMAL on the fallback; retries
+exhausted → structured SolveFailure with the ordered fault history. No
+test waits out an injected hang — the watchdog deadline bounds every wait.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import Status, solve
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+from distributedlpsolver_tpu.supervisor import (
+    FaultKind,
+    InjectedCrash,
+    InjectedFault,
+    SolveFailure,
+    StepDeadlineExceeded,
+    SupervisorConfig,
+    run_with_deadline,
+    supervised_solve,
+)
+
+pytestmark = pytest.mark.faults
+
+# Small, strictly feasible+bounded by construction: ~12 IPM iterations on
+# any backend, so injection iterations 1-5 always exist.
+_PROBLEM = dict(m=20, n=45, seed=3)
+
+
+def _problem():
+    return random_dense_lp(**_PROBLEM)
+
+
+def _sup(**kw):
+    kw.setdefault("backoff_base", 0.001)
+    return SupervisorConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def reference_result():
+    return solve(_problem(), backend="cpu", fused_loop=False)
+
+
+# ----------------------------------------------------------- watchdog unit
+class TestWatchdog:
+    def test_passthrough_value(self):
+        assert run_with_deadline(lambda: 42, 5.0) == 42
+
+    def test_disabled_timeout_direct_call(self):
+        assert run_with_deadline(lambda: "x", None) == "x"
+        assert run_with_deadline(lambda: "x", 0) == "x"
+
+    def test_exception_reraises_on_caller(self):
+        with pytest.raises(ValueError, match="boom"):
+            run_with_deadline(lambda: (_ for _ in ()).throw(ValueError("boom")), 5.0)
+
+    def test_deadline_fires_within_2x(self):
+        deadline = 0.2
+        t0 = time.perf_counter()
+        with pytest.raises(StepDeadlineExceeded):
+            run_with_deadline(lambda: time.sleep(10 * deadline), deadline, iteration=7)
+        assert time.perf_counter() - t0 < 2 * deadline
+
+
+# --------------------------------------------------------- recovery paths
+def test_no_faults_is_passthrough(reference_result):
+    r = supervised_solve(_problem(), backend="cpu", supervisor=_sup())
+    assert r.status == Status.OPTIMAL
+    assert r.faults == []
+    np.testing.assert_allclose(
+        r.objective, reference_result.objective, rtol=1e-8
+    )
+
+
+def test_nan_iterate_rolls_back_to_optimal(reference_result):
+    plan = [InjectedFault(FaultKind.NUMERICAL, iteration=5)]
+    r = supervised_solve(
+        _problem(), backend="cpu", supervisor=_sup(fault_plan=plan)
+    )
+    assert r.status == Status.OPTIMAL
+    assert [f.kind for f in r.faults] == [FaultKind.NUMERICAL]
+    assert r.faults[0].iteration == 5
+    assert r.faults[0].action == "rollback"
+    np.testing.assert_allclose(
+        r.objective, reference_result.objective, rtol=1e-6
+    )
+
+
+def test_nan_escalates_through_recenter():
+    """Three NaNs at the same iteration walk the full per-backend ladder:
+    rollback, then reg bump, then re-center — and still reach OPTIMAL."""
+    plan = [InjectedFault(FaultKind.NUMERICAL, iteration=4, times=3)]
+    r = supervised_solve(
+        _problem(), backend="cpu", supervisor=_sup(fault_plan=plan)
+    )
+    assert r.status == Status.OPTIMAL
+    assert [f.action for f in r.faults] == [
+        "rollback",
+        "rollback+reg_bump",
+        "recenter",
+    ]
+
+
+def test_hang_watchdog_timeout_then_retry(reference_result):
+    deadline = 0.25
+    plan = [
+        InjectedFault(FaultKind.HANG, iteration=3, hang_seconds=20 * deadline)
+    ]
+    t0 = time.perf_counter()
+    r = supervised_solve(
+        _problem(),
+        backend="cpu",
+        supervisor=_sup(fault_plan=plan, step_timeout=deadline),
+    )
+    elapsed = time.perf_counter() - t0
+    assert r.status == Status.OPTIMAL
+    assert [f.kind for f in r.faults] == [FaultKind.HANG]
+    assert r.faults[0].iteration == 3
+    # The watchdog abandoned the hang instead of waiting it out: total
+    # wall time is far below the injected 5 s hang.
+    assert elapsed < 10 * deadline
+    np.testing.assert_allclose(
+        r.objective, reference_result.objective, rtol=1e-6
+    )
+
+
+def test_persistent_crash_degrades_backend(reference_result):
+    """A backend that crashes every attempt climbs the ladder, then the
+    supervisor degrades along backends.auto.DEGRADATION_CHAIN and the
+    fallback backend finishes the solve."""
+    plan = [
+        InjectedFault(
+            FaultKind.CRASH, iteration=1, backend="tpu", times=None
+        )
+    ]
+    r = supervised_solve(
+        _problem(),
+        backend="tpu",
+        supervisor=_sup(fault_plan=plan, max_retries=8),
+    )
+    assert r.status == Status.OPTIMAL
+    assert r.backend == "cpu-sparse"  # first chain entry after "tpu"
+    assert [f.kind for f in r.faults] == [FaultKind.CRASH] * 4
+    assert r.faults[-1].action == "degrade:cpu-sparse"
+    np.testing.assert_allclose(
+        r.objective, reference_result.objective, rtol=1e-6
+    )
+
+
+def test_retries_exhausted_raises_structured_failure():
+    plan = [InjectedFault(FaultKind.CRASH, iteration=1, times=None)]
+    with pytest.raises(SolveFailure) as ei:
+        supervised_solve(
+            _problem(),
+            backend="cpu",
+            supervisor=_sup(fault_plan=plan, max_retries=3),
+        )
+    e = ei.value
+    assert e.status == Status.FAILED
+    # max_retries recoveries were attempted; the (max_retries+1)-th fault
+    # is terminal — the history holds all of them, in order.
+    assert len(e.faults) == 4
+    assert all(f.kind == FaultKind.CRASH for f in e.faults)
+    assert e.faults[-1].action == "give_up"
+    assert "InjectedCrash" in e.faults[0].detail
+    assert "fault history" in str(e)
+
+
+def test_ladder_exhausted_without_degradation_raises():
+    plan = [InjectedFault(FaultKind.CRASH, iteration=1, times=None)]
+    with pytest.raises(SolveFailure) as ei:
+        supervised_solve(
+            _problem(),
+            backend="cpu",
+            supervisor=_sup(fault_plan=plan, max_retries=20, degrade=False),
+        )
+    # rollback, reg bump, recenter, then no rung left: 4 faults total.
+    assert len(ei.value.faults) == 4
+    assert ei.value.faults[-1].action == "give_up"
+
+
+def test_terminal_answers_are_not_retried():
+    """ITERATION_LIMIT is an answer, not a fault — no recovery attempts."""
+    r = supervised_solve(
+        _problem(), backend="cpu", supervisor=_sup(), max_iter=3
+    )
+    assert r.status == Status.ITERATION_LIMIT
+    assert r.faults == []
+
+
+# ------------------------------------------------------------- injection
+class TestFaultInjector:
+    def test_times_budget_persists_across_wraps(self):
+        from distributedlpsolver_tpu.supervisor import FaultInjector
+
+        inj = FaultInjector(
+            [InjectedFault(FaultKind.CRASH, iteration=2, times=1)]
+        )
+        ok = lambda: ("state", "stats")
+        assert inj.wrap_step(ok, 1, "cpu") is ok  # wrong iteration
+        with pytest.raises(InjectedCrash):
+            inj.wrap_step(ok, 2, "cpu")()  # fires
+        assert inj.wrap_step(ok, 2, "cpu") is ok  # budget consumed
+
+    def test_backend_filter(self):
+        from distributedlpsolver_tpu.supervisor import FaultInjector
+
+        inj = FaultInjector(
+            [InjectedFault(FaultKind.CRASH, iteration=1, backend="tpu")]
+        )
+        ok = lambda: None
+        assert inj.wrap_step(ok, 1, "cpu") is ok
+        assert inj.wrap_step(ok, 1, "tpu") is not ok
