@@ -33,7 +33,12 @@ _SMALL_ENTRIES = 200_000
 # Supervisor degradation order (supervisor/supervisor.py): each step trades
 # throughput for independence from whatever the faulting layer was —
 # multi-device sharding → single-device dense → CPU sparse-direct → plain
-# CPU numpy, which shares no device runtime at all.
+# CPU numpy, which shares no device runtime at all. Note that a mesh
+# backend gets one rung ABOVE this chain: on device loss (or hangs the
+# health probe pins to a shard) the supervisor first tries to SHRINK the
+# mesh over the surviving devices (backend.reshard on
+# parallel.mesh.reform_mesh) — dropping one participant of a healthy pod
+# beats abandoning the pod for a single device or the CPU.
 DEGRADATION_CHAIN = ("sharded", "tpu", "cpu-sparse", "cpu")
 
 
@@ -143,3 +148,13 @@ class AutoBackend(SolverBackend):
 
     def block_until_ready(self, obj) -> None:
         self._inner.block_until_ready(obj)
+
+    @property
+    def mesh(self):
+        return getattr(self._inner, "mesh", None) if self._inner else None
+
+    def reshard(self, mesh):
+        # The auto decision already happened at setup; a shrink re-places
+        # the CHOSEN backend — returning the inner reshard (not a fresh
+        # AutoBackend) keeps the new mesh from being second-guessed.
+        return self._inner.reshard(mesh) if self._inner else None

@@ -36,9 +36,24 @@ class SolverBackend(abc.ABC):
 
     name: str = "abstract"
 
+    # Device mesh this backend executes over, or None for single-device /
+    # host backends. Mesh-placed backends expose theirs so the supervisor
+    # can probe participants and re-form a smaller mesh on device loss.
+    mesh = None
+
     @abc.abstractmethod
     def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
         """Move problem data to the execution target; build/compile kernels."""
+
+    def reshard(self, mesh) -> "SolverBackend | None":
+        """Return a FRESH backend of this kind placed on ``mesh`` (elastic
+        recovery: the supervisor re-forms a smaller mesh after device loss
+        and resumes on the survivors), or None when this backend cannot be
+        re-placed — the supervisor then falls through to backend
+        degradation. The returned instance is un-setup; the driver's
+        normal ``setup`` re-shards the problem data onto the new layout
+        and ``from_host`` re-places the checkpointed iterate."""
+        return None
 
     @abc.abstractmethod
     def starting_point(self) -> IPMState:

@@ -90,3 +90,13 @@ class ShardedJaxBackend(DenseJaxBackend):
     @property
     def mesh(self) -> jax.sharding.Mesh:
         return self._mesh
+
+    def reshard(self, mesh: jax.sharding.Mesh) -> "ShardedJaxBackend":
+        """Fresh instance of this backend on ``mesh`` — the elastic
+        recovery seam. Everything layout-dependent (padding to the mesh
+        multiple, array placement, the compiled step's GSPMD partition)
+        is derived in ``setup``/``from_host`` from the mesh alone, so
+        re-placement is just re-construction; the supervisor resumes the
+        IPM from the last host-canonical checkpoint, which ``from_host``
+        re-pads and re-shards onto the new layout."""
+        return type(self)(mesh=mesh)

@@ -72,6 +72,20 @@ def _add_solver_flags(ap: argparse.ArgumentParser) -> None:
         default=6,
         help="supervisor recovery attempts before a structured failure",
     )
+    ap.add_argument(
+        "--adaptive-timeout",
+        action="store_true",
+        help="size the watchdog deadline adaptively (10x the trailing "
+        "median step time, clamped, with compile-grace) instead of the "
+        "static --step-timeout; implies --supervise",
+    )
+    ap.add_argument(
+        "--min-devices",
+        type=int,
+        default=1,
+        help="smallest mesh the elastic SHRINK recovery may re-form "
+        "after device loss before degrading to the next backend",
+    )
 
 
 def _config_from(args) -> "SolverConfig":
@@ -128,7 +142,7 @@ def cmd_solve(args) -> int:
 
     problem = read_mps(args.file)
     cfg = _config_from(args)
-    if args.supervise or args.step_timeout > 0:
+    if args.supervise or args.step_timeout > 0 or args.adaptive_timeout:
         from distributedlpsolver_tpu.supervisor import (
             SolveFailure,
             SupervisorConfig,
@@ -137,7 +151,9 @@ def cmd_solve(args) -> int:
 
         sup = SupervisorConfig(
             step_timeout=args.step_timeout or None,
+            adaptive_timeout=args.adaptive_timeout,
             max_retries=args.max_retries,
+            min_devices=args.min_devices,
         )
         try:
             result = supervised_solve(

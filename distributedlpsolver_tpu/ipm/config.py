@@ -128,6 +128,11 @@ class SolverConfig:
     # latter). Off by default — a per-iteration syscall is noise next to a
     # device step but not next to a 10ms CPU solve.
     log_fsync: bool = False
+    # Open the JSONL stream in append mode instead of truncating: the
+    # supervisor's retries each re-enter the driver, and attempt N must
+    # not erase the telemetry (and fault/resume event records) of
+    # attempts 1..N-1. The supervisor truncates the file once up front.
+    log_append: bool = False
     checkpoint_path: Optional[str] = None  # iterate checkpoint (SURVEY.md §5.4)
     checkpoint_every: int = 0  # 0 = disabled
     profile_dir: Optional[str] = None  # jax.profiler trace dir (SURVEY.md §5.1)

@@ -109,7 +109,9 @@ def solve(
         inf_solve, scaling = equilibrate(inf)
 
     be = get_backend(backend) if isinstance(backend, str) else backend
-    logger = IterLogger(cfg.verbose, cfg.log_jsonl, fsync=cfg.log_fsync)
+    logger = IterLogger(
+        cfg.verbose, cfg.log_jsonl, fsync=cfg.log_fsync, append=cfg.log_append
+    )
 
     def to_solver_space(host_state):
         return be.from_host(
@@ -132,6 +134,11 @@ def solve(
         and resumed[0].x.shape == (inf.n,)
         and resumed[0].y.shape == (inf.m,)
     ):
+        # Checkpoints are host-canonical (utils/checkpoint.py v3):
+        # to_solver_space → backend.from_host re-pads and re-places the
+        # iterate for THIS backend's layout, so the same file resumes on
+        # a different mesh size (the elastic shrink path), a single
+        # device, or the CPU.
         state, start_iter = to_solver_space(resumed[0]), resumed[1]
     else:
         state, start_iter = be.starting_point(), 0

@@ -62,13 +62,18 @@ class FaultKind(enum.Enum):
     The taxonomy mirrors the production failure classes: a device dispatch
     that never returns (``HANG``, the watchdog's deadline fired), an
     iterate whose host-side convergence scalars went non-finite or μ
-    exploded (``NUMERICAL``), and a backend step that raised outright
-    (``CRASH``).
+    exploded (``NUMERICAL``), a backend step that raised outright
+    (``CRASH``), and a mesh participant dropping out of the runtime
+    (``DEVICE_LOST`` — a raised device-loss error, or repeated hangs the
+    health probe attributes to the same shard). ``DEVICE_LOST`` is the
+    fault class the elastic mesh-shrink rung recovers from: the surviving
+    devices re-form a smaller mesh instead of abandoning the pod.
     """
 
     HANG = "hang"
     NUMERICAL = "numerical"
     CRASH = "crash"
+    DEVICE_LOST = "device_lost"
 
 
 @dataclasses.dataclass
@@ -79,12 +84,20 @@ class FaultRecord:
     iteration: int  # driver iteration at which the fault surfaced (-1 unknown)
     backend: str  # backend name active when the fault occurred
     detail: str  # human-readable cause (exception text / guard values)
-    action: str = ""  # recovery applied: rollback / reg_bump / recenter / degrade:<name> / give_up
+    action: str = ""  # recovery applied: rollback / reg_bump / recenter / shrink:<K>-><K'> / degrade:<name> / give_up
     at_time: float = 0.0  # unix timestamp when classified
+    # Device ids implicated in this fault (DEVICE_LOST, or hangs the
+    # health probe attributed to specific shards); empty when unknown.
+    devices: tuple = ()
+    # Wall-clock seconds from fault classification to the completion of
+    # the first post-resume iteration (0.0 until the resume lands) — the
+    # recovery-path overhead a post-mortem attributes wall-clock loss to.
+    recovery_overhead_s: float = 0.0
 
     def asdict(self):
         d = dataclasses.asdict(self)
         d["kind"] = self.kind.value
+        d["devices"] = list(self.devices)
         return d
 
 

@@ -2,22 +2,34 @@ from distributedlpsolver_tpu.parallel.mesh import (
     col_sharding,
     make_hybrid_mesh,
     make_mesh,
+    reform_mesh,
     replicated,
     vec_sharding,
 )
 from distributedlpsolver_tpu.parallel.runtime import (
     init_distributed,
     is_primary,
+    probe_device,
+    probe_devices,
+    restore_devices,
+    simulate_device_loss,
+    simulated_lost_devices,
     world,
 )
 
 __all__ = [
     "make_mesh",
     "make_hybrid_mesh",
+    "reform_mesh",
     "col_sharding",
     "vec_sharding",
     "replicated",
     "init_distributed",
     "world",
     "is_primary",
+    "probe_device",
+    "probe_devices",
+    "simulate_device_loss",
+    "restore_devices",
+    "simulated_lost_devices",
 ]

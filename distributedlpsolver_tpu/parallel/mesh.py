@@ -79,6 +79,38 @@ def make_hybrid_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
+def reform_mesh(
+    mesh: Mesh,
+    exclude: Sequence = (),
+    axis_name: Optional[str] = None,
+) -> Mesh:
+    """Re-form ``mesh`` over its surviving devices (elastic recovery).
+
+    ``exclude`` lists lost participants — devices or bare device ids (the
+    health probe in parallel/runtime.py hands back devices; fault records
+    carry ids). The survivors keep their original mesh order and become a
+    1-D mesh named ``axis_name`` (default: the innermost axis of the old
+    mesh, which is where the variable axis — and the per-iteration Schur
+    all-reduce — lives). A multi-axis hybrid mesh therefore collapses to
+    1-D: after losing a device the old (dcn, ici) factorization no longer
+    tiles the survivor count, and a 1-D re-shard is always valid.
+
+    Raises ``ValueError`` when exclusion would leave no devices (the
+    caller's min-devices policy gates *how few* is acceptable; zero never
+    is).
+    """
+    exclude_ids = {
+        int(getattr(d, "id", d)) for d in exclude
+    }
+    survivors = [d for d in mesh.devices.flat if d.id not in exclude_ids]
+    if not survivors:
+        raise ValueError(
+            f"reform_mesh: excluding {sorted(exclude_ids)} leaves no devices"
+        )
+    name = axis_name or mesh.axis_names[-1]
+    return Mesh(np.array(survivors), (name,))
+
+
 def col_sharding(mesh: Mesh, axis: str = "cols") -> NamedSharding:
     """(m, n) matrix sharded along its variable (column) dimension."""
     return NamedSharding(mesh, PartitionSpec(None, axis))

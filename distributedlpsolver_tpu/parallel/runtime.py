@@ -17,9 +17,11 @@ path runs everywhere (the analogue of ``mpirun -np 1``).
 from __future__ import annotations
 
 import os
-from typing import Optional
+import threading
+from typing import List, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 
 _INITIALIZED = False
 
@@ -78,3 +80,98 @@ def world() -> dict:
 def is_primary() -> bool:
     """True on the process that should own logging/IO (rank 0)."""
     return jax.process_index() == 0
+
+
+# ----------------------------------------------------------------------
+# Per-device health probes (elastic mesh recovery).
+#
+# When the supervisor suspects device loss — a raised device-loss error,
+# or repeated watchdog timeouts — it needs to know which participants of
+# the mesh still answer before re-forming a smaller mesh over the
+# survivors (parallel/mesh.py: reform_mesh). The probe is deliberately
+# tiny: one device_put + one jitted reduction per device, each under its
+# own short wall-clock deadline, so probing an 8-device mesh costs
+# milliseconds when healthy and at most ``deadline`` per wedged device.
+#
+# There is no portable way to *make* a CPU/TPU device fail on demand, so
+# the probe also consults a process-local simulated-loss registry —
+# the seam the fault-injection harness (supervisor/faults.py) uses to
+# make device loss deterministically testable on N virtual CPU devices.
+# ----------------------------------------------------------------------
+
+# Device ids the fault injector has declared dead/wedged. Consulted by
+# probe_device before any real dispatch; empty in production.
+_SIMULATED_LOST: set = set()
+
+
+def simulate_device_loss(device_ids: Sequence[int]) -> None:
+    """Mark device ids as lost/unhealthy for this process (test harness:
+    the health probe reports them unhealthy without dispatching)."""
+    _SIMULATED_LOST.update(int(i) for i in device_ids)
+
+
+def restore_devices(device_ids: Optional[Sequence[int]] = None) -> None:
+    """Undo :func:`simulate_device_loss` (all devices when ids is None)."""
+    if device_ids is None:
+        _SIMULATED_LOST.clear()
+    else:
+        for i in device_ids:
+            _SIMULATED_LOST.discard(int(i))
+
+
+def simulated_lost_devices() -> frozenset:
+    return frozenset(_SIMULATED_LOST)
+
+
+def _run_under_deadline(fn, deadline: float) -> bool:
+    """True iff ``fn()`` returned (no exception) within ``deadline``
+    seconds. Local daemon-thread implementation — the supervisor's
+    watchdog has the same contract, but importing the supervisor package
+    from here would be circular (supervisor → parallel → supervisor)."""
+    box = {}
+
+    def _target():
+        try:
+            box["value"] = fn()
+        except BaseException as e:
+            box["error"] = e
+
+    t = threading.Thread(target=_target, daemon=True, name="dlps-probe")
+    t.start()
+    t.join(deadline)
+    return (not t.is_alive()) and ("error" not in box)
+
+
+def probe_device(device, deadline: float = 2.0) -> bool:
+    """One device's health: place a tiny buffer and run a jitted
+    reduction on it under ``deadline`` seconds of wall clock. A device
+    that raises, wedges past the deadline, or is in the simulated-loss
+    registry is unhealthy."""
+    if getattr(device, "id", None) in _SIMULATED_LOST:
+        return False
+
+    def _ping():
+        buf = jax.device_put(np.arange(4, dtype=np.float32), device)
+        out = jax.jit(lambda v: (v * v).sum())(buf)
+        jax.block_until_ready(out)
+        return out
+
+    try:
+        return _run_under_deadline(_ping, deadline)
+    except Exception:
+        return False
+
+
+def probe_devices(
+    devices: Optional[Sequence] = None, deadline: float = 2.0
+) -> Tuple[List, List]:
+    """Probe each device; returns ``(healthy, unhealthy)`` device lists.
+
+    ``devices=None`` probes every local device. The supervisor feeds the
+    unhealthy set to ``reform_mesh(exclude=...)`` to rebuild the mesh
+    over the survivors."""
+    devs = list(devices if devices is not None else jax.local_devices())
+    healthy, unhealthy = [], []
+    for d in devs:
+        (healthy if probe_device(d, deadline) else unhealthy).append(d)
+    return healthy, unhealthy

@@ -1,15 +1,21 @@
-"""Fault-tolerant solve supervision (watchdog, rollback, degradation).
+"""Fault-tolerant solve supervision (watchdog, rollback, degradation,
+elastic mesh recovery).
 
 Public surface: :func:`supervised_solve` wraps ``ipm.solve`` with the
 recovery ladder; :class:`SupervisorConfig` tunes it; :class:`SolveFailure`
-is the structured terminal failure; ``faults`` provides the deterministic
-injection harness that makes every recovery path CPU-testable.
+is the structured terminal failure; :class:`AdaptiveDeadline` sizes
+watchdog deadlines from the trailing median step time; ``faults`` provides
+the deterministic injection harness (hangs, NaNs, crashes, device loss)
+that makes every recovery path — including the mesh-shrink rung —
+CPU-testable.
 """
 
 from distributedlpsolver_tpu.ipm.state import FaultKind, FaultRecord
+from distributedlpsolver_tpu.supervisor.adaptive import AdaptiveDeadline
 from distributedlpsolver_tpu.supervisor.faults import (
     FaultInjector,
     InjectedCrash,
+    InjectedDeviceLoss,
     InjectedFault,
 )
 from distributedlpsolver_tpu.supervisor.supervisor import (
@@ -24,10 +30,12 @@ from distributedlpsolver_tpu.supervisor.watchdog import (
 )
 
 __all__ = [
+    "AdaptiveDeadline",
     "FaultInjector",
     "FaultKind",
     "FaultRecord",
     "InjectedCrash",
+    "InjectedDeviceLoss",
     "InjectedFault",
     "IterateHealthFault",
     "SolveFailure",

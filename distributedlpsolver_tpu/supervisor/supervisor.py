@@ -1,29 +1,62 @@
-"""Solve supervisor: watchdog + health guards + rollback-and-degrade.
+"""Solve supervisor: watchdog + health guards + rollback-and-degrade +
+elastic mesh recovery.
 
 Wraps ``ipm.driver.solve`` in a fault-tolerance loop so a solve survives
 the failure classes a benchmark artifact can ignore but a serving system
 cannot (ROUND5_NOTES.md: a hung dispatch wedging a worker for ≥1h two
-iterations from optimal; program classes that crash the worker outright):
+iterations from optimal; program classes that crash the worker outright;
+a mesh participant dropping out of a pod mid-solve):
 
 1. **Dispatch watchdog** — every device step runs under a deadline
    (supervisor/watchdog.py); a step that blows it is ``FaultKind.HANG``.
+   The deadline is either the static ``step_timeout`` or, with
+   ``adaptive_timeout``, 10× the trailing median of observed step times
+   (supervisor/adaptive.py: floor/ceiling clamped, with warm-up grace for
+   compilation) — the only sizing that distinguishes "slow step on a big
+   problem" from "wedged device" across problem scales.
 2. **Iterate health guards** — the host-side convergence scalars are
    checked every iteration; non-finite values or exploding μ are
    ``FaultKind.NUMERICAL`` before the driver grinds on a poisoned iterate.
 3. **Recovery ladder** — on any fault the supervisor rolls back to the
    last good checkpoint and retries with exponential backoff, escalating
    per backend: plain rollback → rollback + regularization bump →
-   re-center (fresh well-centered starting point) → degrade to the next
-   backend in ``backends.auto.DEGRADATION_CHAIN``. When the ladder and the
-   retry budget are both exhausted it raises a structured
-   :class:`SolveFailure` carrying the ordered fault history — never a
-   silent wedge, never a bare traceback.
+   re-center (fresh well-centered starting point) → **shrink the mesh**
+   (mesh backends: probe device health, re-form a smaller mesh over the
+   survivors, re-shard, resume — see below) → degrade to the next backend
+   in ``backends.auto.DEGRADATION_CHAIN``. When the ladder and the retry
+   budget are both exhausted it raises a structured :class:`SolveFailure`
+   carrying the ordered fault history — never a silent wedge, never a
+   bare traceback.
+
+**Elastic mesh recovery** (the SHRINK rung): when a fault is classified
+as ``FaultKind.DEVICE_LOST`` — a raised device-loss error, or repeated
+``HANG`` faults the per-device health probe (parallel/runtime.py)
+attributes to the same shard (``hang_shard_threshold``) — and the active
+backend runs on a mesh with more than ``min_devices`` healthy
+participants, the supervisor re-probes the device set, re-forms a smaller
+``Mesh`` over the survivors (parallel.mesh.reform_mesh), re-places the
+backend on it (``backend.reshard``), and resumes the IPM from the last
+host-canonical checkpoint — the problem data and iterate are re-sharded
+onto the new layout by the backend's normal ``setup``/``from_host``
+(checkpoints are sharding-layout independent, utils/checkpoint.py v3).
+Losing one participant of a healthy pod costs one shard's throughput, not
+the pod. Device loss never walks the rollback rungs first — a lost device
+does not come back on retry — and only falls through to backend
+degradation when no shrinkable mesh remains.
 
 Rollback reuses the existing checkpoint machinery (utils/checkpoint.py):
 the supervisor forces per-iteration checkpointing to a (temp, unless
 configured) path, and each retry resumes through the driver's normal
 checkpoint-resume path — fingerprint-guarded, so a rollback can never
 resume into a different problem's iterate.
+
+Telemetry: with ``config.log_jsonl`` set, fault classifications and
+resume completions are appended to the same JSONL stream as the
+iteration records (``{"event": "fault"|"resume", ...}``); each resume
+event — and the corresponding ``FaultRecord.recovery_overhead_s`` —
+carries the wall-clock from fault classification to the first completed
+post-resume iteration, so a post-mortem can attribute wall-clock loss to
+the recovery path itself.
 """
 
 from __future__ import annotations
@@ -33,7 +66,7 @@ import os
 import shutil
 import tempfile
 import time
-from typing import List, Optional, Union
+from typing import Dict, List, Optional, Union
 
 import numpy as np
 
@@ -45,11 +78,19 @@ from distributedlpsolver_tpu.ipm.state import (
     IPMResult,
     Status,
 )
-from distributedlpsolver_tpu.supervisor.faults import FaultInjector, InjectedFault
+from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+from distributedlpsolver_tpu.parallel import runtime as rt
+from distributedlpsolver_tpu.supervisor.adaptive import AdaptiveDeadline
+from distributedlpsolver_tpu.supervisor.faults import (
+    FaultInjector,
+    InjectedDeviceLoss,
+    InjectedFault,
+)
 from distributedlpsolver_tpu.supervisor.watchdog import (
     StepDeadlineExceeded,
     run_with_deadline,
 )
+from distributedlpsolver_tpu.utils.logging import IterLogger
 
 
 class IterateHealthFault(RuntimeError):
@@ -83,8 +124,20 @@ class SupervisorConfig:
     # Watchdog deadline per device step, seconds. None/0 disables the
     # watchdog (guards and crash recovery still run). Size it ~10× the
     # expected step time: a 15 s/iter 10k endgame wants ~180 s, a CPU test
-    # problem 0.5 s.
+    # problem 0.5 s. With adaptive_timeout this is only the warm-up
+    # fallback — the live deadline tracks the observed step times.
     step_timeout: Optional[float] = None
+    # Adaptive watchdog deadline (supervisor/adaptive.py): 10× the
+    # trailing median of observed step times, clamped to
+    # [timeout_floor, timeout_ceiling], with warm-up grace (step_timeout,
+    # or no deadline when unset) during the first timeout_warmup steps
+    # and after every recovery that recompiles.
+    adaptive_timeout: bool = False
+    timeout_multiplier: float = 10.0
+    timeout_floor: float = 0.25  # seconds; never deadline below this
+    timeout_ceiling: float = 900.0  # seconds; never deadline above this
+    timeout_window: int = 32  # trailing step times the median sees
+    timeout_warmup: int = 3  # deadline-grace steps (compile headroom)
     max_retries: int = 6  # total recovery attempts before SolveFailure
     snapshot_every: int = 1  # rollback checkpoint cadence (iterations)
     backoff_base: float = 0.05  # seconds; doubles per fault
@@ -92,16 +145,46 @@ class SupervisorConfig:
     mu_limit: float = 1e30  # exploding-μ guard threshold
     reg_bump: float = 1e4  # regularization multiplier on the bump rung
     degrade: bool = True  # allow backend degradation
+    # Elastic mesh recovery: smallest mesh the SHRINK rung may re-form
+    # (below it the supervisor degrades instead). 0/1 = shrink down to a
+    # single device before degrading.
+    min_devices: int = 1
+    # HANG faults the health probe attributes to the same device before
+    # that device is treated as lost (shrink it out of the mesh).
+    hang_shard_threshold: int = 2
+    # Per-device wall-clock budget of the post-fault health probe.
+    probe_deadline: float = 2.0
     # Rollback checkpoint path; None = a temp file, removed on success.
     checkpoint_path: Optional[str] = None
     # Deterministic fault injection (tests): a list of InjectedFault.
     fault_plan: Optional[List[InjectedFault]] = None
 
 
-# Ladder rungs per backend, in escalation order.
+# Ladder rungs per backend, in escalation order. The SHRINK rung is not a
+# counter value: it triggers on classification (DEVICE_LOST / attributed
+# hangs) or on rung overflow of a mesh backend with probed-unhealthy
+# devices, and resets the rung counter for the re-formed mesh.
 _RUNG_ROLLBACK, _RUNG_REG_BUMP, _RUNG_RECENTER = 0, 1, 2
 
 _GUARDED_SCALARS = ("mu", "gap", "rel_gap", "pinf", "dinf", "pobj", "dobj")
+
+# Substrings (lowercased) of runtime errors that mean a device dropped
+# out rather than the program being at fault. Conservative: a mismatch
+# only costs the fault a trip through the generic CRASH ladder before
+# the rung-overflow probe still catches a genuinely dead device.
+_DEVICE_LOSS_PATTERNS = (
+    "device_lost",
+    "device lost",
+    "device is lost",
+    "device unavailable",
+    "failed to connect to device",
+    "hardware failure",
+)
+
+
+def _looks_like_device_loss(e: BaseException) -> bool:
+    msg = f"{type(e).__name__}: {e}".lower()
+    return any(p in msg for p in _DEVICE_LOSS_PATTERNS)
 
 
 class _SupervisorHooks(SolveHooks):
@@ -113,18 +196,61 @@ class _SupervisorHooks(SolveHooks):
         step_timeout: Optional[float],
         mu_limit: float,
         injector: Optional[FaultInjector],
+        adaptive: Optional[AdaptiveDeadline] = None,
+        mesh_ids_fn=None,
+        pending_fault: Optional[FaultRecord] = None,
+        events: Optional[IterLogger] = None,
     ):
         self.backend = backend
         self.step_timeout = step_timeout
         self.mu_limit = mu_limit
         self.injector = injector
+        self.adaptive = adaptive
+        # Lazy: the mesh exists only after the backend's setup ran inside
+        # solve(), which is after this hooks object was constructed.
+        self.mesh_ids_fn = mesh_ids_fn or (lambda: None)
+        # The fault this attempt is recovering from; cleared (and its
+        # recovery overhead recorded) when the first iteration lands.
+        self.pending_fault = pending_fault
+        self.events = events
+
+    def _deadline(self) -> Optional[float]:
+        if self.adaptive is not None:
+            return self.adaptive.current()
+        return self.step_timeout
 
     def run_step(self, step_fn, iteration: int):
         if self.injector is not None:
-            step_fn = self.injector.wrap_step(step_fn, iteration, self.backend)
-        return run_with_deadline(step_fn, self.step_timeout, iteration)
+            step_fn = self.injector.wrap_step(
+                step_fn, iteration, self.backend, self.mesh_ids_fn()
+            )
+        t0 = time.perf_counter()
+        out = run_with_deadline(step_fn, self._deadline(), iteration)
+        if self.adaptive is not None:
+            # Only completed steps feed the estimate — see
+            # AdaptiveDeadline.observe on why timeouts must not.
+            self.adaptive.observe(time.perf_counter() - t0)
+        return out
 
     def on_iterate(self, iteration: int, scalars: dict) -> None:
+        if self.pending_fault is not None:
+            # First completed post-resume iteration: the recovery path's
+            # wall-clock cost is now known — record it on the fault and
+            # in the telemetry stream (satellite: post-mortems attribute
+            # wall-clock loss without diffing timestamps by hand).
+            overhead = time.time() - self.pending_fault.at_time
+            self.pending_fault.recovery_overhead_s = overhead
+            if self.events is not None:
+                self.events.event(
+                    {
+                        "event": "resume",
+                        "iteration": iteration,
+                        "backend": self.backend,
+                        "action": self.pending_fault.action,
+                        "recovery_overhead_s": round(overhead, 6),
+                    }
+                )
+            self.pending_fault = None
         bad = [
             k
             for k in _GUARDED_SCALARS
@@ -157,6 +283,8 @@ def supervised_solve(
     statuses that are *answers* (infeasible, unbounded, iteration limit)
     return as-is — only faults trigger recovery.
     """
+    from distributedlpsolver_tpu.backends.base import get_backend
+
     sup = supervisor or SupervisorConfig()
     base_cfg = config or SolverConfig()
     if config_overrides:
@@ -171,24 +299,71 @@ def supervised_solve(
         checkpoint_path=ckpt_path,
         checkpoint_every=base_cfg.checkpoint_every or sup.snapshot_every,
         fused_loop=False,  # supervision needs per-iteration boundaries
+        # Attempts append to the telemetry stream; the supervisor
+        # truncated it once below, so retries (and the supervisor's own
+        # fault/resume events) extend one post-mortem-readable file.
+        log_append=bool(base_cfg.log_jsonl),
     )
 
-    current = backend if isinstance(backend, str) else getattr(backend, "name", "custom")
+    events: Optional[IterLogger] = None
+    if base_cfg.log_jsonl:
+        open(base_cfg.log_jsonl, "w").close()  # one truncation, up front
+        events = IterLogger(
+            verbose=False,
+            jsonl_path=base_cfg.log_jsonl,
+            fsync=base_cfg.log_fsync,
+            append=True,
+        )
+
+    if isinstance(backend, str):
+        current_name = backend
+        be = get_backend(backend)
+    else:
+        be = backend
+        current_name = getattr(backend, "name", "custom")
     injector = FaultInjector(sup.fault_plan) if sup.fault_plan else None
+    adaptive = (
+        AdaptiveDeadline(
+            multiplier=sup.timeout_multiplier,
+            floor=sup.timeout_floor,
+            ceiling=sup.timeout_ceiling,
+            window=sup.timeout_window,
+            warmup=sup.timeout_warmup,
+            static_hint=sup.step_timeout or None,
+        )
+        if sup.adaptive_timeout
+        else None
+    )
     faults: List[FaultRecord] = []
+    # Hang suspicion per device id (health-probe attribution); a device
+    # reaching hang_shard_threshold is treated as lost.
+    suspects: Dict[int, int] = {}
     attempt_cfg = base_cfg
     rung = 0
+    pending: Optional[FaultRecord] = None  # fault being recovered from
 
     try:
         while True:
             hooks = _SupervisorHooks(
-                current, sup.step_timeout, sup.mu_limit, injector
+                current_name,
+                sup.step_timeout,
+                sup.mu_limit,
+                injector,
+                adaptive=adaptive,
+                mesh_ids_fn=lambda: _mesh_ids(be),
+                pending_fault=pending,
+                events=events,
             )
+            # The hooks object owns the pending fault now (it records the
+            # recovery overhead when the first iteration lands); a fault
+            # in THIS attempt supersedes it below.
+            pending = None
             fault = None
+            lost_ids: set = set()
             try:
                 result = solve(
                     problem,
-                    backend=current,
+                    backend=be,
                     config=attempt_cfg,
                     warm_start=warm_start,
                     hooks=hooks,
@@ -199,65 +374,216 @@ def supervised_solve(
                 fault = FaultRecord(
                     FaultKind.NUMERICAL,
                     result.iterations,
-                    current,
+                    current_name,
                     "driver returned numerical_error "
                     "(regularization headroom exhausted)",
                 )
             except StepDeadlineExceeded as e:
-                fault = FaultRecord(FaultKind.HANG, e.iteration, current, str(e))
+                fault = FaultRecord(
+                    FaultKind.HANG, e.iteration, current_name, str(e)
+                )
+            except InjectedDeviceLoss as e:
+                fault = FaultRecord(
+                    FaultKind.DEVICE_LOST,
+                    e.iteration,
+                    current_name,
+                    str(e),
+                    devices=tuple(e.device_ids),
+                )
+                lost_ids.update(e.device_ids)
             except IterateHealthFault as e:
                 fault = FaultRecord(
-                    FaultKind.NUMERICAL, e.iteration, current, str(e)
+                    FaultKind.NUMERICAL, e.iteration, current_name, str(e)
                 )
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
+                kind = (
+                    FaultKind.DEVICE_LOST
+                    if _looks_like_device_loss(e)
+                    else FaultKind.CRASH
+                )
                 fault = FaultRecord(
-                    FaultKind.CRASH,
+                    kind,
                     getattr(e, "iteration", -1),
-                    current,
+                    current_name,
                     f"{type(e).__name__}: {e}",
                 )
             fault.at_time = time.time()
             faults.append(fault)
+            pending = fault
             warm_start = None  # retries resume via the rollback checkpoint
 
             if len(faults) > sup.max_retries:
                 fault.action = "give_up"
+                _emit_fault(events, fault)
                 raise SolveFailure(
                     faults, f"retry budget ({sup.max_retries}) exhausted"
                 )
 
-            # Escalation ladder for the current backend.
-            if rung == _RUNG_ROLLBACK:
-                fault.action = "rollback"
-            elif rung == _RUNG_REG_BUMP:
-                fault.action = "rollback+reg_bump"
-                attempt_cfg = attempt_cfg.replace(
-                    reg_primal=attempt_cfg.reg_primal * sup.reg_bump,
-                    reg_dual=attempt_cfg.reg_dual * sup.reg_bump,
+            # ---- elastic attribution: who (if anyone) is to blame? -----
+            mesh = getattr(be, "mesh", None)
+            if mesh is not None and fault.kind in (
+                FaultKind.DEVICE_LOST,
+                FaultKind.HANG,
+            ):
+                _, unhealthy = rt.probe_devices(
+                    list(mesh.devices.flat), sup.probe_deadline
                 )
-            elif rung == _RUNG_RECENTER:
-                fault.action = "recenter"
-                _remove_quiet(ckpt_path)  # fresh, well-centered start
-            else:
-                nxt = _next_backend(current, faults) if sup.degrade else None
-                if nxt is None:
-                    fault.action = "give_up"
-                    raise SolveFailure(
-                        faults,
-                        f"recovery ladder exhausted on backend {current!r} "
-                        "and no degradation target remains",
+                probed_ids = {d.id for d in unhealthy}
+                if fault.kind is FaultKind.DEVICE_LOST:
+                    lost_ids |= probed_ids
+                else:  # HANG: count suspicions; promote at the threshold
+                    for i in probed_ids:
+                        suspects[i] = suspects.get(i, 0) + 1
+                    blamed = {
+                        i
+                        for i, c in suspects.items()
+                        if c >= sup.hang_shard_threshold
+                    }
+                    if blamed:
+                        lost_ids |= blamed
+                if lost_ids:
+                    fault.devices = tuple(sorted(lost_ids))
+
+            # ---- recovery ladder ---------------------------------------
+            shrunk = False
+            if fault.kind is FaultKind.DEVICE_LOST or lost_ids:
+                # A lost device does not come back on retry: go straight
+                # to the SHRINK rung; its failure falls through to
+                # degradation, never to rollback-and-hope.
+                new_be, old_k, new_k = _shrunk_backend(
+                    be, lost_ids, sup.min_devices
+                )
+                if new_be is not None:
+                    fault.action = f"shrink:{old_k}->{new_k}"
+                    be = new_be
+                    rung = 0  # fresh ladder for the re-formed mesh
+                    suspects.clear()
+                    if adaptive is not None:
+                        # Shrunk shapes recompile; re-open the grace
+                        # window but keep the (still relevant) cadence.
+                        adaptive.grant_grace()
+                    shrunk = True
+                else:
+                    rung = _RUNG_RECENTER + 1  # force the degrade rung
+
+            if not shrunk:
+                if rung == _RUNG_ROLLBACK:
+                    fault.action = "rollback"
+                elif rung == _RUNG_REG_BUMP:
+                    fault.action = "rollback+reg_bump"
+                    attempt_cfg = attempt_cfg.replace(
+                        reg_primal=attempt_cfg.reg_primal * sup.reg_bump,
+                        reg_dual=attempt_cfg.reg_dual * sup.reg_bump,
                     )
-                fault.action = f"degrade:{nxt}"
-                current = nxt
-                attempt_cfg = base_cfg  # reset reg escalation on a new backend
-                rung = -1  # restart the ladder for the new backend
-            rung += 1
+                elif rung == _RUNG_RECENTER:
+                    fault.action = "recenter"
+                    _remove_quiet(ckpt_path)  # fresh, well-centered start
+                else:
+                    # Rung overflow. SHRINK sits above degradation: a mesh
+                    # backend whose ladder is exhausted gets one health
+                    # probe, and any unhealthy participant is shrunk out
+                    # before the pod is abandoned for the next backend.
+                    mesh = getattr(be, "mesh", None)
+                    new_be = None
+                    if mesh is not None:
+                        _, unhealthy = rt.probe_devices(
+                            list(mesh.devices.flat), sup.probe_deadline
+                        )
+                        if unhealthy:
+                            new_be, old_k, new_k = _shrunk_backend(
+                                be,
+                                {d.id for d in unhealthy},
+                                sup.min_devices,
+                            )
+                    if new_be is not None:
+                        fault.action = f"shrink:{old_k}->{new_k}"
+                        fault.devices = tuple(
+                            sorted(d.id for d in unhealthy)
+                        )
+                        be = new_be
+                        rung = -1  # += 1 below: fresh ladder on the new mesh
+                        suspects.clear()
+                        if adaptive is not None:
+                            adaptive.grant_grace()
+                    else:
+                        nxt = (
+                            _next_backend(current_name, faults)
+                            if sup.degrade
+                            else None
+                        )
+                        if nxt is None:
+                            fault.action = "give_up"
+                            _emit_fault(events, fault)
+                            raise SolveFailure(
+                                faults,
+                                f"recovery ladder exhausted on backend "
+                                f"{current_name!r} and no degradation "
+                                "target remains",
+                            )
+                        fault.action = f"degrade:{nxt}"
+                        current_name = nxt
+                        be = get_backend(nxt)
+                        attempt_cfg = base_cfg  # reset reg escalation
+                        rung = -1  # += 1 below: fresh ladder, new backend
+                        suspects.clear()
+                        if adaptive is not None:
+                            # New backend = new step-time regime: the old
+                            # cadence would mis-size the first deadlines.
+                            adaptive.reset()
+                rung += 1
+            _emit_fault(events, fault)
             _backoff(sup, len(faults))
     finally:
+        if events is not None:
+            events.close()
         if tmpdir is not None:
             shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _emit_fault(events: Optional[IterLogger], fault: FaultRecord) -> None:
+    if events is None:
+        return
+    events.event(
+        {
+            "event": "fault",
+            "kind": fault.kind.value,
+            "iteration": fault.iteration,
+            "backend": fault.backend,
+            "action": fault.action,
+            "devices": list(fault.devices),
+            "detail": fault.detail[:300],
+            "t": fault.at_time,
+        }
+    )
+
+
+def _mesh_ids(be) -> Optional[tuple]:
+    mesh = getattr(be, "mesh", None)
+    if mesh is None:
+        return None
+    return tuple(d.id for d in mesh.devices.flat)
+
+
+def _shrunk_backend(be, exclude_ids, min_devices: int):
+    """(new_backend, old_count, new_count) for the SHRINK rung, or
+    (None, 0, 0) when shrinking is not possible: no mesh, nothing to
+    exclude, too few survivors, or the backend cannot re-place itself."""
+    mesh = getattr(be, "mesh", None)
+    if mesh is None or not exclude_ids:
+        return None, 0, 0
+    devs = list(mesh.devices.flat)
+    survivors = [d for d in devs if d.id not in exclude_ids]
+    if len(survivors) == len(devs):
+        return None, 0, 0  # none of the excluded ids are in this mesh
+    if len(survivors) < max(1, min_devices):
+        return None, 0, 0
+    new_mesh = mesh_lib.reform_mesh(mesh, exclude=exclude_ids)
+    new_be = be.reshard(new_mesh)
+    if new_be is None:
+        return None, 0, 0
+    return new_be, len(devs), len(survivors)
 
 
 def _next_backend(current: str, faults: List[FaultRecord]) -> Optional[str]:

@@ -12,8 +12,18 @@ form it was taken from). :func:`load_state` refuses to hand a checkpoint
 from a different problem to a resume — the failure mode it closes is a
 stale ``--checkpoint`` path silently seeding a solve with another LP's
 iterate (shape-coincident garbage converges to the wrong answer; a shape
-mismatch merely crashes later and uglier). v1 checkpoints (no
-version/fingerprint fields) still load.
+mismatch merely crashes later and uglier).
+
+Format v3 (elastic recovery): checkpoints are **sharding-layout
+independent** by contract. ``save_state`` force-materializes every field
+on the host (``np.asarray`` pulls sharded device arrays down), so a
+checkpoint written from an 8-device mesh restores onto a 6-device mesh, a
+single device, or the CPU — placement belongs to the *active* backend's
+``from_host``/``shardings()``, never to the file. v3 additionally records
+the canonical (unpadded) problem shapes ``m``/``n`` and refuses a file
+whose arrays disagree with them (a truncated/corrupt write fails loudly
+instead of resuming garbage). v1 (no version/fingerprint) and v2 (no
+shape fields) checkpoints still load.
 """
 
 from __future__ import annotations
@@ -28,12 +38,13 @@ import numpy as np
 
 from distributedlpsolver_tpu.ipm.state import IPMState
 
-CKPT_FORMAT_VERSION = 2
+CKPT_FORMAT_VERSION = 3
 
 
 class CheckpointMismatch(RuntimeError):
-    """Checkpoint belongs to a different problem (fingerprint conflict) or
-    was written by a newer, unreadable format version."""
+    """Checkpoint belongs to a different problem (fingerprint conflict),
+    is internally inconsistent (v3 shape fields vs stored arrays), or was
+    written by a newer, unreadable format version."""
 
 
 def problem_fingerprint(inf) -> str:
@@ -53,6 +64,17 @@ def save_state(
     name: str = "",
     fingerprint: str = "",
 ) -> None:
+    """Atomically write a host-canonical checkpoint.
+
+    ``np.asarray`` materializes each field on the host regardless of how
+    the live iterate was placed (replicated, column-sharded over a mesh,
+    already numpy) — the file never encodes a device layout, which is
+    what lets the elastic supervisor resume the same checkpoint on a
+    re-formed, smaller mesh. Callers hand in the *unpadded* state (the
+    driver checkpoints ``backend.to_host`` output, which slices mesh
+    padding off); the recorded m/n are the canonical shapes a v3 load
+    re-validates.
+    """
     arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
@@ -65,6 +87,8 @@ def save_state(
                 name=name,
                 version=CKPT_FORMAT_VERSION,
                 fingerprint=fingerprint,
+                m=int(arrays["y"].shape[0]),
+                n=int(arrays["x"].shape[0]),
                 **arrays,
             )
         os.replace(tmp, path)
@@ -77,9 +101,13 @@ def save_state(
 def load_state(
     path: str, expected_fingerprint: Optional[str] = None
 ) -> Tuple[IPMState, int, str]:
-    """Load a checkpoint; raises :class:`CheckpointMismatch` when
-    ``expected_fingerprint`` is given and conflicts with the stored one.
-    A v1 checkpoint has no fingerprint and is accepted as-is."""
+    """Load a checkpoint as host numpy arrays (placement is the caller's
+    backend's job — ``from_host`` re-pads/re-shards for the active
+    layout); raises :class:`CheckpointMismatch` when
+    ``expected_fingerprint`` is given and conflicts with the stored one,
+    or when a v3 file's recorded shapes disagree with its arrays. v1
+    checkpoints have no fingerprint and are accepted as-is; v2 have no
+    shape fields and skip that check."""
     with np.load(path, allow_pickle=False) as data:
         version = int(data["version"]) if "version" in data else 1
         if version > CKPT_FORMAT_VERSION:
@@ -95,6 +123,14 @@ def load_state(
                 f"resume from a different problem's iterate"
             )
         state = IPMState(*(data[f] for f in IPMState._fields))
+        if version >= 3:
+            m, n = int(data["m"]), int(data["n"])
+            if state.x.shape != (n,) or state.y.shape != (m,):
+                raise CheckpointMismatch(
+                    f"{path}: stored arrays x{state.x.shape}/y{state.y.shape} "
+                    f"disagree with the recorded canonical shapes "
+                    f"(n={n}, m={m}) — corrupt or non-canonical checkpoint"
+                )
         return state, int(data["iteration"]), str(data["name"])
 
 

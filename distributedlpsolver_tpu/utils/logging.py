@@ -38,9 +38,19 @@ class IterLogger:
         verbose: bool = False,
         jsonl_path: Optional[str] = None,
         fsync: bool = False,
+        append: bool = False,
     ):
+        # ``append`` keeps an existing stream: the supervisor's retries
+        # re-enter the driver (one IterLogger per attempt) and must not
+        # truncate the telemetry of the attempts — and the supervisor's
+        # fault/resume event records — that came before. O_APPEND also
+        # makes the supervisor's concurrent event handle safe: both
+        # handles write whole flushed lines at the file end.
         self.verbose = verbose
-        self._fh: Optional[TextIO] = open(jsonl_path, "w") if jsonl_path else None
+        mode = "a" if append else "w"
+        self._fh: Optional[TextIO] = (
+            open(jsonl_path, mode) if jsonl_path else None
+        )
         self._fsync = fsync
         self._printed_header = False
 
@@ -57,6 +67,17 @@ class IterLogger:
             )
         if self._fh:
             self._fh.write(json.dumps(rec.asdict()) + "\n")
+            self._fh.flush()
+            if self._fsync:
+                os.fsync(self._fh.fileno())
+
+    def event(self, payload: dict) -> None:
+        """Write one non-iteration event record (fault classified, resume
+        landed) into the same JSONL stream, flushed like iteration rows.
+        Events carry an ``"event"`` key so consumers separate them from
+        iteration records (which never have one)."""
+        if self._fh:
+            self._fh.write(json.dumps(payload) + "\n")
             self._fh.flush()
             if self._fsync:
                 os.fsync(self._fh.fileno())

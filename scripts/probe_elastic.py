@@ -1,0 +1,88 @@
+"""End-to-end elastic recovery probe: injected device loss on an 8-virtual-
+device CPU mesh, recovered by mesh re-formation, with the fault history
+printed — the fastest way to see (and demo) the SHRINK rung working
+without TPU hardware.
+
+Run: python scripts/probe_elastic.py
+Exit 0 iff the solve recovered via SHRINK (not backend degradation) and
+matched the fault-free objective within 1e-8.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distributedlpsolver_tpu.ipm import Status, solve  # noqa: E402
+from distributedlpsolver_tpu.models.generators import random_dense_lp  # noqa: E402
+from distributedlpsolver_tpu.supervisor import (  # noqa: E402
+    FaultKind,
+    InjectedFault,
+    SupervisorConfig,
+    supervised_solve,
+)
+
+
+def main() -> int:
+    devs = jax.devices()
+    print(f"devices: {len(devs)} × {devs[0].platform}")
+    problem = random_dense_lp(30, 70, seed=7)
+
+    t0 = time.perf_counter()
+    reference = solve(problem, backend="sharded", fused_loop=False)
+    print(
+        f"fault-free : {reference.summary()} "
+        f"({time.perf_counter() - t0:.1f}s wall)"
+    )
+
+    lost = (devs[5].id, devs[6].id)
+    plan = [InjectedFault(FaultKind.DEVICE_LOST, iteration=3, device_ids=lost)]
+    sup = SupervisorConfig(
+        fault_plan=plan,
+        adaptive_timeout=True,
+        backoff_base=0.01,
+    )
+    t0 = time.perf_counter()
+    r = supervised_solve(problem, backend="sharded", supervisor=sup)
+    wall = time.perf_counter() - t0
+    print(f"with loss  : {r.summary()} ({wall:.1f}s wall)")
+    print("fault history:")
+    for f in r.faults:
+        print(
+            f"  {f.kind.value}@it{f.iteration} [{f.backend}] "
+            f"devices={list(f.devices)} -> {f.action} "
+            f"(recovery {f.recovery_overhead_s:.3f}s)"
+        )
+
+    err = abs(r.objective - reference.objective) / (
+        1.0 + abs(reference.objective)
+    )
+    shrunk = any(f.action.startswith("shrink:") for f in r.faults)
+    ok = (
+        r.status == Status.OPTIMAL
+        and r.backend == "sharded"
+        and shrunk
+        and err <= 1e-8
+    )
+    print(
+        f"objective agreement: {err:.2e} (<= 1e-8), "
+        f"recovered via {'SHRINK' if shrunk else 'NOT-shrink (FAIL)'}"
+    )
+    print("PROBE", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

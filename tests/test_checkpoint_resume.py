@@ -116,6 +116,89 @@ def test_v1_checkpoint_still_loads(tmp_path):
     np.testing.assert_array_equal(loaded.x, state.x)
 
 
+def test_v2_checkpoint_still_loads(tmp_path):
+    """v2 files (version + fingerprint, no canonical-shape fields) were
+    written by the previous release; the v3 reader migrates them as-is —
+    same arrays, same iteration, no shape validation to trip on."""
+    from distributedlpsolver_tpu.ipm.state import IPMState
+
+    n, m = 6, 3
+    state = IPMState(
+        x=np.arange(n, dtype=np.float64),
+        y=np.arange(m, dtype=np.float64),
+        s=np.ones(n),
+        w=np.ones(n),
+        z=np.zeros(n),
+    )
+    path = tmp_path / "v2.npz"
+    np.savez(
+        path,
+        iteration=11,
+        name="v2-era",
+        version=2,
+        fingerprint="cafe0123cafe0123",
+        **{f: np.asarray(getattr(state, f)) for f in state._fields},
+    )
+    loaded, it, name = ckpt.load_state(
+        str(path), expected_fingerprint="cafe0123cafe0123"
+    )
+    assert it == 11 and name == "v2-era"
+    np.testing.assert_array_equal(loaded.x, state.x)
+    np.testing.assert_array_equal(loaded.y, state.y)
+
+
+def test_v3_shape_mismatch_rejected(tmp_path):
+    """A v3 file whose arrays disagree with its recorded canonical shapes
+    (truncated/corrupt write) fails loudly instead of resuming garbage."""
+    from distributedlpsolver_tpu.ipm.state import IPMState
+
+    state = IPMState(*(np.ones(4) for _ in range(5)))
+    path = tmp_path / "bad.npz"
+    np.savez(
+        path,
+        iteration=1,
+        name="corrupt",
+        version=3,
+        fingerprint="",
+        m=9,  # disagrees with y.shape == (4,)
+        n=4,
+        **{f: np.asarray(getattr(state, f)) for f in state._fields},
+    )
+    with pytest.raises(ckpt.CheckpointMismatch, match="canonical shapes"):
+        ckpt.load_state(str(path))
+
+
+@pytest.mark.elastic
+def test_checkpoint_is_sharding_layout_independent(tmp_path):
+    """A checkpoint written while solving on the 8-device mesh restores
+    through a single-device backend (and vice versa would too): the file
+    is host-canonical — unpadded numpy, no device layout — and placement
+    happens in the active backend's from_host/shardings()."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    p = random_dense_lp(22, 50, seed=6)
+    ck = str(tmp_path / "mesh.npz")
+    solve(
+        p, backend="sharded", fused_loop=False,
+        checkpoint_path=ck, checkpoint_every=1, max_iter=4,
+    )
+    with np.load(ck, allow_pickle=False) as data:
+        n, m = int(data["n"]), int(data["m"])
+        # Unpadded canonical shapes — not the mesh-padded multiples.
+        assert data["x"].shape == (n,) and data["y"].shape == (m,)
+    full = solve(p, backend="cpu", fused_loop=False)
+    resumed = solve(
+        p, backend="tpu", fused_loop=False,
+        checkpoint_path=ck, checkpoint_every=1,
+    )
+    assert resumed.status == Status.OPTIMAL
+    assert abs(resumed.objective - full.objective) <= 1e-8 * (
+        1.0 + abs(full.objective)
+    )
+
+
 def test_future_version_rejected(tmp_path):
     path = tmp_path / "future.npz"
     np.savez(path, iteration=1, name="n", version=99, fingerprint="ab")

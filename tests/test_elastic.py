@@ -1,0 +1,333 @@
+"""Elastic mesh recovery: device loss → re-formation → re-sharded resume.
+
+The ISSUE acceptance scenario runs here end-to-end on the 8 virtual CPU
+devices: a sharded solve loses 2 of its 8 mesh participants mid-solve,
+the supervisor re-forms a 6-device mesh over the survivors, re-shards the
+problem and the last-good iterate, and converges to the fault-free
+objective within 1e-8 — via the SHRINK rung, never the CPU fallback.
+Plus the building blocks: mesh re-formation, device health probes,
+per-shard hang attribution, and the adaptive watchdog deadline.
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import Status, solve
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+from distributedlpsolver_tpu.parallel import (
+    make_mesh,
+    probe_devices,
+    reform_mesh,
+    restore_devices,
+    simulate_device_loss,
+)
+from distributedlpsolver_tpu.supervisor import (
+    AdaptiveDeadline,
+    FaultKind,
+    InjectedFault,
+    SupervisorConfig,
+    supervised_solve,
+)
+
+pytestmark = [
+    pytest.mark.elastic,
+    pytest.mark.skipif(
+        len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+    ),
+]
+
+_PROBLEM = dict(m=20, n=45, seed=3)
+
+
+def _problem():
+    return random_dense_lp(**_PROBLEM)
+
+
+def _sup(**kw):
+    kw.setdefault("backoff_base", 0.001)
+    return SupervisorConfig(**kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Injected device loss marks ids in a process-local registry the
+    health probe consults; never leak that into the next test."""
+    restore_devices()
+    yield
+    restore_devices()
+
+
+@pytest.fixture(scope="module")
+def reference_result():
+    return solve(_problem(), backend="sharded", fused_loop=False)
+
+
+# -------------------------------------------------------- mesh re-formation
+def test_reform_mesh_excludes_devices():
+    mesh = make_mesh()
+    lost = [d.id for d in mesh.devices.flat][-2:]
+    smaller = reform_mesh(mesh, exclude=lost)
+    assert smaller.devices.size == mesh.devices.size - 2
+    assert smaller.axis_names == ("cols",)
+    assert not {d.id for d in smaller.devices.flat} & set(lost)
+    # Device objects (not just ids) are accepted too.
+    smaller2 = reform_mesh(mesh, exclude=list(mesh.devices.flat)[:1])
+    assert smaller2.devices.size == mesh.devices.size - 1
+
+
+def test_reform_mesh_refuses_empty():
+    mesh = make_mesh()
+    with pytest.raises(ValueError, match="no devices"):
+        reform_mesh(mesh, exclude=[d.id for d in mesh.devices.flat])
+
+
+def test_reform_mesh_collapses_hybrid_to_1d():
+    from distributedlpsolver_tpu.parallel import make_hybrid_mesh
+
+    hybrid = make_hybrid_mesh(ici_parallelism=4, dcn_parallelism=2)
+    lost = [d.id for d in hybrid.devices.flat][:1]
+    smaller = reform_mesh(hybrid, exclude=lost)
+    # 7 survivors cannot tile (2, ici); the re-formed mesh is 1-D over
+    # the innermost (ICI/"cols") axis name.
+    assert smaller.devices.shape == (7,)
+    assert smaller.axis_names == ("cols",)
+
+
+# ------------------------------------------------------------ health probes
+def test_probe_flags_simulated_loss():
+    devs = jax.devices()
+    healthy, unhealthy = probe_devices(devs)
+    assert [d.id for d in unhealthy] == []
+    simulate_device_loss([devs[2].id, devs[5].id])
+    healthy, unhealthy = probe_devices(devs)
+    assert sorted(d.id for d in unhealthy) == sorted(
+        [devs[2].id, devs[5].id]
+    )
+    assert len(healthy) == len(devs) - 2
+    restore_devices([devs[2].id])
+    _, unhealthy = probe_devices(devs)
+    assert [d.id for d in unhealthy] == [devs[5].id]
+
+
+# ------------------------------------------- the acceptance scenario (8→6)
+def test_device_loss_shrinks_mesh_and_converges(reference_result):
+    """Injected loss of 2 of 8 devices: the solve completes via mesh
+    re-formation — SHRINK in the fault history, still on the sharded
+    backend (no cpu fallback) — and matches the fault-free objective
+    within 1e-8."""
+    devs = jax.devices()
+    lost = (devs[5].id, devs[6].id)
+    plan = [
+        InjectedFault(FaultKind.DEVICE_LOST, iteration=3, device_ids=lost)
+    ]
+    r = supervised_solve(
+        _problem(),
+        backend="sharded",
+        supervisor=_sup(fault_plan=plan),
+    )
+    assert r.status == Status.OPTIMAL
+    assert r.backend == "sharded"  # recovered the mesh, not the CPU
+    assert [f.kind for f in r.faults] == [FaultKind.DEVICE_LOST]
+    f = r.faults[0]
+    assert f.action == "shrink:8->6"
+    assert sorted(f.devices) == sorted(lost)
+    assert f.recovery_overhead_s > 0.0  # resume landed and was timed
+    assert abs(r.objective - reference_result.objective) <= 1e-8 * (
+        1.0 + abs(reference_result.objective)
+    )
+
+
+def test_device_loss_below_min_devices_degrades():
+    """With min_devices above the survivor count the SHRINK rung is
+    gated off and the ladder falls through to backend degradation."""
+    devs = jax.devices()
+    plan = [
+        InjectedFault(
+            FaultKind.DEVICE_LOST, iteration=2, device_ids=(devs[1].id,)
+        )
+    ]
+    r = supervised_solve(
+        _problem(),
+        backend="sharded",
+        supervisor=_sup(fault_plan=plan, min_devices=8),
+    )
+    assert r.status == Status.OPTIMAL
+    assert r.faults[0].action == "degrade:tpu"
+    assert r.backend == "tpu"
+
+
+def test_persistent_shard_hang_attributed_and_shrunk(reference_result):
+    """'Shard k always hangs': two watchdog timeouts both attributed to
+    the same device by the health probe promote it to DEVICE_LOST-class
+    recovery — the mesh shrinks it out and the solve completes on 7.
+    The deadline is ADAPTIVE (a static one sized for the hang would
+    false-fire on the compiling first step; the warm-up grace plus
+    10×-median is the mechanism that makes this scenario decidable)."""
+    shard = jax.devices()[3].id
+    plan = [
+        InjectedFault(
+            FaultKind.HANG,
+            iteration=4,
+            shard=shard,
+            times=None,  # hangs EVERY time its device is in the mesh
+            hang_seconds=30.0,
+        )
+    ]
+    t0 = time.perf_counter()
+    r = supervised_solve(
+        _problem(),
+        backend="sharded",
+        supervisor=_sup(
+            fault_plan=plan,
+            adaptive_timeout=True,
+            timeout_floor=0.3,
+            timeout_warmup=3,
+            hang_shard_threshold=2,
+            max_retries=8,
+        ),
+    )
+    elapsed = time.perf_counter() - t0
+    assert r.status == Status.OPTIMAL
+    assert r.backend == "sharded"
+    kinds = [f.kind for f in r.faults]
+    assert kinds == [FaultKind.HANG, FaultKind.HANG]
+    assert r.faults[0].action == "rollback"  # below the threshold
+    assert r.faults[1].action == "shrink:8->7"
+    assert r.faults[1].devices == (shard,)
+    # The watchdog abandoned both 30 s hangs — the wall clock holds
+    # compiles and warm steps, never a slept-out nap.
+    assert elapsed < 55.0
+    assert abs(r.objective - reference_result.objective) <= 1e-6 * (
+        1.0 + abs(reference_result.objective)
+    )
+
+
+def test_fault_and_resume_events_in_jsonl(tmp_path):
+    """The telemetry stream carries the fault classification and the
+    resume completion with its recovery overhead, interleaved with the
+    per-iteration records of every attempt (append mode)."""
+    devs = jax.devices()
+    log = tmp_path / "telemetry.jsonl"
+    plan = [
+        InjectedFault(
+            FaultKind.DEVICE_LOST, iteration=3, device_ids=(devs[7].id,)
+        )
+    ]
+    r = supervised_solve(
+        _problem(),
+        backend="sharded",
+        supervisor=_sup(fault_plan=plan),
+        log_jsonl=str(log),
+    )
+    assert r.status == Status.OPTIMAL
+    records = [json.loads(l) for l in log.read_text().splitlines()]
+    events = [rec for rec in records if "event" in rec]
+    iters = [rec for rec in records if "event" not in rec]
+    fault_ev = [e for e in events if e["event"] == "fault"]
+    resume_ev = [e for e in events if e["event"] == "resume"]
+    assert len(fault_ev) == 1 and len(resume_ev) == 1
+    assert fault_ev[0]["kind"] == "device_lost"
+    assert fault_ev[0]["action"] == "shrink:8->7"
+    assert fault_ev[0]["devices"] == [devs[7].id]
+    assert resume_ev[0]["recovery_overhead_s"] > 0.0
+    assert resume_ev[0]["recovery_overhead_s"] == pytest.approx(
+        r.faults[0].recovery_overhead_s, abs=1e-6
+    )
+    # Pre-fault iterations (attempt 1) were not truncated by the retry.
+    assert [rec["iter"] for rec in iters][:2] == [1, 2]
+
+
+# ------------------------------------------------------- adaptive deadline
+class TestAdaptiveDeadline:
+    def test_warmup_grace_uses_static_hint(self):
+        ad = AdaptiveDeadline(warmup=3, static_hint=42.0)
+        assert ad.current() == 42.0  # no observations yet
+        ad.observe(0.1)
+        ad.observe(0.1)
+        assert ad.current() == 42.0  # still inside warm-up
+        ad.observe(0.1)
+        assert ad.current() == pytest.approx(1.0)  # 10× median, floored
+
+    def test_warmup_without_hint_means_no_deadline(self):
+        ad = AdaptiveDeadline(warmup=2)
+        assert ad.current() is None
+        ad.observe(30.0)  # the compile step
+        assert ad.current() is None
+        ad.observe(0.5)
+        assert ad.current() is not None
+
+    def test_tracks_trailing_median_not_outliers(self):
+        ad = AdaptiveDeadline(warmup=0, floor=0.0, window=8)
+        for _ in range(7):
+            ad.observe(0.2)
+        ad.observe(50.0)  # one GC-pause outlier must not ratchet it up
+        assert ad.current() == pytest.approx(10.0 * 0.2)
+
+    def test_window_is_trailing(self):
+        ad = AdaptiveDeadline(warmup=0, floor=0.0, ceiling=1e9, window=4)
+        for _ in range(10):
+            ad.observe(1.0)
+        for _ in range(4):
+            ad.observe(3.0)  # old regime fully evicted
+        assert ad.current() == pytest.approx(30.0)
+        assert ad.observations == 4
+
+    def test_floor_and_ceiling_clamp(self):
+        ad = AdaptiveDeadline(warmup=0, floor=0.5, ceiling=100.0)
+        ad.observe(1e-4)
+        assert ad.current() == 0.5
+        ad2 = AdaptiveDeadline(warmup=0, floor=0.5, ceiling=100.0)
+        ad2.observe(1e4)
+        assert ad2.current() == 100.0
+
+    def test_grace_reopens_without_losing_history(self):
+        ad = AdaptiveDeadline(warmup=2, floor=0.0, static_hint=None)
+        ad.observe(0.1)
+        ad.observe(0.1)
+        assert ad.current() == pytest.approx(1.0)
+        ad.grant_grace()  # post-shrink recompile headroom
+        assert ad.current() is None
+        ad.observe(5.0)  # the recompile step — absorbed by the median
+        ad.observe(0.1)
+        assert ad.current() == pytest.approx(1.0)
+
+    def test_reset_forgets_regime(self):
+        ad = AdaptiveDeadline(warmup=1, static_hint=7.0)
+        ad.observe(0.1)
+        assert ad.current() is not None
+        ad.reset()
+        assert ad.observations == 0
+        assert ad.current() == 7.0  # back to the static warm-up fallback
+
+    def test_invalid_params_rejected(self):
+        with pytest.raises(ValueError):
+            AdaptiveDeadline(multiplier=1.0)
+        with pytest.raises(ValueError):
+            AdaptiveDeadline(floor=10.0, ceiling=1.0)
+
+
+def test_adaptive_supervised_solve_catches_injected_hang():
+    """End-to-end: no static deadline at all — the adaptive tracker
+    learns the CPU step cadence and its 10×-median deadline still
+    catches an injected hang (which a 30 s static default would have
+    slept through)."""
+    plan = [InjectedFault(FaultKind.HANG, iteration=6, hang_seconds=30.0)]
+    t0 = time.perf_counter()
+    r = supervised_solve(
+        _problem(),
+        backend="cpu",
+        supervisor=_sup(
+            fault_plan=plan,
+            adaptive_timeout=True,
+            timeout_floor=0.2,
+            timeout_warmup=2,
+        ),
+    )
+    elapsed = time.perf_counter() - t0
+    assert r.status == Status.OPTIMAL
+    assert [f.kind for f in r.faults] == [FaultKind.HANG]
+    assert elapsed < 20.0  # nothing slept out the 30 s injected hang
