@@ -561,6 +561,10 @@ def run_scale(args) -> list:
          a minutes-not-hours size): OPTIMAL with final pinf <= 1e-12 —
          the host-factor + primal-closure guarantee (entry pinf ~1e-8
          must DROP through the endgame, not floor).
+      3. batched 1024x(128,512) headline: all 1024 members OPTIMAL,
+         warm solve <= 240 s (measured ~116 s).
+      4. storm-20k hint-less block-angular headline: detection recovers
+         K=256, Schur solve OPTIMAL <= 30 s warm (measured 6.3-10.2 s).
     """
     import jax
 
@@ -570,7 +574,7 @@ def run_scale(args) -> list:
     on_tpu = jax.default_backend() == "tpu"
     rows = []
 
-    _log("[scale 1/2] dense 2048x10240 auto schedule (envelope: optimal, "
+    _log("[scale 1/4] dense 2048x10240 auto schedule (envelope: optimal, "
          "pinf<=1e-8, warm solve<=3s)")
     p = random_dense_lp(2048, 10240, seed=2)
     # Warm-up at DEFAULT config: buffer caps are static jit keys bucketed
@@ -608,16 +612,26 @@ def run_scale(args) -> list:
         # The endgame only triggers from the two-phase+PCG schedule, which
         # is TPU-only (off-TPU, device f64 is LAPACK-grade and the direct
         # path runs) — forcing it here would test a path production never
-        # takes on this platform and fail spuriously.
-        row2 = {"check": "dense_1024x5120_forced_endgame", "skipped": True,
-                "reason": "endgame is a TPU-only path (emulated-f64 "
-                          "finish); run this tier on the TPU chip",
-                "pass": True}
-        rows.append(row2)
-        _log(json.dumps(row2))
+        # takes on this platform and fail spuriously. The batched and
+        # storm headline envelopes are wall-clock envelopes calibrated on
+        # the chip, so they skip off-TPU too (their MATH is covered at
+        # small scale by tier-1 tests).
+        for check, why in (
+            ("dense_1024x5120_forced_endgame",
+             "endgame is a TPU-only path (emulated-f64 finish)"),
+            ("batched_1024x128x512",
+             "wall-clock envelope calibrated on the TPU chip"),
+            ("storm20k_block_angular",
+             "wall-clock envelope calibrated on the TPU chip"),
+        ):
+            row2 = {"check": check, "skipped": True,
+                    "reason": f"{why}; run this tier on the TPU chip",
+                    "pass": True}
+            rows.append(row2)
+            _log(json.dumps(row2))
         return rows
 
-    _log("[scale 2/2] dense 1024x5120 forced endgame (envelope: optimal, "
+    _log("[scale 2/4] dense 1024x5120 forced endgame (envelope: optimal, "
          "final pinf<=1e-12)")
     entries_save = D.DenseJaxBackend._ENDGAME_ENTRIES
     try:
@@ -646,6 +660,78 @@ def run_scale(args) -> list:
     }
     rows.append(row2)
     _log(json.dumps(row2))
+
+    # 3. Batched headline config (BASELINE.json:11; VERDICT "What's weak"
+    # #3 — the 2.06×-vs-CPU-loop figure had no regression envelope).
+    # Measured 2026-08-01: ~116 s warm with all 1024 members optimal;
+    # 240 s = ~2× headroom over tunnel noise.
+    _log("[scale 3/4] batched 1024x(128,512) vmap solve (envelope: "
+         "1024/1024 optimal, solve<=240s warm)")
+    from distributedlpsolver_tpu.backends.batched import solve_batched
+    from distributedlpsolver_tpu.models.generators import random_batched_lp
+
+    batch = random_batched_lp(1024, 128, 512, seed=0)
+    solve_batched(batch, max_iter=3)  # compile warm-up (full-size programs)
+    # One full untimed solve: the final-phase compaction programs
+    # (256→…→32) only compile once actives drain — see _bench_batched.
+    solve_batched(batch)
+    r3 = solve_batched(batch)
+    r3b = solve_batched(batch)
+    if r3b.solve_time < r3.solve_time:
+        r3 = r3b
+    row3 = {
+        "check": "batched_1024x128x512",
+        "optimal": int(r3.n_optimal),
+        "problems": len(r3.status),
+        "time_s": round(r3.solve_time, 3),
+        "envelope": {"n_optimal": 1024, "time_s_max": 240.0},
+        "pass": bool(r3.n_optimal == 1024 and r3.solve_time <= 240.0),
+    }
+    rows.append(row3)
+    _log(json.dumps(row3))
+
+    # 4. storm-20k headline config (scripts/run_storm20k.py, VERDICT
+    # round 2 item 4): hint-less ≥20k-row block-angular — detection must
+    # recover K=256 and the Schur path must stay in its measured class
+    # (6.3–10.2 s observed; 30 s = ~3× headroom).
+    _log("[scale 4/4] storm-20k hint-less detect→Schur (envelope: optimal, "
+         "K=256 detected, solve<=30s warm)")
+    from distributedlpsolver_tpu.models.generators import block_angular_lp
+    from distributedlpsolver_tpu.models.structure import detect_block_structure
+
+    p4 = block_angular_lp(256, 80, 160, 48, seed=3, sparse=True, density=0.08)
+    p4.block_structure = None  # what a real file looks like
+    hint = detect_block_structure(p4)
+    detected = int(hint["num_blocks"]) if hint else 0
+    row4 = {
+        "check": "storm20k_block_angular",
+        "detected_blocks": detected,
+        "envelope": {"status": "optimal", "detected_blocks": 256,
+                     "time_s_max": 30.0},
+    }
+    if hint is None:
+        row4.update(status="detection_declined")
+        row4["pass"] = False
+    else:
+        p4.block_structure = hint
+        _solve_timed(p4, "block", max_iter=3)  # compile warm-up
+        r4 = _solve_timed(p4, "block")
+        r4b = _solve_timed(p4, "block")
+        if r4b.solve_time < r4.solve_time:
+            r4 = r4b
+        row4.update(
+            status=r4.status.value,
+            time_s=round(r4.solve_time, 3),
+            iters=int(r4.iterations),
+            rel_gap=float(r4.rel_gap),
+        )
+        row4["pass"] = bool(
+            r4.status.value == "optimal"
+            and detected == 256
+            and r4.solve_time <= 30.0
+        )
+    rows.append(row4)
+    _log(json.dumps(row4))
     return rows
 
 
@@ -670,13 +756,26 @@ def main() -> int:
 
     import jax
 
+    fell_back = False
     try:
         devs = jax.devices()
     except RuntimeError as e:  # accelerator claim failed — fall back to CPU
         _log(f"accelerator unavailable ({e}); falling back to CPU")
         jax.config.update("jax_platforms", "cpu")
         devs = jax.devices()
+        fell_back = True
     _log(f"devices: {devs}")
+    # Every JSON row this run writes carries the platform it ACTUALLY ran
+    # on; a fallback run stamps the distinct "cpu-fallback" so its figures
+    # can never masquerade as backend=tpu measurements (VERDICT "What's
+    # weak" #1 — the silent-fallback rows).
+    args.platform = "cpu-fallback" if fell_back else jax.default_backend()
+    if fell_back:
+        _log(
+            "=== CPU FALLBACK: the requested accelerator was unavailable; "
+            "all figures below are host-CPU numbers and every JSON row is "
+            'stamped "platform": "cpu-fallback" ==='
+        )
 
     from distributedlpsolver_tpu.backends import available_backends
 
@@ -687,6 +786,8 @@ def main() -> int:
 
     if args.scale:
         rows = run_scale(args)
+        for r in rows:
+            r.setdefault("platform", args.platform)
         out = os.path.join(_REPO, "SCALE_CHECK.json")
         with open(out, "w") as fh:
             json.dump(rows, fh, indent=2)
@@ -699,6 +800,8 @@ def main() -> int:
 
     if args.suite:
         rows = run_suite(args)
+        for r in rows:
+            r.setdefault("platform", args.platform)
         out = os.path.join(_REPO, "BENCH_SUITE.json")
         with open(out, "w") as fh:
             json.dump(rows, fh, indent=2)
@@ -720,6 +823,7 @@ def main() -> int:
                 ),
                 "value": row["time_s"],
                 "unit": "seconds",
+                "platform": args.platform,
                 "vs_baseline": row["vs_baseline"],
             }
         )

@@ -686,6 +686,150 @@ def _drive_compacting(
     )
 
 
+# ---------------------------------------------------------------------------
+# Bucket entry point (serve/): a pre-padded batch + active mask, one compiled
+# program per bucket shape, reused verbatim across service dispatches.
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "factor_dtype", "stall_window")
+)
+def _solve_bucket_jit(
+    A, data, active0, reg0, max_iter, max_refactor, reg_grow, params,
+    factor_dtype, stall_window,
+):
+    # Single-phase schedule on purpose: serving members sit far below
+    # _PHASED_MEMBER_ENTRIES (the phased schedules are a large-member
+    # optimization that LOSES at bucket shapes — see the measurements
+    # there), and one phase means one program per bucket. max_iter /
+    # max_refactor / reg_grow are traced so per-request iteration budgets
+    # never fork the compile cache; ``active0`` masks padding slots
+    # inactive from iteration 0 — the same machinery that freezes
+    # converged members freezes slots that never held a request.
+    fdt = jnp.dtype(factor_dtype)
+    B = A.shape[0]
+    dtype = A.dtype
+    states0 = jax.vmap(
+        lambda a, d: _single_start(a, d, reg0, params, fdt)
+    )(A, data)
+    states, active, it, regs, bad, status, iters, best, since = (
+        _fresh_batch_carry(states0, jnp.zeros(B, jnp.int32), B, reg0, dtype)
+    )
+    carry = (
+        states,
+        active & active0,
+        it,
+        regs,
+        bad,
+        # Padding slots report _OPTIMAL so the all-settled loop predicate
+        # and the cleanup/demux logic treat them as finished; consumers
+        # must ignore slots they never filled (serve/service.py demuxes
+        # by slot index, so a padding verdict is never read).
+        jnp.where(active0, status, _OPTIMAL),
+        iters,
+        best,
+        since,
+    )
+    states, _, _, _, _, status, iters, _, _ = _batched_phase(
+        A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
+        None, 2 * stall_window if stall_window else 0, _STALL,
+    )
+    status = jnp.where(status == _RUNNING, _MAXITER, status)
+
+    def final_norms(a, d, st):
+        ops = _make_ops(a, jnp.asarray(0.0, dtype), fdt, 0)
+        pinf, dinf, _, rel_gap, pobj, _, _ = core.residual_norms(ops, d, st)
+        return pinf, dinf, rel_gap, pobj
+
+    pinf, dinf, rel_gap, pobj = jax.vmap(final_norms)(A, data, states)
+    return states, status, iters, pinf, dinf, rel_gap, pobj
+
+
+def bucket_cache_size() -> int:
+    """Number of compiled bucket programs in this process — the serve
+    layer's recompile telemetry, and the warm-bucket zero-recompile
+    assertion in tests (repeat dispatches to a warm bucket must not grow
+    this)."""
+    return _solve_bucket_jit._cache_size()
+
+
+def solve_bucket(
+    batch: BatchedLP,
+    active,
+    config: Optional[SolverConfig] = None,
+    **config_overrides,
+) -> BatchedResult:
+    """Solve one pre-padded serving bucket: ``batch`` is (B, m, n) arrays
+    already padded to the bucket shape (serve/buckets.py), ``active`` a
+    (B,) bool mask — False slots are padding and are frozen from the
+    first iteration (their returned status is a placeholder OPTIMAL;
+    demux by slot and ignore them).
+
+    Unlike :func:`solve_batched` there is no chunking, no mesh, no phase
+    schedule and no solo cleanup: the service owns the retry budget of
+    unfinished members (supervisor ladder / solo re-solve), and the one
+    jitted program per (B, m, n, dtype, params) key is reused across
+    every dispatch — a warm bucket never recompiles
+    (:func:`bucket_cache_size`).
+    """
+    cfg = config or SolverConfig()
+    if config_overrides:
+        cfg = cfg.replace(**config_overrides)
+    dtype = jnp.dtype(cfg.dtype)
+    fname = jnp.dtype(cfg.factor_dtype_resolved()).name
+
+    t0 = time.perf_counter()
+    A = jnp.asarray(np.asarray(batch.A), dtype=dtype)
+    b = jnp.asarray(np.asarray(batch.b), dtype=dtype)
+    c = jnp.asarray(np.asarray(batch.c), dtype=dtype)
+    Bsz, _, n = A.shape
+    active = np.asarray(active, dtype=bool)
+    if active.shape != (Bsz,):
+        raise ValueError(f"active mask shape {active.shape} != ({Bsz},)")
+    u = jnp.full((Bsz, n), jnp.inf, dtype=dtype)
+    data = jax.vmap(
+        lambda cc, bb, uu: core.make_problem_data(jnp, cc, bb, uu, dtype)
+    )(c, b, u)
+    setup_time = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    states, status, iters, pinf, dinf, rel_gap, pobj = _solve_bucket_jit(
+        A,
+        data,
+        jnp.asarray(active),
+        jnp.asarray(cfg.reg_dual, dtype),
+        jnp.asarray(cfg.max_iter, jnp.int32),
+        jnp.asarray(cfg.max_refactor, jnp.int32),
+        jnp.asarray(cfg.reg_grow, dtype),
+        cfg.step_params(),
+        fname,
+        cfg.stall_window,
+    )
+    jax.block_until_ready(states)
+    solve_time = time.perf_counter() - t1
+
+    code_map = {
+        _OPTIMAL: Status.OPTIMAL,
+        _MAXITER: Status.ITERATION_LIMIT,
+        _NUMERR: Status.NUMERICAL_ERROR,
+        _STALL: Status.STALLED,
+    }
+    status_arr = np.array(
+        [code_map[int(sc)] for sc in np.asarray(status)], dtype=object
+    )
+    return BatchedResult(
+        status=status_arr,
+        objective=np.asarray(pobj, dtype=np.float64),
+        x=np.asarray(states.x, dtype=np.float64),
+        iterations=np.asarray(iters),
+        rel_gap=np.asarray(rel_gap, dtype=np.float64),
+        pinf=np.asarray(pinf, dtype=np.float64),
+        dinf=np.asarray(dinf, dtype=np.float64),
+        solve_time=solve_time,
+        setup_time=setup_time,
+    )
+
+
 def member_interior_form(batch: BatchedLP, i: int):
     """One batch member as a standalone InteriorForm — the solo-cleanup
     path's input, exported so bench warm-ups can compile the SAME dense

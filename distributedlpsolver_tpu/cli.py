@@ -178,6 +178,84 @@ def cmd_solve(args) -> int:
     return _report(result, args.json, args.x_out)
 
 
+def _iter_request_specs(args):
+    """Yield request-spec dicts from --requests (JSONL file or '-' =
+    stdin) or --dir (sorted *.mps files, each one request, plus *.jsonl
+    files of specs). A spec is ``{"mps": path}`` or
+    ``{"m": .., "n": .., "seed": ..}`` (generated standard-form), plus
+    optional ``"id"``, ``"tol"``, ``"deadline_s"``."""
+    import os
+
+    if args.dir:
+        for fname in sorted(os.listdir(args.dir)):
+            path = os.path.join(args.dir, fname)
+            if fname.endswith(".mps") or fname.endswith(".mps.gz"):
+                yield {"mps": path, "id": fname}
+            elif fname.endswith(".jsonl"):
+                with open(path) as fh:
+                    for line in fh:
+                        if line.strip():
+                            yield json.loads(line)
+        return
+    fh = sys.stdin if args.requests == "-" else open(args.requests)
+    try:
+        for line in fh:
+            if line.strip():
+                yield json.loads(line)
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+
+
+def cmd_serve(args) -> int:
+    """Serve loop: read LP requests, multiplex them through the async
+    batching SolveService, write one JSONL result record per request."""
+    from distributedlpsolver_tpu.io.mps import read_mps
+    from distributedlpsolver_tpu.models.generators import random_dense_lp
+    from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+
+    svc_cfg = ServiceConfig(
+        batch=args.batch,
+        flush_s=args.flush_ms / 1e3,
+        max_queue_depth=args.queue_depth,
+        default_deadline_s=args.deadline_s or None,
+        log_jsonl=args.log_jsonl,
+    )
+    out = sys.stdout if args.out == "-" else open(args.out, "w")
+    n_failed = 0
+    try:
+        with SolveService(svc_cfg, solver_config=_config_from(args).replace(
+            verbose=False
+        )) as svc:
+            submitted = []
+            for spec in _iter_request_specs(args):
+                if "mps" in spec:
+                    problem = read_mps(spec["mps"])
+                else:
+                    problem = random_dense_lp(
+                        int(spec["m"]), int(spec["n"]),
+                        seed=int(spec.get("seed", 0)),
+                    )
+                fut = svc.submit(
+                    problem,
+                    deadline=spec.get("deadline_s"),
+                    tol=spec.get("tol"),
+                    name=str(spec.get("id", problem.name)),
+                )
+                submitted.append(fut)
+            svc.drain()
+            for fut in submitted:
+                r = fut.result()
+                n_failed += r.status.value == "failed"
+                out.write(json.dumps(r.record()) + "\n")
+            out.flush()
+            print(json.dumps(svc.stats()), file=sys.stderr)
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 2 if n_failed else 0
+
+
 def cmd_backends(_args) -> int:
     from distributedlpsolver_tpu.backends import available_backends
 
@@ -211,6 +289,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap_solve.add_argument("file", help="MPS path (optionally .gz)")
     _add_solver_flags(ap_solve)
     ap_solve.set_defaults(fn=cmd_solve)
+
+    ap_srv = sub.add_parser(
+        "serve",
+        help="async batching solve service: JSONL/MPS requests in, "
+        "result records out (README 'Serving')",
+    )
+    src = ap_srv.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--requests", help="JSONL request file, or '-' for stdin"
+    )
+    src.add_argument(
+        "--dir", help="directory of *.mps requests and/or *.jsonl spec files"
+    )
+    ap_srv.add_argument("--out", default="-", help="result JSONL path ('-' = stdout)")
+    ap_srv.add_argument("--batch", type=int, default=16, help="bucket slots")
+    ap_srv.add_argument(
+        "--flush-ms", type=float, default=50.0,
+        help="oldest-request age that launches a part-full bucket",
+    )
+    ap_srv.add_argument(
+        "--queue-depth", type=int, default=1024,
+        help="admission-control bound on total queued requests",
+    )
+    ap_srv.add_argument(
+        "--deadline-s", type=float, default=0.0,
+        help="default per-request deadline (0 = none)",
+    )
+    _add_solver_flags(ap_srv)
+    ap_srv.set_defaults(fn=cmd_serve, quiet=True)
 
     ap_b = sub.add_parser("backends", help="list registered backends")
     ap_b.set_defaults(fn=cmd_backends)

@@ -45,6 +45,17 @@ _SECTIONS = {
 }
 
 
+def _num(tok: str) -> float:
+    """Numeric field → float, accepting the Fortran D-exponent form
+    ("1.5D+02") that old fixed-format Netlib files carry — float() alone
+    rejects it and would fail the parse on a token the classic parsers
+    all accept."""
+    try:
+        return float(tok)
+    except ValueError:
+        return float(tok.replace("D", "E").replace("d", "e"))
+
+
 def read_mps(
     source: Union[str, os.PathLike, TextIO],
     dense: Optional[bool] = None,
@@ -172,7 +183,7 @@ def _parse(fh: TextIO, dense: Optional[bool]) -> LPProblem:
                     f"count: column name + row/value pairs): {line!r}"
                 )
             for k in range(1, len(fields) - 1, 2):
-                rname, val = fields[k], float(fields[k + 1])
+                rname, val = fields[k], _num(fields[k + 1])
                 if rname == obj_row:
                     obj_coef[j] = obj_coef.get(j, 0.0) + val
                 elif rname in free_rows:
@@ -190,7 +201,7 @@ def _parse(fh: TextIO, dense: Optional[bool]) -> LPProblem:
             # avoiding misparses when a set name collides with a row name.
             start = len(fields) % 2
             for k in range(start, len(fields) - 1, 2):
-                rname, val = fields[k], float(fields[k + 1])
+                rname, val = fields[k], _num(fields[k + 1])
                 if rname == obj_row:
                     c0 = -val
                 elif rname in free_rows:
@@ -203,7 +214,12 @@ def _parse(fh: TextIO, dense: Optional[bool]) -> LPProblem:
         elif section == "RANGES":
             start = len(fields) % 2  # same parity rule as RHS
             for k in range(start, len(fields) - 1, 2):
-                rname, val = fields[k], float(fields[k + 1])
+                rname, val = fields[k], _num(fields[k + 1])
+                if rname == obj_row or rname in free_rows:
+                    # A range on a free/objective row has no constraint to
+                    # widen — classic parsers ignore it (same convention
+                    # as RHS/COLUMNS entries on dropped free rows).
+                    continue
                 i = row_index.get(rname)
                 if i is None:
                     raise ValueError(f"RANGES references unknown row {rname!r}")
@@ -218,9 +234,9 @@ def _parse(fh: TextIO, dense: Optional[bool]) -> LPProblem:
                 val = 0.0
             else:
                 if len(fields) >= 4:
-                    cname, val = fields[2], float(fields[3])
+                    cname, val = fields[2], _num(fields[3])
                 else:
-                    cname, val = fields[1], float(fields[2])
+                    cname, val = fields[1], _num(fields[2])
             j = col_index.get(cname)
             if j is None:
                 raise ValueError(f"BOUNDS references unknown column {cname!r}")

@@ -149,6 +149,12 @@ def solve(
         use_fused = not (cfg.checkpoint_every and cfg.checkpoint_path)
     if hooks is not None:
         use_fused = False  # hooks need iteration boundaries on the host
+    if cfg.profile_dir:
+        # Profiling wants per-iteration dispatch boundaries: the fused
+        # loop is one opaque device program (and the profiler context
+        # only wraps the host loop), so --profile-dir silently produced
+        # nothing whenever the fused path ran.
+        use_fused = False
     if use_fused:
         fused = _try_fused(be, state, cfg, logger)
         if fused is not None:
@@ -229,6 +235,10 @@ def solve(
         profile_stack.close()
         solve_time = time.perf_counter() - t_solve0
         logger.close()
+        if cfg.profile_dir:
+            _write_profile_report(
+                cfg.profile_dir, history, setup_time, solve_time
+            )
 
     return _finalize(
         be, state, status, history, last, solve_time, setup_time,
@@ -413,3 +423,54 @@ def _maybe_profiler(profile_dir: Optional[str]):
     import contextlib
 
     return contextlib.nullcontext()
+
+
+def _write_profile_report(
+    profile_dir: str, history, setup_time: float, solve_time: float
+) -> None:
+    """--profile-dir honesty (VERDICT §5.1): through the tunneled-TPU
+    path ``jax.profiler.trace`` completes without writing a single file,
+    so a profile run used to yield an empty directory. The dispatch-level
+    timer (the per-iteration wall times the host loop measures anyway) is
+    the measurement that demonstrably works everywhere — always write its
+    report into the profile dir, and WARN when the trace produced nothing
+    so nobody mistakes an empty trace for a profiled run."""
+    import json
+    import os
+    import sys
+
+    report_name = "dispatch_timings.json"
+    try:
+        os.makedirs(profile_dir, exist_ok=True)
+        traced = any(
+            fn != report_name
+            for _, _, files in os.walk(profile_dir)
+            for fn in files
+        )
+        t_iters = [r.t_iter for r in history]
+        report = {
+            "jax_profiler_trace_wrote_files": traced,
+            "setup_s": round(setup_time, 6),
+            "solve_s": round(solve_time, 6),
+            "iterations": len(t_iters),
+            "t_iter_s": [round(t, 6) for t in t_iters],
+            "t_iter_mean_s": round(
+                sum(t_iters) / len(t_iters), 6
+            ) if t_iters else None,
+            "t_iter_max_s": round(max(t_iters), 6) if t_iters else None,
+        }
+        with open(os.path.join(profile_dir, report_name), "w") as fh:
+            json.dump(report, fh, indent=2)
+        if not traced:
+            print(
+                f"WARNING: jax.profiler.trace produced no files in "
+                f"{profile_dir!r} (known through tunneled TPUs); wrote the "
+                f"dispatch-level timing report to {report_name} instead",
+                file=sys.stderr,
+            )
+    except Exception as e:  # profiling must never sink the solve
+        print(
+            f"WARNING: could not write profile report to "
+            f"{profile_dir!r}: {e}",
+            file=sys.stderr,
+        )

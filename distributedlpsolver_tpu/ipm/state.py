@@ -54,6 +54,7 @@ class Status(enum.Enum):
     DUAL_INFEASIBLE = "dual_infeasible"  # == primal unbounded
     STALLED = "stalled"  # no progress over the stall window (fused loop)
     FAILED = "failed"  # supervisor exhausted its recovery ladder (supervisor/)
+    TIMEOUT = "timeout"  # serve/: request deadline expired before a result
 
 
 class FaultKind(enum.Enum):

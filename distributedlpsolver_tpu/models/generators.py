@@ -191,6 +191,22 @@ def random_batched_lp(batch: int, m: int, n: int, seed: int = 0) -> BatchedLP:
     return BatchedLP(c=c, A=A, b=b, name=f"batched_{batch}x{m}x{n}_s{seed}")
 
 
+def random_request_stream(
+    n_requests: int,
+    shapes=((8, 24), (12, 32)),
+    seed: int = 0,
+):
+    """Deterministic stream of standard-form LP requests at randomly drawn
+    shapes — the serve/ layer's test and load-probe workload. Each request
+    is a feasible+bounded :func:`random_dense_lp` instance (standard form:
+    all-equality rows, x ≥ 0), so the service routes it to the bucketed
+    fast path and every request has an OPTIMAL reference solve."""
+    rng = np.random.default_rng(seed)
+    for k in range(n_requests):
+        m, n = shapes[int(rng.integers(len(shapes)))]
+        yield random_dense_lp(m, n, seed=int(rng.integers(2**31 - 1)))
+
+
 def block_angular_lp(
     num_blocks: int,
     block_m: int,

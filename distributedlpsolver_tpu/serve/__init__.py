@@ -1,0 +1,44 @@
+"""Solve service: an async batching front-end that multiplexes many LP
+requests onto bucketed batched device programs (README "Serving").
+
+Public surface: :class:`SolveService` (submit → Future), configured by
+:class:`ServiceConfig` over a :class:`BucketSpec` ladder;
+:class:`RequestResult` is what futures resolve to;
+:class:`ServiceOverloaded` is the admission-control backpressure signal.
+"""
+
+from distributedlpsolver_tpu.serve.buckets import (
+    BucketSpec,
+    BucketTable,
+    pad_standard_form,
+    padding_waste,
+)
+from distributedlpsolver_tpu.serve.records import (
+    RequestResult,
+    latency_summary,
+)
+from distributedlpsolver_tpu.serve.scheduler import (
+    PendingRequest,
+    Scheduler,
+    ServiceOverloaded,
+)
+from distributedlpsolver_tpu.serve.service import (
+    ServiceConfig,
+    SolveService,
+    standard_form,
+)
+
+__all__ = [
+    "BucketSpec",
+    "BucketTable",
+    "PendingRequest",
+    "RequestResult",
+    "Scheduler",
+    "ServiceConfig",
+    "ServiceOverloaded",
+    "SolveService",
+    "latency_summary",
+    "pad_standard_form",
+    "padding_waste",
+    "standard_form",
+]

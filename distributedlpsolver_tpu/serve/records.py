@@ -1,0 +1,102 @@
+"""Service telemetry records: per-request results and service-level
+latency/throughput summaries (the serving analogue of the per-iteration
+IterRecord stream — one JSONL record per request, plus batch and summary
+events, all through utils/logging.IterLogger.event)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from distributedlpsolver_tpu.ipm.state import FaultRecord, Status
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Outcome of one service request, with the timing split the ISSUE
+    names: queue (submit → dispatch), compile (bucket program build, 0 on
+    a warm bucket), solve (device batch wall, shared by batch-mates)."""
+
+    request_id: int
+    name: str
+    status: Status
+    objective: float
+    x: Optional[np.ndarray]
+    iterations: int
+    rel_gap: float
+    pinf: float
+    dinf: float
+    bucket: Optional[Tuple[int, int, int]]  # (m, n, batch); None = solo path
+    queue_ms: float
+    compile_ms: float
+    solve_ms: float
+    total_ms: float
+    padding_waste: float
+    dispatch_index: int = -1
+    slot: int = -1
+    retried_solo: bool = False
+    faults: List[FaultRecord] = dataclasses.field(default_factory=list)
+
+    def record(self) -> dict:
+        """The JSONL record for this request (x is elided — solutions go
+        back through the future, not the telemetry stream)."""
+        return {
+            "event": "request",
+            "id": self.request_id,
+            "name": self.name,
+            "status": self.status.value,
+            "objective": float(self.objective),
+            "iterations": int(self.iterations),
+            "rel_gap": float(self.rel_gap),
+            "pinf": float(self.pinf),
+            "dinf": float(self.dinf),
+            "bucket": list(self.bucket) if self.bucket else None,
+            "queue_ms": round(self.queue_ms, 3),
+            "compile_ms": round(self.compile_ms, 3),
+            "solve_ms": round(self.solve_ms, 3),
+            "total_ms": round(self.total_ms, 3),
+            "padding_waste": round(self.padding_waste, 4),
+            "dispatch": self.dispatch_index,
+            "slot": self.slot,
+            "retried_solo": self.retried_solo,
+            "faults": [f.asdict() for f in self.faults],
+        }
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    return float(np.percentile(np.asarray(values), q))
+
+
+def latency_summary(results: List[RequestResult]) -> dict:
+    """p50/p95 latency + throughput over completed requests — the
+    service-level summary event emitted at drain/shutdown."""
+    done = [r for r in results if r.status is not Status.TIMEOUT]
+    totals = [r.total_ms for r in done]
+    queues = [r.queue_ms for r in results]
+    span_s = max(totals) / 1e3 if totals else 0.0
+    by_status: dict = {}
+    for r in results:
+        by_status[r.status.value] = by_status.get(r.status.value, 0) + 1
+    return {
+        "requests": len(results),
+        "status_breakdown": by_status,
+        "latency_ms_p50": round(_percentile(totals, 50), 3),
+        "latency_ms_p95": round(_percentile(totals, 95), 3),
+        "latency_ms_max": round(max(totals), 3) if totals else 0.0,
+        "queue_ms_p50": round(_percentile(queues, 50), 3),
+        "queue_ms_p95": round(_percentile(queues, 95), 3),
+        # Throughput proxy over the submit→last-completion span; the load
+        # probe reports wall-clock throughput over its own clock too.
+        "throughput_rps": round(len(done) / span_s, 2) if span_s > 0 else 0.0,
+        "mean_padding_waste": round(
+            float(np.mean([r.padding_waste for r in results])), 4
+        )
+        if results
+        else 0.0,
+        "solo_retries": sum(1 for r in results if r.retried_solo),
+        "faults": sum(len(r.faults) for r in results),
+    }

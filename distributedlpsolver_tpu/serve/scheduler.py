@@ -1,0 +1,152 @@
+"""Micro-batch scheduler: per-bucket queues, flush policy, admission
+control, and deadline accounting.
+
+Flush policy (continuous batching): a bucket launches when it holds a
+full batch, or when its oldest request's age exceeds ``flush_s`` — the
+knob that trades padding waste (early flushes dispatch part-full
+buckets) against tail latency (late flushes make the first request wait
+for batch-mates). Deadlines are checked at pop time: a request whose
+deadline passed while queued is split out of the batch and returned
+TIMEOUT without ever occupying a slot — an expired request can never
+poison its batch-mates' dispatch.
+
+Admission control is a single bounded depth across all buckets: submit
+past ``max_depth`` raises :class:`ServiceOverloaded` (backpressure is the
+caller's signal to shed load; queueing unboundedly just converts overload
+into timeout storms).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributedlpsolver_tpu.serve.buckets import BucketSpec, BucketTable
+
+
+class ServiceOverloaded(RuntimeError):
+    """Admission control rejected a submit: queue depth at its bound."""
+
+
+@dataclasses.dataclass
+class PendingRequest:
+    """One queued request (standard form: min cᵀx, Ax=b, x≥0)."""
+
+    request_id: int
+    name: str
+    c: np.ndarray
+    A: np.ndarray
+    b: np.ndarray
+    tol: float
+    future: object  # concurrent.futures.Future
+    t_submit: float
+    deadline: Optional[float] = None  # absolute perf_counter() time
+    problem: object = None  # general-form LPProblem (solo path only)
+
+    @property
+    def m(self) -> int:
+        return self.A.shape[0] if self.A is not None else self.problem.m
+
+    @property
+    def n(self) -> int:
+        return self.A.shape[1] if self.A is not None else self.problem.n
+
+
+# Queue key: the bucket spec plus the request tolerance — tol is part of
+# the compiled program's static params, so mixing tolerances in one batch
+# would either recompile per dispatch or solve some requests to the wrong
+# tolerance. Requests at a novel tol pay one compile and then share it.
+QueueKey = Tuple[BucketSpec, float]
+
+
+class Scheduler:
+    """Owns the per-bucket queues; all methods require the service lock."""
+
+    def __init__(
+        self, table: BucketTable, max_depth: int, flush_s: float
+    ):
+        self.table = table
+        self.max_depth = max_depth
+        self.flush_s = flush_s
+        self._queues: Dict[QueueKey, deque] = {}
+        self._depth = 0
+
+    def depth(self) -> int:
+        return self._depth
+
+    def occupancy(self) -> dict:
+        return {
+            f"{k[0].m}x{k[0].n}x{k[0].batch}@{k[1]:g}": len(q)
+            for k, q in self._queues.items()
+            if q
+        }
+
+    def add(self, p: PendingRequest) -> QueueKey:
+        if self._depth >= self.max_depth:
+            raise ServiceOverloaded(
+                f"queue depth {self._depth} at max_queue_depth="
+                f"{self.max_depth}; shed load or raise the bound"
+            )
+        if p.A is None:  # general form: solo pseudo-bucket (batch of 1)
+            key = (BucketSpec(p.m, p.n, 1), p.tol)
+        else:
+            key = (self.table.spec_for(p.m, p.n), p.tol)
+        self._queues.setdefault(key, deque()).append(p)
+        self._depth += 1
+        return key
+
+    def ready(self, now: float) -> List[QueueKey]:
+        """Keys whose bucket should launch now: full, aged past flush_s,
+        or holding a request whose deadline already passed (so TIMEOUTs
+        are returned promptly, not at the next natural flush)."""
+        out = []
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            spec = key[0]
+            if (
+                len(q) >= spec.batch
+                or now - q[0].t_submit >= self.flush_s
+                or any(p.deadline is not None and now >= p.deadline for p in q)
+            ):
+                out.append(key)
+        return out
+
+    def next_event_in(self, now: float) -> Optional[float]:
+        """Seconds until the earliest flush deadline or request deadline —
+        the dispatcher's wait bound (None: queues empty, wait for a
+        submit)."""
+        t = None
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            cand = q[0].t_submit + self.flush_s
+            for p in q:
+                if p.deadline is not None:
+                    cand = min(cand, p.deadline)
+            t = cand if t is None else min(t, cand)
+        if t is None:
+            return None
+        return max(0.0, t - now)
+
+    def pop(
+        self, key: QueueKey, now: float
+    ) -> Tuple[List[PendingRequest], List[PendingRequest]]:
+        """Take up to one batch off ``key``'s queue, splitting out
+        deadline-expired requests: returns (live, expired)."""
+        q = self._queues.get(key)
+        live: List[PendingRequest] = []
+        expired: List[PendingRequest] = []
+        spec = key[0]
+        while q and len(live) < spec.batch:
+            p = q.popleft()
+            self._depth -= 1
+            if p.deadline is not None and now >= p.deadline:
+                expired.append(p)
+            else:
+                live.append(p)
+        return live, expired

@@ -1,0 +1,581 @@
+"""The solve service: async request multiplexing onto bucketed batched
+device programs.
+
+``SolveService.submit(problem, deadline=..., tol=...) -> Future`` accepts
+independent, asynchronously-arriving LP requests and multiplexes them
+onto the device the way the batched backend proved is right for this
+domain (one vmap'd masked program per shape bucket — see
+backends/batched.solve_bucket and MPAX, arXiv:2412.09734). A single
+dispatcher thread runs the continuous-batching loop:
+
+    submit → admission control → per-(bucket, tol) queue →
+    flush (full batch OR oldest age > flush_s) →
+    pad + mask → one compiled device program → demux to futures
+
+Standard-form requests (min cᵀx, Ax=b, x≥0 — the serving workload) ride
+the bucketed fast path; general-form problems (finite bounds, ranged
+rows, sparse A) take the solo path through ``ipm.solve`` — same futures,
+same records, batch=1.
+
+Fault tolerance: a dispatch that raises (or blows ``batch_timeout_s``)
+is retried whole once, then degrades to per-request solo solves through
+``supervisor.supervised_solve`` — the existing recovery ladder — so a
+wedged batch costs its members a retry, never a silent drop. Members the
+batch leaves unfinished (stall/iteration limit) take the same solo
+ladder individually.
+
+Telemetry: one JSONL record per request (queue/compile/solve split,
+padding waste, faults), one per dispatched batch, and a service summary
+at shutdown — all through utils/logging.IterLogger.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import (
+    FaultKind,
+    FaultRecord,
+    Status,
+)
+from distributedlpsolver_tpu.models.problem import LPProblem
+from distributedlpsolver_tpu.serve.buckets import (
+    BucketSpec,
+    BucketTable,
+    pad_standard_form,
+    padding_waste,
+)
+from distributedlpsolver_tpu.serve.records import (
+    RequestResult,
+    latency_summary,
+)
+from distributedlpsolver_tpu.serve.scheduler import (
+    PendingRequest,
+    QueueKey,
+    Scheduler,
+    ServiceOverloaded,
+)
+from distributedlpsolver_tpu.supervisor.watchdog import (
+    StepDeadlineExceeded,
+    run_with_deadline,
+)
+from distributedlpsolver_tpu.utils.logging import IterLogger
+
+_INF = np.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceConfig:
+    """Tunables of the serving loop (see README "Serving")."""
+
+    # Explicit bucket ladder; None = auto power-of-two buckets of ``batch``
+    # slots created on demand.
+    buckets: Optional[Sequence[BucketSpec]] = None
+    batch: int = 16
+    # Oldest-request age that forces a part-full bucket to launch. The
+    # latency/padding-waste tradeoff knob: lower = snappier tails, more
+    # padding; higher = fuller batches.
+    flush_s: float = 0.05
+    # Admission control: total queued requests across all buckets before
+    # submit raises ServiceOverloaded.
+    max_queue_depth: int = 1024
+    # Default per-request deadline (seconds from submit); None = no
+    # deadline. A request past deadline at dispatch time is returned
+    # TIMEOUT without occupying a batch slot.
+    default_deadline_s: Optional[float] = None
+    # Watchdog over one batch dispatch (supervisor/watchdog.py semantics:
+    # abandonment, not cancellation). None/0 disables.
+    batch_timeout_s: Optional[float] = None
+    # Whole-batch retries before degrading to per-request solo recovery.
+    max_batch_retries: int = 1
+    # Route batch-fault survivors and unfinished members through the
+    # supervisor's recovery ladder individually (False: fail them fast).
+    solo_recovery: bool = True
+    solo_backend: str = "auto"
+    # Service telemetry JSONL path (request/batch/fault/summary events).
+    log_jsonl: Optional[str] = None
+    # Deterministic fault injection (tests): called with
+    # (dispatch_index, bucket_key) before each batch launch; raising makes
+    # that dispatch attempt fault.
+    fault_injector: Optional[Callable[[int, tuple], None]] = None
+    drain_poll_s: float = 0.005
+
+
+def standard_form(problem: LPProblem):
+    """(c, A, b) when ``problem`` is a pure standard-form LP the bucketed
+    path consumes directly (dense A, all-equality rows, x ≥ 0, no upper
+    bounds, no constant, minimized); None routes it to the solo path."""
+    A = problem.A
+    if not isinstance(A, np.ndarray):
+        return None
+    if problem.maximize or problem.c0 != 0.0:
+        return None
+    if not (
+        np.array_equal(problem.rlb, problem.rub)
+        and np.all(np.isfinite(problem.rlb))
+        and np.all(problem.lb == 0.0)
+        and np.all(problem.ub == _INF)
+    ):
+        return None
+    return (
+        np.asarray(problem.c, dtype=np.float64),
+        np.asarray(A, dtype=np.float64),
+        np.asarray(problem.rlb, dtype=np.float64),
+    )
+
+
+class SolveService:
+    """In-process async batching front-end over the batched backend."""
+
+    def __init__(
+        self,
+        config: Optional[ServiceConfig] = None,
+        solver_config: Optional[SolverConfig] = None,
+        auto_start: bool = True,
+    ):
+        self.config = config or ServiceConfig()
+        # The bucket path solves raw standard form — presolve/scaling and
+        # per-iteration diagnostics are general-form driver concerns.
+        self.solver_config = (solver_config or SolverConfig()).replace(
+            verbose=False, log_jsonl=None, checkpoint_path=None,
+            checkpoint_every=0, profile_dir=None,
+        )
+        self.scheduler = Scheduler(
+            BucketTable(self.config.buckets, batch=self.config.batch),
+            self.config.max_queue_depth,
+            self.config.flush_s,
+        )
+        self._logger = IterLogger(
+            verbose=False, jsonl_path=self.config.log_jsonl
+        )
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._results: List[RequestResult] = []
+        self._next_id = 0
+        self._dispatch_seq = 0
+        self._inflight = 0
+        self._stopping = False
+        self._warm: set = set()
+        self._compiles = 0
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "SolveService":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="dlps-serve-dispatch"
+            )
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "SolveService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted request has a result. False iff
+        ``timeout`` expired first."""
+        t0 = time.perf_counter()
+        while True:
+            with self._lock:
+                if self.scheduler.depth() == 0 and self._inflight == 0:
+                    return True
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                return False
+            time.sleep(self.config.drain_poll_s)
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop accepting work; by default finish what was accepted
+        (drain), then stop the dispatcher and emit the summary record."""
+        with self._wake:
+            self._stopping = True
+            self._wake.notify_all()
+        if drain:
+            self.drain(timeout)
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._logger.event({"event": "service", **self.stats()})
+        self._logger.close()
+
+    # -- submission ------------------------------------------------------
+
+    def submit(
+        self,
+        problem: LPProblem,
+        deadline: Optional[float] = None,
+        tol: Optional[float] = None,
+        name: Optional[str] = None,
+    ) -> Future:
+        """Enqueue one LP; the Future resolves to a RequestResult.
+
+        ``deadline`` is seconds from now: a request still queued when it
+        expires is returned ``Status.TIMEOUT`` (it never poisons its
+        batch — expiry is checked before a slot is assigned). ``tol``
+        defaults to the service solver config's tolerance; a novel tol
+        compiles its own bucket program once, then shares it.
+        """
+        sf = standard_form(problem)
+        now = time.perf_counter()
+        if deadline is None:
+            deadline = self.config.default_deadline_s
+        p = PendingRequest(
+            request_id=-1,
+            name=name or problem.name,
+            c=sf[0] if sf else None,
+            A=sf[1] if sf else None,
+            b=sf[2] if sf else None,
+            tol=tol if tol is not None else self.solver_config.tol,
+            future=Future(),
+            t_submit=now,
+            deadline=None if deadline is None else now + deadline,
+            problem=None if sf else problem,
+        )
+        with self._wake:
+            if self._stopping:
+                raise RuntimeError("SolveService is shut down")
+            p.request_id = self._next_id
+            self._next_id += 1
+            try:
+                self.scheduler.add(p)
+            except ServiceOverloaded:
+                self._logger.event(
+                    {
+                        "event": "reject",
+                        "id": p.request_id,
+                        "name": p.name,
+                        "queue_depth": self.scheduler.depth(),
+                    }
+                )
+                raise
+            self._wake.notify_all()
+        return p.future
+
+    # -- dispatcher ------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                now = time.perf_counter()
+                ready = self.scheduler.ready(now)
+                if not ready:
+                    if self._stopping and self.scheduler.depth() == 0:
+                        return
+                    # Part-full buckets flush on a clock; wake for the
+                    # earliest flush/request deadline or a new submit.
+                    self._wake.wait(timeout=self.scheduler.next_event_in(now))
+                    continue
+                batches = []
+                for key in ready:
+                    live, expired = self.scheduler.pop(key, now)
+                    batches.append((key, live, expired))
+                    self._inflight += len(live) + len(expired)
+            for key, live, expired in batches:  # solve outside the lock
+                try:
+                    self._dispatch(key, live, expired)
+                finally:
+                    with self._lock:
+                        self._inflight -= len(live) + len(expired)
+
+    def _dispatch(
+        self,
+        key: QueueKey,
+        live: List[PendingRequest],
+        expired: List[PendingRequest],
+    ) -> None:
+        now = time.perf_counter()
+        for p in expired:
+            self._finish(
+                p,
+                RequestResult(
+                    request_id=p.request_id,
+                    name=p.name,
+                    status=Status.TIMEOUT,
+                    objective=float("nan"),
+                    x=None,
+                    iterations=0,
+                    rel_gap=_INF,
+                    pinf=_INF,
+                    dinf=_INF,
+                    bucket=key[0].key(),
+                    queue_ms=(now - p.t_submit) * 1e3,
+                    compile_ms=0.0,
+                    solve_ms=0.0,
+                    total_ms=(now - p.t_submit) * 1e3,
+                    padding_waste=0.0,
+                ),
+            )
+        if not live:
+            return
+        if live[0].A is None:  # general-form solo pseudo-bucket
+            for p in live:
+                self._solo(p, key, now, [], retried=False)
+            return
+        self._dispatch_bucket(key, live, now)
+
+    def _dispatch_bucket(
+        self, key: QueueKey, live: List[PendingRequest], t_dispatch: float
+    ) -> None:
+        from distributedlpsolver_tpu.backends.batched import (
+            bucket_cache_size,
+            solve_bucket,
+        )
+        from distributedlpsolver_tpu.models.generators import BatchedLP
+
+        spec, tol = key
+        B = spec.batch
+        A = np.zeros((B, spec.m, spec.n))
+        b = np.zeros((B, spec.m))
+        c = np.zeros((B, spec.n))
+        active = np.zeros(B, dtype=bool)
+        for k, p in enumerate(live):
+            c[k], A[k], b[k] = pad_standard_form(p.c, p.A, p.b, spec.m, spec.n)
+            active[k] = True
+        for k in range(len(live), B):  # inactive slots: well-posed copies
+            A[k], b[k], c[k] = A[0], b[0], c[0]
+        batch = BatchedLP(c=c, A=A, b=b, name=f"bucket_{spec.m}x{spec.n}")
+        cfg = self.solver_config.replace(tol=tol)
+        waste = padding_waste(sum(p.m * p.n for p in live), spec)
+        seq = self._dispatch_seq
+        self._dispatch_seq += 1
+
+        # Cold bucket: one max_iter=1 call compiles the program (max_iter
+        # is traced, so it is the SAME executable the real solve reuses) —
+        # the compile cost is stamped as compile_ms on this batch's
+        # requests instead of polluting solve_ms forever after.
+        warm_key = (spec.key(), tol, cfg.dtype)
+        compile_ms = 0.0
+        if warm_key not in self._warm:
+            size0 = bucket_cache_size()
+            t0 = time.perf_counter()
+            solve_bucket(batch, active, cfg, max_iter=1)
+            compile_ms = (time.perf_counter() - t0) * 1e3
+            self._warm.add(warm_key)
+            self._compiles += bucket_cache_size() - size0
+
+        faults: List[FaultRecord] = []
+        res = None
+        for attempt in range(1 + self.config.max_batch_retries):
+            try:
+                if self.config.fault_injector is not None:
+                    self.config.fault_injector(seq, key)
+
+                def _solve():
+                    return solve_bucket(batch, active, cfg)
+
+                res = run_with_deadline(
+                    _solve, self.config.batch_timeout_s, seq
+                )
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except StepDeadlineExceeded as e:
+                fault = FaultRecord(
+                    FaultKind.HANG, -1, "batched", str(e),
+                    action="retry_batch"
+                    if attempt < self.config.max_batch_retries
+                    else "solo_fallback",
+                )
+            except Exception as e:
+                fault = FaultRecord(
+                    FaultKind.CRASH, -1, "batched",
+                    f"{type(e).__name__}: {e}",
+                    action="retry_batch"
+                    if attempt < self.config.max_batch_retries
+                    else "solo_fallback",
+                )
+            fault.at_time = time.time()
+            faults.append(fault)
+            self._logger.event(
+                {
+                    "event": "fault",
+                    "dispatch": seq,
+                    "bucket": list(spec.key()),
+                    "kind": fault.kind.value,
+                    "action": fault.action,
+                    "detail": fault.detail[:300],
+                }
+            )
+
+        with self._lock:
+            depth = self.scheduler.depth()
+            occupancy = self.scheduler.occupancy()
+        self._logger.event(
+            {
+                "event": "batch",
+                "dispatch": seq,
+                "bucket": list(spec.key()),
+                "tol": tol,
+                "live": len(live),
+                "padding_waste": round(waste, 4),
+                "compile_ms": round(compile_ms, 3),
+                "solve_ms": round(res.solve_time * 1e3, 3) if res else None,
+                "attempts": len(faults) + (1 if res is not None else 0),
+                "queue_depth": depth,
+                "occupancy": occupancy,
+            }
+        )
+
+        if res is None:
+            # Batch recovery exhausted: every member goes through the
+            # supervisor's ladder individually — retried or failed one by
+            # one, never silently dropped.
+            for p in live:
+                self._solo(p, key, t_dispatch, list(faults), retried=True)
+            return
+
+        solve_ms = res.solve_time * 1e3
+        for k, p in enumerate(live):
+            status = res.status[k]
+            if status is not Status.OPTIMAL and self.config.solo_recovery:
+                member_fault = FaultRecord(
+                    FaultKind.NUMERICAL,
+                    int(res.iterations[k]),
+                    "batched",
+                    f"batched member finished {status.value}",
+                    action="solo_fallback",
+                )
+                self._solo(
+                    p, key, t_dispatch, faults + [member_fault], retried=True
+                )
+                continue
+            x_real = res.x[k, : p.n]
+            done = time.perf_counter()
+            self._finish(
+                p,
+                RequestResult(
+                    request_id=p.request_id,
+                    name=p.name,
+                    status=status,
+                    # Real-column objective: pad rows pin their pad
+                    # columns at cost 1 each, so the padded pobj is
+                    # offset — recompute on the request's own c.
+                    objective=float(p.c @ x_real),
+                    x=x_real,
+                    iterations=int(res.iterations[k]),
+                    rel_gap=float(res.rel_gap[k]),
+                    pinf=float(res.pinf[k]),
+                    dinf=float(res.dinf[k]),
+                    bucket=spec.key(),
+                    queue_ms=(t_dispatch - p.t_submit) * 1e3,
+                    compile_ms=compile_ms,
+                    solve_ms=solve_ms,
+                    total_ms=(done - p.t_submit) * 1e3,
+                    padding_waste=waste,
+                    dispatch_index=seq,
+                    slot=k,
+                    faults=list(faults),
+                ),
+            )
+
+    def _solo(
+        self,
+        p: PendingRequest,
+        key: QueueKey,
+        t_dispatch: float,
+        faults: List[FaultRecord],
+        retried: bool,
+    ) -> None:
+        """Per-request path: general-form requests, and bucket members
+        whose batch (or own verdict) failed — through the supervisor's
+        recovery ladder so they are retried or failed individually."""
+        from distributedlpsolver_tpu.ipm.driver import solve
+        from distributedlpsolver_tpu.supervisor import (
+            SolveFailure,
+            SupervisorConfig,
+            supervised_solve,
+        )
+
+        problem = p.problem
+        if problem is None:
+            n = p.A.shape[1]
+            problem = LPProblem(
+                c=p.c, A=p.A, rlb=p.b, rub=p.b,
+                lb=np.zeros(n), ub=np.full(n, _INF), name=p.name,
+            )
+        cfg = self.solver_config.replace(tol=p.tol)
+        t0 = time.perf_counter()
+        try:
+            if self.config.solo_recovery:
+                r = supervised_solve(
+                    problem,
+                    backend=self.config.solo_backend,
+                    config=cfg,
+                    supervisor=SupervisorConfig(backoff_base=0.01),
+                )
+            else:
+                r = solve(problem, backend=self.config.solo_backend, config=cfg)
+            status, faults = r.status, faults + list(r.faults)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except SolveFailure as e:
+            r, status, faults = None, Status.FAILED, faults + list(e.faults)
+        except Exception as e:
+            r, status = None, Status.FAILED
+            faults = faults + [
+                FaultRecord(
+                    FaultKind.CRASH, -1, self.config.solo_backend,
+                    f"{type(e).__name__}: {e}", action="give_up",
+                )
+            ]
+        done = time.perf_counter()
+        self._finish(
+            p,
+            RequestResult(
+                request_id=p.request_id,
+                name=p.name,
+                status=status,
+                objective=r.objective if r else float("nan"),
+                x=r.x if r else None,
+                iterations=r.iterations if r else 0,
+                rel_gap=r.rel_gap if r else _INF,
+                pinf=r.pinf if r else _INF,
+                dinf=r.dinf if r else _INF,
+                bucket=None if p.A is None else key[0].key(),
+                queue_ms=(t_dispatch - p.t_submit) * 1e3,
+                compile_ms=0.0,
+                solve_ms=(done - t0) * 1e3,
+                total_ms=(done - p.t_submit) * 1e3,
+                padding_waste=0.0,
+                retried_solo=retried,
+                faults=faults,
+            ),
+        )
+
+    def _finish(self, p: PendingRequest, result: RequestResult) -> None:
+        with self._lock:
+            self._results.append(result)
+        self._logger.event(result.record())
+        p.future.set_result(result)
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            results = list(self._results)
+            depth = self.scheduler.depth()
+            occupancy = self.scheduler.occupancy()
+        return {
+            **latency_summary(results),
+            "queue_depth": depth,
+            "occupancy": occupancy,
+            "dispatches": self._dispatch_seq,
+            "programs_compiled": self._compiles,
+            "buckets": [
+                list(s.key()) for s in self.scheduler.table.specs()
+            ],
+        }

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Optional, TextIO
 
 from distributedlpsolver_tpu.ipm.state import IterRecord
@@ -53,6 +54,11 @@ class IterLogger:
         )
         self._fsync = fsync
         self._printed_header = False
+        # The serve layer writes this stream from two threads (the submit
+        # thread logs admission rejections while the dispatcher logs
+        # results); whole-line writes interleave safely but flush/fsync
+        # pairs do not, so serialize record emission.
+        self._lock = threading.Lock()
 
     def log(self, rec: IterRecord) -> None:
         if self.verbose:
@@ -66,10 +72,11 @@ class IterLogger:
                 f"{rec.t_iter:>8.4f}"
             )
         if self._fh:
-            self._fh.write(json.dumps(rec.asdict()) + "\n")
-            self._fh.flush()
-            if self._fsync:
-                os.fsync(self._fh.fileno())
+            with self._lock:
+                self._fh.write(json.dumps(rec.asdict()) + "\n")
+                self._fh.flush()
+                if self._fsync:
+                    os.fsync(self._fh.fileno())
 
     def event(self, payload: dict) -> None:
         """Write one non-iteration event record (fault classified, resume
@@ -77,13 +84,15 @@ class IterLogger:
         Events carry an ``"event"`` key so consumers separate them from
         iteration records (which never have one)."""
         if self._fh:
-            self._fh.write(json.dumps(payload) + "\n")
-            self._fh.flush()
-            if self._fsync:
-                os.fsync(self._fh.fileno())
+            with self._lock:
+                self._fh.write(json.dumps(payload) + "\n")
+                self._fh.flush()
+                if self._fsync:
+                    os.fsync(self._fh.fileno())
 
     def close(self) -> None:
         if self._fh:
-            self._fh.flush()
-            self._fh.close()
-            self._fh = None
+            with self._lock:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None

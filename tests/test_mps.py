@@ -290,6 +290,176 @@ ENDATA
         np.testing.assert_allclose(q.rlb, [1.0])
 
 
+class TestAdversarial:
+    """Adversarial parser inputs beyond the two hand-written fixtures:
+    fixed-format layout quirks, RANGES sign conventions per row type,
+    duplicate entries in every section, and a writer-driven fuzz
+    round-trip (VERDICT "What's missing" #5)."""
+
+    def test_fixed_format_column_layout(self):
+        # Genuine fixed-column layout (fields at columns 2/5/15/25/40/50,
+        # wide name fields padded with blanks) plus trailing whitespace —
+        # must tokenize identically to free format.
+        text = (
+            "NAME          FIXED\n"
+            "ROWS\n"
+            " N  COST\n"
+            " L  LIM1      \n"
+            " E  EQ2\n"
+            "COLUMNS\n"
+            "    X1        COST            1.0   LIM1            2.0\n"
+            "    X1        EQ2             1.0\n"
+            "    X2        COST            3.0   EQ2             1.0   \n"
+            "RHS\n"
+            "    RHS       LIM1            4.0   EQ2             5.0\n"
+            "BOUNDS\n"
+            " UP BND       X1              9.0\n"
+            "ENDATA\n"
+        )
+        p = read_mps_string(text)
+        assert p.shape == (2, 2)
+        np.testing.assert_allclose(np.asarray(p.A), [[2.0, 0.0], [1.0, 1.0]])
+        np.testing.assert_allclose(p.c, [1.0, 3.0])
+        np.testing.assert_allclose(p.ub, [9.0, np.inf])
+
+    def test_fortran_d_exponents(self):
+        # Old fixed-format Netlib files carry Fortran D exponents in
+        # values; every value-bearing section must accept them.
+        text = """\
+NAME D
+ROWS
+ N obj
+ L l1
+COLUMNS
+    x obj 1.5D+01 l1 -2.5d-01
+RHS
+    R l1 1.0D2
+RANGES
+    RNG l1 4.0D0
+BOUNDS
+ UP B x 1.0D+03
+ENDATA
+"""
+        p = read_mps_string(text)
+        assert p.c[0] == 15.0
+        assert np.asarray(p.A)[0, 0] == -0.25
+        np.testing.assert_allclose([p.rlb[0], p.rub[0]], [96.0, 100.0])
+        assert p.ub[0] == 1000.0
+
+    def test_ranges_sign_conventions_all_row_types(self):
+        # |r| on L and G regardless of sign; signed convention on E;
+        # zero range on E collapses to the equality itself.
+        text = """\
+NAME R
+ROWS
+ N obj
+ L l1
+ L l2
+ G g1
+ G g2
+ E e0
+COLUMNS
+    x obj 1.0 l1 1.0
+    x l2 1.0 g1 1.0
+    x g2 1.0 e0 1.0
+RHS
+    R l1 10.0 l2 10.0
+    R g1 2.0 g2 2.0
+    R e0 5.0
+RANGES
+    RNG l1 4.0 l2 -4.0
+    RNG g1 3.0 g2 -3.0
+    RNG e0 0.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        np.testing.assert_allclose(p.rlb, [6.0, 6.0, 2.0, 2.0, 5.0])
+        np.testing.assert_allclose(p.rub, [10.0, 10.0, 5.0, 5.0, 5.0])
+
+    def test_ranges_on_objective_and_free_rows_ignored(self):
+        # A range on an N row has no constraint to widen; classic parsers
+        # drop it like RHS entries on free rows — ours must not crash.
+        text = """\
+NAME N
+ROWS
+ N obj
+ N free2
+ L l1
+COLUMNS
+    x obj 1.0 l1 1.0
+RHS
+    R l1 8.0
+RANGES
+    RNG obj 3.0 free2 2.0
+    RNG l1 2.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        np.testing.assert_allclose([p.rlb[0], p.rub[0]], [6.0, 8.0])
+
+    def test_duplicate_entries_within_one_line_summed(self):
+        text = """\
+NAME D2
+ROWS
+ N obj
+ E e1
+COLUMNS
+    x obj 1.0 e1 1.0 e1 2.0
+    x obj 0.5
+RHS
+    R e1 3.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        assert np.asarray(p.A)[0, 0] == 3.0  # duplicates summed
+        assert p.c[0] == 1.5  # objective duplicates summed too
+
+    def test_duplicate_rhs_ranges_bounds_last_wins(self):
+        # Pins the overwrite semantics for duplicate RHS/RANGES/BOUNDS
+        # entries (classic parsers disagree; ours is last-wins).
+        text = """\
+NAME D3
+ROWS
+ N obj
+ L l1
+COLUMNS
+    x obj 1.0 l1 1.0
+RHS
+    R l1 5.0 l1 9.0
+RANGES
+    RNG l1 2.0 l1 4.0
+BOUNDS
+ UP B x 7.0
+ UP B x 3.0
+ENDATA
+"""
+        p = read_mps_string(text)
+        np.testing.assert_allclose([p.rlb[0], p.rub[0]], [5.0, 9.0])
+        assert p.ub[0] == 3.0
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_roundtrip_via_writer(self, tmp_path, seed):
+        # Writer-driven fuzz: random general LPs at random shapes (mixed
+        # row senses, ranges, boxed/free/one-sided columns) must survive
+        # write→read bit-exactly on every field the format carries.
+        rng = np.random.default_rng(1000 + seed)
+        m = int(rng.integers(2, 20))
+        n = int(rng.integers(2, 30))
+        p = random_general_lp(m, n, seed=seed)
+        path = tmp_path / f"fuzz{seed}.mps"
+        write_mps(p, path)
+        q = read_mps(path)
+        assert q.shape == p.shape
+        np.testing.assert_allclose(q.c, p.c, rtol=1e-15)
+        np.testing.assert_allclose(
+            np.asarray(q.A), np.asarray(p.A), rtol=1e-15
+        )
+        np.testing.assert_allclose(q.rlb, p.rlb, rtol=1e-12)
+        np.testing.assert_allclose(q.rub, p.rub, rtol=1e-12)
+        np.testing.assert_allclose(q.lb, p.lb, rtol=1e-15)
+        np.testing.assert_allclose(q.ub, p.ub, rtol=1e-15)
+
+
 def test_objsense_max_round_trip(tmp_path):
     """A maximize problem must survive write->read: OBJSENSE MAX emitted,
     stored-minimized c/c0 identical, and the sense-corrected objective of
