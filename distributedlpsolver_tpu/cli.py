@@ -210,9 +210,15 @@ def _iter_request_specs(args):
 def cmd_serve(args) -> int:
     """Serve loop: read LP requests, multiplex them through the async
     batching SolveService, write one JSONL result record per request."""
+    import time
+
     from distributedlpsolver_tpu.io.mps import read_mps
     from distributedlpsolver_tpu.models.generators import random_dense_lp
-    from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+    from distributedlpsolver_tpu.serve import (
+        ServiceConfig,
+        ServiceOverloaded,
+        SolveService,
+    )
 
     svc_cfg = ServiceConfig(
         batch=args.batch,
@@ -236,12 +242,20 @@ def cmd_serve(args) -> int:
                         int(spec["m"]), int(spec["n"]),
                         seed=int(spec.get("seed", 0)),
                     )
-                fut = svc.submit(
-                    problem,
-                    deadline=spec.get("deadline_s"),
-                    tol=spec.get("tol"),
-                    name=str(spec.get("id", problem.name)),
-                )
+                while True:
+                    try:
+                        fut = svc.submit(
+                            problem,
+                            deadline=spec.get("deadline_s"),
+                            tol=spec.get("tol"),
+                            name=str(spec.get("id", problem.name)),
+                        )
+                        break
+                    except ServiceOverloaded:
+                        # Backpressure: the reader outran the solver.
+                        # Block until the queue drains a little instead
+                        # of crashing mid-stream.
+                        time.sleep(svc_cfg.flush_s)
                 submitted.append(fut)
             svc.drain()
             for fut in submitted:

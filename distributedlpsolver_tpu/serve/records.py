@@ -38,6 +38,11 @@ class RequestResult:
     slot: int = -1
     retried_solo: bool = False
     faults: List[FaultRecord] = dataclasses.field(default_factory=list)
+    # perf_counter() stamps at submit and completion — the service span
+    # for throughput is first-submit → last-completion, not the slowest
+    # single latency (which only matches when all requests arrive at once).
+    t_submit: float = 0.0
+    t_done: float = 0.0
 
     def record(self) -> dict:
         """The JSONL record for this request (x is elided — solutions go
@@ -77,7 +82,15 @@ def latency_summary(results: List[RequestResult]) -> dict:
     done = [r for r in results if r.status is not Status.TIMEOUT]
     totals = [r.total_ms for r in done]
     queues = [r.queue_ms for r in results]
-    span_s = max(totals) / 1e3 if totals else 0.0
+    # Wall span from first submit to last completion; results built
+    # without stamps (t_done unset) fall back to the burst approximation.
+    stamped = [r for r in results if r.t_done > 0.0]
+    if stamped:
+        span_s = max(r.t_done for r in stamped) - min(
+            r.t_submit for r in stamped
+        )
+    else:
+        span_s = max(totals) / 1e3 if totals else 0.0
     by_status: dict = {}
     for r in results:
         by_status[r.status.value] = by_status.get(r.status.value, 0) + 1
@@ -89,8 +102,8 @@ def latency_summary(results: List[RequestResult]) -> dict:
         "latency_ms_max": round(max(totals), 3) if totals else 0.0,
         "queue_ms_p50": round(_percentile(queues, 50), 3),
         "queue_ms_p95": round(_percentile(queues, 95), 3),
-        # Throughput proxy over the submit→last-completion span; the load
-        # probe reports wall-clock throughput over its own clock too.
+        # Completed requests over the first-submit → last-completion wall
+        # span; the load probe reports throughput over its own clock too.
         "throughput_rps": round(len(done) / span_s, 2) if span_s > 0 else 0.0,
         "mean_padding_waste": round(
             float(np.mean([r.padding_waste for r in results])), 4

@@ -287,6 +287,14 @@ class SolveService:
             for key, live, expired in batches:  # solve outside the lock
                 try:
                     self._dispatch(key, live, expired)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    # Last-ditch guard: an exception escaping _dispatch
+                    # would kill the sole dispatcher thread and strand
+                    # every queued future forever. Fail the batch's
+                    # unresolved members instead.
+                    self._fail_batch(key, live + expired, e)
                 finally:
                     with self._lock:
                         self._inflight -= len(live) + len(expired)
@@ -317,6 +325,8 @@ class SolveService:
                     solve_ms=0.0,
                     total_ms=(now - p.t_submit) * 1e3,
                     padding_waste=0.0,
+                    t_submit=p.t_submit,
+                    t_done=now,
                 ),
             )
         if not live:
@@ -353,19 +363,8 @@ class SolveService:
         seq = self._dispatch_seq
         self._dispatch_seq += 1
 
-        # Cold bucket: one max_iter=1 call compiles the program (max_iter
-        # is traced, so it is the SAME executable the real solve reuses) —
-        # the compile cost is stamped as compile_ms on this batch's
-        # requests instead of polluting solve_ms forever after.
         warm_key = (spec.key(), tol, cfg.dtype)
         compile_ms = 0.0
-        if warm_key not in self._warm:
-            size0 = bucket_cache_size()
-            t0 = time.perf_counter()
-            solve_bucket(batch, active, cfg, max_iter=1)
-            compile_ms = (time.perf_counter() - t0) * 1e3
-            self._warm.add(warm_key)
-            self._compiles += bucket_cache_size() - size0
 
         faults: List[FaultRecord] = []
         res = None
@@ -373,6 +372,21 @@ class SolveService:
             try:
                 if self.config.fault_injector is not None:
                     self.config.fault_injector(seq, key)
+
+                # Cold bucket: one max_iter=1 call compiles the program
+                # (max_iter is traced, so it is the SAME executable the
+                # real solve reuses) — the compile cost is stamped as
+                # compile_ms on this batch's requests instead of polluting
+                # solve_ms forever after. Inside the fault loop so a
+                # compile failure (XLA OOM, device error) degrades like
+                # any other dispatch fault rather than escaping.
+                if warm_key not in self._warm:
+                    size0 = bucket_cache_size()
+                    t0 = time.perf_counter()
+                    solve_bucket(batch, active, cfg, max_iter=1)
+                    compile_ms = (time.perf_counter() - t0) * 1e3
+                    self._warm.add(warm_key)
+                    self._compiles += bucket_cache_size() - size0
 
                 def _solve():
                     return solve_bucket(batch, active, cfg)
@@ -479,6 +493,8 @@ class SolveService:
                     dispatch_index=seq,
                     slot=k,
                     faults=list(faults),
+                    t_submit=p.t_submit,
+                    t_done=done,
                 ),
             )
 
@@ -553,14 +569,69 @@ class SolveService:
                 padding_waste=0.0,
                 retried_solo=retried,
                 faults=faults,
+                t_submit=p.t_submit,
+                t_done=done,
             ),
         )
 
+    def _fail_batch(
+        self, key: QueueKey, members: List[PendingRequest], exc: Exception
+    ) -> None:
+        """Fail every unresolved member of a batch whose dispatch raised
+        past the per-attempt fault handling — the dispatcher thread must
+        survive, and 'never a silent drop' means the futures resolve."""
+        fault = FaultRecord(
+            FaultKind.CRASH, -1, "dispatcher",
+            f"{type(exc).__name__}: {exc}", action="give_up",
+        )
+        fault.at_time = time.time()
+        self._logger.event(
+            {
+                "event": "dispatch_error",
+                "bucket": list(key[0].key()),
+                "detail": fault.detail[:300],
+            }
+        )
+        now = time.perf_counter()
+        for p in members:
+            if p.future.done():
+                continue
+            self._finish(
+                p,
+                RequestResult(
+                    request_id=p.request_id,
+                    name=p.name,
+                    status=Status.FAILED,
+                    objective=float("nan"),
+                    x=None,
+                    iterations=0,
+                    rel_gap=_INF,
+                    pinf=_INF,
+                    dinf=_INF,
+                    bucket=key[0].key(),
+                    queue_ms=(now - p.t_submit) * 1e3,
+                    compile_ms=0.0,
+                    solve_ms=0.0,
+                    total_ms=(now - p.t_submit) * 1e3,
+                    padding_waste=0.0,
+                    faults=[fault],
+                    t_submit=p.t_submit,
+                    t_done=now,
+                ),
+            )
+
     def _finish(self, p: PendingRequest, result: RequestResult) -> None:
         with self._lock:
-            self._results.append(result)
+            # Stats only need the scalar fields; retaining every x would
+            # grow a long-running service's memory without bound.
+            self._results.append(dataclasses.replace(result, x=None))
         self._logger.event(result.record())
-        p.future.set_result(result)
+        # A caller may have cancelled its still-pending future (submit
+        # never marks it RUNNING, so Future.cancel succeeds). Claiming it
+        # first makes set_result safe; if cancellation won the race the
+        # telemetry record above still stands.
+        if p.future.set_running_or_notify_cancel():
+            p.future.set_result(result)
 
     # -- introspection ---------------------------------------------------
 

@@ -71,8 +71,11 @@ class IterLogger:
                 f"{rec.alpha_d:>6.3f} {rec.sigma:>8.1e} {rec.pobj:>14.6e} "
                 f"{rec.t_iter:>8.4f}"
             )
-        if self._fh:
-            with self._lock:
+        # The handle check lives INSIDE the lock: close() nulls _fh under
+        # it, and a dispatcher thread outliving shutdown's join timeout
+        # must drop records silently, not race a closing handle.
+        with self._lock:
+            if self._fh:
                 self._fh.write(json.dumps(rec.asdict()) + "\n")
                 self._fh.flush()
                 if self._fsync:
@@ -83,16 +86,16 @@ class IterLogger:
         landed) into the same JSONL stream, flushed like iteration rows.
         Events carry an ``"event"`` key so consumers separate them from
         iteration records (which never have one)."""
-        if self._fh:
-            with self._lock:
+        with self._lock:
+            if self._fh:
                 self._fh.write(json.dumps(payload) + "\n")
                 self._fh.flush()
                 if self._fsync:
                     os.fsync(self._fh.fileno())
 
     def close(self) -> None:
-        if self._fh:
-            with self._lock:
+        with self._lock:
+            if self._fh:
                 self._fh.flush()
                 self._fh.close()
                 self._fh = None

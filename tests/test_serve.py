@@ -284,6 +284,94 @@ class TestService:
         with pytest.raises(RuntimeError, match="shut down"):
             svc.submit(random_dense_lp(8, 24, seed=1))
 
+    def test_dispatcher_survives_dispatch_crash(self):
+        """An exception escaping _dispatch (e.g. a compile OOM outside
+        the per-attempt fault handling) must fail that batch's futures —
+        never kill the sole dispatcher thread and strand the queue."""
+        svc = SolveService(ServiceConfig(batch=2, flush_s=0.01))
+        orig = svc._dispatch
+        state = {"crashed": False}
+
+        def boom(key, live, expired):
+            if not state["crashed"]:
+                state["crashed"] = True
+                raise RuntimeError("escaped dispatch")
+            return orig(key, live, expired)
+
+        svc._dispatch = boom
+        r1 = svc.submit(random_dense_lp(8, 24, seed=1)).result(timeout=300)
+        assert r1.status is Status.FAILED
+        assert any(f.backend == "dispatcher" for f in r1.faults)
+        # the dispatcher is still alive: the next request completes
+        r2 = svc.submit(random_dense_lp(8, 24, seed=2)).result(timeout=300)
+        assert r2.status is Status.OPTIMAL
+        svc.shutdown()
+
+    def test_cancelled_future_does_not_poison_dispatch(self):
+        """Future.cancel succeeds while a request is queued (submit never
+        marks it RUNNING); _finish must tolerate that instead of raising
+        InvalidStateError in the dispatcher thread."""
+        svc = SolveService(
+            ServiceConfig(batch=4, flush_s=0.01), auto_start=False
+        )
+        doomed = svc.submit(random_dense_lp(8, 24, seed=3))
+        mate = svc.submit(random_dense_lp(8, 24, seed=4))
+        assert doomed.cancel()
+        svc.start()
+        assert svc.drain(timeout=300)
+        assert mate.result(timeout=30).status is Status.OPTIMAL
+        assert doomed.cancelled()
+        # the cancelled request was still solved and recorded (telemetry
+        # keeps its row; only the future hand-off is skipped)
+        assert svc.stats()["requests"] == 2
+        svc.shutdown()
+
+
+def test_throughput_span_is_submit_to_completion():
+    """REVIEW: throughput must divide by the first-submit→last-completion
+    wall span, not the slowest single request's latency."""
+    from distributedlpsolver_tpu.serve import RequestResult, latency_summary
+
+    def rr(i, t_submit, t_done):
+        return RequestResult(
+            request_id=i, name=f"r{i}", status=Status.OPTIMAL,
+            objective=0.0, x=None, iterations=1, rel_gap=0.0, pinf=0.0,
+            dinf=0.0, bucket=(8, 32, 4), queue_ms=0.0, compile_ms=0.0,
+            solve_ms=0.0, total_ms=(t_done - t_submit) * 1e3,
+            padding_waste=0.0, t_submit=t_submit, t_done=t_done,
+        )
+
+    # 10 requests spread over ~9 s, each 0.1 s latency: the burst
+    # approximation (max latency = 0.1 s) would claim 100 rps.
+    s = latency_summary([rr(i, float(i), i + 0.1) for i in range(10)])
+    assert s["throughput_rps"] == pytest.approx(10 / 9.1, rel=0.01)
+
+
+def test_cli_serve_backpressure_survives_overload(tmp_path):
+    """REVIEW: cmd_serve must block and resubmit on ServiceOverloaded —
+    a request stream longer than the queue bound used to crash the CLI
+    mid-stream and lose every already-computed result."""
+    from distributedlpsolver_tpu.cli import main
+
+    req = tmp_path / "req.jsonl"
+    req.write_text(
+        "".join(
+            json.dumps({"m": 8, "n": 24, "seed": s, "id": f"q{s}"}) + "\n"
+            for s in range(24)
+        )
+    )
+    out = tmp_path / "res.jsonl"
+    rc = main(
+        [
+            "serve", "--requests", str(req), "--out", str(out),
+            "--batch", "4", "--flush-ms", "5", "--queue-depth", "2",
+        ]
+    )
+    assert rc == 0
+    records = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(records) == 24
+    assert all(r["status"] == "optimal" for r in records)
+
 
 def test_probe_serve_smoke():
     """CI satellite: the service loop is exercised end to end on every
