@@ -749,14 +749,60 @@ def bucket_cache_size() -> int:
     """Number of compiled bucket programs in this process — the serve
     layer's recompile telemetry, and the warm-bucket zero-recompile
     assertion in tests (repeat dispatches to a warm bucket must not grow
-    this)."""
+    this). The cache keys include the input shardings, so the invariant
+    holds per (bucket, mesh) pair: the same bucket dispatched over a
+    different mesh compiles once more, then stays warm there too."""
     return _solve_bucket_jit._cache_size()
+
+
+def place_bucket(
+    batch: BatchedLP,
+    active,
+    config: Optional[SolverConfig] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    batch_axis: str = "batch",
+):
+    """Host→device transfer of a pre-padded bucket — the PACK stage of the
+    serving pipeline. Casts to the solve dtype and places the leading
+    (batch) axis over ``mesh`` (data parallelism: the same compiled
+    program then runs B/K problems per device), or on the default device
+    unsharded. Split out of :func:`solve_bucket` so the service can run
+    this host work for batch k+1 while the device still solves batch k;
+    ``solve_bucket`` accepts the returned (batch, active) verbatim and
+    skips its own conversion.
+    """
+    cfg = config or SolverConfig()
+    dtype = jnp.dtype(cfg.dtype)
+    A = np.asarray(batch.A, dtype=dtype)
+    b = np.asarray(batch.b, dtype=dtype)
+    c = np.asarray(batch.c, dtype=dtype)
+    act = np.asarray(active, dtype=bool)
+    Bsz = A.shape[0]
+    if act.shape != (Bsz,):
+        raise ValueError(f"active mask shape {act.shape} != ({Bsz},)")
+    if mesh is not None:
+        k = mesh.shape[batch_axis]
+        if Bsz % k != 0:
+            raise ValueError(
+                f"bucket batch {Bsz} not divisible by mesh axis {k}"
+            )
+        sh = lambda nd: mesh_lib.batch_sharding(mesh, nd, batch_axis)
+        A = jax.device_put(A, sh(3))
+        b = jax.device_put(b, sh(2))
+        c = jax.device_put(c, sh(2))
+        act = jax.device_put(act, sh(1))
+    else:
+        A, b, c = jax.device_put(A), jax.device_put(b), jax.device_put(c)
+        act = jax.device_put(act)
+    return BatchedLP(c=c, A=A, b=b, name=batch.name), act
 
 
 def solve_bucket(
     batch: BatchedLP,
     active,
     config: Optional[SolverConfig] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    batch_axis: str = "batch",
     **config_overrides,
 ) -> BatchedResult:
     """Solve one pre-padded serving bucket: ``batch`` is (B, m, n) arrays
@@ -765,10 +811,17 @@ def solve_bucket(
     first iteration (their returned status is a placeholder OPTIMAL;
     demux by slot and ignore them).
 
-    Unlike :func:`solve_batched` there is no chunking, no mesh, no phase
-    schedule and no solo cleanup: the service owns the retry budget of
-    unfinished members (supervisor ladder / solo re-solve), and the one
-    jitted program per (B, m, n, dtype, params) key is reused across
+    ``mesh`` shards the batch axis over its devices (B must divide by the
+    mesh size) — batch-axis data parallelism, the same placement-only
+    scheme as :func:`solve_batched`: one dispatch drives every device and
+    the only cross-device traffic is the ``any(active)`` loop predicate.
+    Inputs already placed by :func:`place_bucket` (the serve pipeline's
+    pack stage) are used as-is.
+
+    Unlike :func:`solve_batched` there is no chunking, no phase schedule
+    and no solo cleanup: the service owns the retry budget of unfinished
+    members (supervisor ladder / solo re-solve), and the one jitted
+    program per (B, m, n, dtype, params, sharding) key is reused across
     every dispatch — a warm bucket never recompiles
     (:func:`bucket_cache_size`).
     """
@@ -779,13 +832,19 @@ def solve_bucket(
     fname = jnp.dtype(cfg.factor_dtype_resolved()).name
 
     t0 = time.perf_counter()
-    A = jnp.asarray(np.asarray(batch.A), dtype=dtype)
-    b = jnp.asarray(np.asarray(batch.b), dtype=dtype)
-    c = jnp.asarray(np.asarray(batch.c), dtype=dtype)
+    if isinstance(batch.A, jax.Array) and batch.A.dtype == dtype:
+        # Pre-placed by place_bucket (pack stage): np.asarray here would
+        # drag the arrays back to host and forfeit the overlapped
+        # transfer. Divisibility was checked at placement time.
+        A, b, c, active = batch.A, batch.b, batch.c, active
+        if not isinstance(active, jax.Array):
+            active = jnp.asarray(np.asarray(active, dtype=bool))
+    else:
+        placed, active = place_bucket(
+            batch, active, cfg, mesh=mesh, batch_axis=batch_axis
+        )
+        A, b, c = placed.A, placed.b, placed.c
     Bsz, _, n = A.shape
-    active = np.asarray(active, dtype=bool)
-    if active.shape != (Bsz,):
-        raise ValueError(f"active mask shape {active.shape} != ({Bsz},)")
     u = jnp.full((Bsz, n), jnp.inf, dtype=dtype)
     data = jax.vmap(
         lambda cc, bb, uu: core.make_problem_data(jnp, cc, bb, uu, dtype)
@@ -796,7 +855,7 @@ def solve_bucket(
     states, status, iters, pinf, dinf, rel_gap, pobj = _solve_bucket_jit(
         A,
         data,
-        jnp.asarray(active),
+        active,
         jnp.asarray(cfg.reg_dual, dtype),
         jnp.asarray(cfg.max_iter, jnp.int32),
         jnp.asarray(cfg.max_refactor, jnp.int32),
@@ -948,16 +1007,14 @@ def solve_batched(
     c = np.asarray(batch.c, dtype=dtype)
     Bsz, m, n = A.shape
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         if Bsz % mesh.shape[batch_axis] != 0:
             raise ValueError(
                 f"batch {Bsz} not divisible by mesh axis {mesh.shape[batch_axis]}"
             )
-        sh = lambda *spec: NamedSharding(mesh, P(*spec))
-        A = jax.device_put(A, sh(batch_axis, None, None))
-        b = jax.device_put(b, sh(batch_axis, None))
-        c = jax.device_put(c, sh(batch_axis, None))
+        sh = lambda nd: mesh_lib.batch_sharding(mesh, nd, batch_axis)
+        A = jax.device_put(A, sh(3))
+        b = jax.device_put(b, sh(2))
+        c = jax.device_put(c, sh(2))
     else:
         A, b, c = jnp.asarray(A), jnp.asarray(b), jnp.asarray(c)
 

@@ -6,6 +6,8 @@ the solver, and reports iterations/gap/wall-clock (the published metric
 surface, BASELINE.json:2). Subcommands:
 
     solve      solve an MPS file (or a generated problem) to tolerance
+    serve      async batching solve service (JSONL/MPS requests in)
+    autotune   refine a serve bucket ladder from telemetry JSONL
     backends   list registered SolverBackend names
     generate   write a generated benchmark problem to MPS
 
@@ -86,6 +88,35 @@ def _add_solver_flags(ap: argparse.ArgumentParser) -> None:
         help="smallest mesh the elastic SHRINK recovery may re-form "
         "after device loss before degrading to the next backend",
     )
+    ap.add_argument(
+        "--jax-cache-dir",
+        default=None,
+        help="persistent JAX/XLA compilation cache directory — restarts "
+        "skip every compile cached by an earlier run (cold-bucket serve "
+        "compiles included); logs a hit/miss line at startup",
+    )
+
+
+def _apply_jax_cache(args) -> None:
+    """Point JAX's persistent compilation cache at --jax-cache-dir (wins
+    over the package default) and log the startup hit/miss line."""
+    d = getattr(args, "jax_cache_dir", None)
+    if not d:
+        return
+    import os
+
+    import jax
+
+    os.makedirs(d, exist_ok=True)
+    n = sum(1 for f in os.listdir(d) if not f.startswith("."))
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    print(
+        f"jax compilation cache: {d} — {n} cached programs "
+        f"({'warm start, cold compiles will be cache hits' if n else 'cold start, compiles will be cached'})",
+        file=sys.stderr,
+    )
 
 
 def _config_from(args) -> "SolverConfig":
@@ -140,6 +171,7 @@ def _report(result, as_json: bool, x_out: Optional[str]) -> int:
 def cmd_solve(args) -> int:
     from distributedlpsolver_tpu.io.mps import read_mps
 
+    _apply_jax_cache(args)
     problem = read_mps(args.file)
     cfg = _config_from(args)
     if args.supervise or args.step_timeout > 0 or args.adaptive_timeout:
@@ -218,14 +250,22 @@ def cmd_serve(args) -> int:
         ServiceConfig,
         ServiceOverloaded,
         SolveService,
+        ladder_from_json,
     )
 
+    _apply_jax_cache(args)
+    buckets = None
+    if args.buckets:
+        with open(args.buckets) as fh:
+            buckets = ladder_from_json(fh.read())
     svc_cfg = ServiceConfig(
+        buckets=buckets,
         batch=args.batch,
         flush_s=args.flush_ms / 1e3,
         max_queue_depth=args.queue_depth,
         default_deadline_s=args.deadline_s or None,
         log_jsonl=args.log_jsonl,
+        mesh_devices=args.mesh_devices,
     )
     out = sys.stdout if args.out == "-" else open(args.out, "w")
     n_failed = 0
@@ -268,6 +308,40 @@ def cmd_serve(args) -> int:
         if out is not sys.stdout:
             out.close()
     return 2 if n_failed else 0
+
+
+def cmd_autotune(args) -> int:
+    """Refine a serve bucket ladder from a telemetry JSONL file and write
+    it as a ladder JSON ``cli serve --buckets`` consumes."""
+    from distributedlpsolver_tpu.serve import (
+        AutotuneConfig,
+        autotune_from_jsonl,
+        ladder_from_json,
+        ladder_to_json,
+    )
+
+    current = None
+    if args.current:
+        with open(args.current) as fh:
+            current = ladder_from_json(fh.read())
+    specs, report = autotune_from_jsonl(
+        args.telemetry,
+        current=current,
+        config=AutotuneConfig(
+            waste_threshold=args.waste_threshold,
+            max_programs=args.max_programs,
+            batch=args.batch or None,
+            devices=args.devices,
+        ),
+    )
+    if not specs:
+        print("no bucketed request telemetry found; nothing to tune",
+              file=sys.stderr)
+        return 2
+    with open(args.out, "w") as fh:
+        fh.write(ladder_to_json(specs) + "\n")
+    print(json.dumps(report))
+    return 0
 
 
 def cmd_backends(_args) -> int:
@@ -330,8 +404,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--deadline-s", type=float, default=0.0,
         help="default per-request deadline (0 = none)",
     )
+    ap_srv.add_argument(
+        "--mesh-devices", type=int, default=0,
+        help="shard each bucket dispatch's batch axis over this many "
+        "local devices (0/1 = unsharded, -1 = all local devices)",
+    )
+    ap_srv.add_argument(
+        "--buckets", default=None,
+        help="explicit bucket ladder JSON (the `autotune` output) "
+        "instead of auto power-of-two buckets",
+    )
     _add_solver_flags(ap_srv)
     ap_srv.set_defaults(fn=cmd_serve, quiet=True)
+
+    ap_at = sub.add_parser(
+        "autotune",
+        help="refine a serve bucket ladder from telemetry JSONL "
+        "(README 'Serving performance')",
+    )
+    ap_at.add_argument(
+        "--telemetry", required=True,
+        help="service telemetry JSONL (the serve --log-jsonl stream)",
+    )
+    ap_at.add_argument("--out", required=True, help="ladder JSON output path")
+    ap_at.add_argument(
+        "--current", default=None,
+        help="current ladder JSON (reported against, seeds split decisions)",
+    )
+    ap_at.add_argument("--waste-threshold", type=float, default=0.35)
+    ap_at.add_argument("--max-programs", type=int, default=12)
+    ap_at.add_argument("--batch", type=int, default=0, help="slots per bucket")
+    ap_at.add_argument(
+        "--devices", type=int, default=1,
+        help="mesh width bucket batches must divide (serve --mesh-devices)",
+    )
+    ap_at.set_defaults(fn=cmd_autotune)
 
     ap_b = sub.add_parser("backends", help="list registered backends")
     ap_b.set_defaults(fn=cmd_backends)

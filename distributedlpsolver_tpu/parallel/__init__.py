@@ -1,4 +1,5 @@
 from distributedlpsolver_tpu.parallel.mesh import (
+    batch_sharding,
     col_sharding,
     make_hybrid_mesh,
     make_mesh,
@@ -18,6 +19,7 @@ from distributedlpsolver_tpu.parallel.runtime import (
 )
 
 __all__ = [
+    "batch_sharding",
     "make_mesh",
     "make_hybrid_mesh",
     "reform_mesh",

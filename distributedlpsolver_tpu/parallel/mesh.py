@@ -111,6 +111,16 @@ def reform_mesh(
     return Mesh(np.array(survivors), (name,))
 
 
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = "batch") -> NamedSharding:
+    """Leading-axis sharding for an ``ndim``-dim array — the data-parallel
+    placement of the batched and serving paths: the batch axis is split
+    over ``axis``, every trailing dim replicated. Used by
+    ``backends.batched`` for both ``solve_batched`` and the serve
+    pipeline's ``place_bucket`` pack stage, so every bucket dispatch
+    builds its placement the same way (and the jit cache keys agree)."""
+    return NamedSharding(mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
+
+
 def col_sharding(mesh: Mesh, axis: str = "cols") -> NamedSharding:
     """(m, n) matrix sharded along its variable (column) dimension."""
     return NamedSharding(mesh, PartitionSpec(None, axis))

@@ -4,9 +4,19 @@ requests onto bucketed batched device programs (README "Serving").
 Public surface: :class:`SolveService` (submit → Future), configured by
 :class:`ServiceConfig` over a :class:`BucketSpec` ladder;
 :class:`RequestResult` is what futures resolve to;
-:class:`ServiceOverloaded` is the admission-control backpressure signal.
+:class:`ServiceOverloaded` is the admission-control backpressure signal;
+:func:`autotune_ladder` refines the bucket ladder from observed
+shape/padding telemetry (swap it in live with
+``SolveService.apply_ladder``).
 """
 
+from distributedlpsolver_tpu.serve.autotune import (
+    AutotuneConfig,
+    autotune_from_jsonl,
+    autotune_ladder,
+    ladder_from_json,
+    ladder_to_json,
+)
 from distributedlpsolver_tpu.serve.buckets import (
     BucketSpec,
     BucketTable,
@@ -29,6 +39,11 @@ from distributedlpsolver_tpu.serve.service import (
 )
 
 __all__ = [
+    "AutotuneConfig",
+    "autotune_from_jsonl",
+    "autotune_ladder",
+    "ladder_from_json",
+    "ladder_to_json",
     "BucketSpec",
     "BucketTable",
     "PendingRequest",

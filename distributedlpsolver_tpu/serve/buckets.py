@@ -66,16 +66,36 @@ class BucketTable:
     created on demand by rounding m and n up to the next power of two
     (≥ 8) — deterministic, so two services over the same request stream
     build the same table.
+
+    ``devices`` is the batch-axis mesh size of the service's dispatches:
+    every bucket's batch must divide by it (the sharded program places
+    B/devices problems per device). The auto batch is rounded up to the
+    next multiple; explicit buckets that don't divide are a configuration
+    error and rejected loudly.
     """
 
     def __init__(
         self,
         buckets: Optional[Sequence[BucketSpec]] = None,
         batch: int = 16,
+        devices: int = 1,
     ):
+        self.devices = max(1, int(devices))
+        if buckets:
+            for s in buckets:
+                if s.batch % self.devices != 0:
+                    raise ValueError(
+                        f"bucket {s.key()} batch {s.batch} not divisible by "
+                        f"mesh devices {self.devices}"
+                    )
         self._explicit = sorted(buckets, key=lambda s: s.cells) if buckets else None
-        self._batch = batch
+        self._batch = -(-batch // self.devices) * self.devices
         self._auto: dict = {}
+
+    @property
+    def batch(self) -> int:
+        """Slots per auto-created bucket (already devices-divisible)."""
+        return self._batch
 
     def spec_for(self, m: int, n: int) -> BucketSpec:
         if self._explicit is not None:

@@ -15,9 +15,11 @@ from distributedlpsolver_tpu.ipm.state import FaultRecord, Status
 
 @dataclasses.dataclass
 class RequestResult:
-    """Outcome of one service request, with the timing split the ISSUE
-    names: queue (submit → dispatch), compile (bucket program build, 0 on
-    a warm bucket), solve (device batch wall, shared by batch-mates)."""
+    """Outcome of one service request, with the per-stage timing split:
+    queue (submit → dispatch), pack (host pad + stack + device transfer,
+    shared by batch-mates and pipelined against the previous dispatch's
+    solve), compile (bucket program build, 0 on a warm bucket), solve
+    (device batch wall, shared by batch-mates)."""
 
     request_id: int
     name: str
@@ -43,6 +45,15 @@ class RequestResult:
     # single latency (which only matches when all requests arrive at once).
     t_submit: float = 0.0
     t_done: float = 0.0
+    # Request shape as submitted — the autotuner's input (padding_waste
+    # alone can't say what a tighter bucket should look like).
+    m: int = 0
+    n: int = 0
+    # Pipeline stage split: host pack wall of this request's batch, and
+    # how much of that batch's pack ran concurrently with an earlier
+    # batch's device solve (nonzero = the pipeline actually overlapped).
+    pack_ms: float = 0.0
+    overlap_ms: float = 0.0
 
     def record(self) -> dict:
         """The JSONL record for this request (x is elided — solutions go
@@ -58,9 +69,13 @@ class RequestResult:
             "pinf": float(self.pinf),
             "dinf": float(self.dinf),
             "bucket": list(self.bucket) if self.bucket else None,
+            "m": int(self.m),
+            "n": int(self.n),
             "queue_ms": round(self.queue_ms, 3),
+            "pack_ms": round(self.pack_ms, 3),
             "compile_ms": round(self.compile_ms, 3),
             "solve_ms": round(self.solve_ms, 3),
+            "overlap_ms": round(self.overlap_ms, 3),
             "total_ms": round(self.total_ms, 3),
             "padding_waste": round(self.padding_waste, 4),
             "dispatch": self.dispatch_index,
@@ -77,7 +92,7 @@ def _percentile(values: List[float], q: float) -> float:
 
 
 def latency_summary(results: List[RequestResult]) -> dict:
-    """p50/p95 latency + throughput over completed requests — the
+    """p50/p95/p99 latency + throughput over completed requests — the
     service-level summary event emitted at drain/shutdown."""
     done = [r for r in results if r.status is not Status.TIMEOUT]
     totals = [r.total_ms for r in done]
@@ -99,6 +114,7 @@ def latency_summary(results: List[RequestResult]) -> dict:
         "status_breakdown": by_status,
         "latency_ms_p50": round(_percentile(totals, 50), 3),
         "latency_ms_p95": round(_percentile(totals, 95), 3),
+        "latency_ms_p99": round(_percentile(totals, 99), 3),
         "latency_ms_max": round(max(totals), 3) if totals else 0.0,
         "queue_ms_p50": round(_percentile(queues, 50), 3),
         "queue_ms_p95": round(_percentile(queues, 95), 3),

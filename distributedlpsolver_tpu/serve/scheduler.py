@@ -133,6 +133,18 @@ class Scheduler:
             return None
         return max(0.0, t - now)
 
+    def drain_pending(self) -> List[PendingRequest]:
+        """Remove and return every queued request (submit order within
+        each queue) — the ladder-swap epoch boundary: pending requests
+        migrate to the replacement scheduler and re-bucket there."""
+        out: List[PendingRequest] = []
+        for q in self._queues.values():
+            while q:
+                out.append(q.popleft())
+        self._queues.clear()
+        self._depth = 0
+        return out
+
     def pop(
         self, key: QueueKey, now: float
     ) -> Tuple[List[PendingRequest], List[PendingRequest]]:

@@ -5,12 +5,30 @@ device programs.
 independent, asynchronously-arriving LP requests and multiplexes them
 onto the device the way the batched backend proved is right for this
 domain (one vmap'd masked program per shape bucket — see
-backends/batched.solve_bucket and MPAX, arXiv:2412.09734). A single
-dispatcher thread runs the continuous-batching loop:
+backends/batched.solve_bucket and MPAX, arXiv:2412.09734). The
+dispatcher is a three-stage pipeline across three threads:
 
-    submit → admission control → per-(bucket, tol) queue →
-    flush (full batch OR oldest age > flush_s) →
-    pad + mask → one compiled device program → demux to futures
+    submit → admission control → per-(bucket, tol) queue ─┐ scheduler
+    flush (full batch OR oldest age > flush_s) ───────────┘ thread
+         │ pop
+         ▼
+    pack: pad + stack + host→device transfer               pack thread
+         │ (pack of batch k+1 overlaps the device
+         ▼  solve of batch k — two-deep pipeline)
+    solve: one compiled device program → demux to futures  solve thread
+
+Stages communicate over bounded queues, so the host prepares the next
+bucket while the device is busy with the current one; each dispatch
+records ``pack_ms`` / ``solve_ms`` / ``overlap_ms`` (how much of its
+pack ran under an earlier dispatch's solve window).
+
+Mesh data parallelism: with ``ServiceConfig(mesh_devices=K)`` the pack
+stage shards the bucket's batch axis over a K-device mesh
+(parallel/mesh.py placement — the same compiled program runs B/K
+problems per device, SPMD), bucket batch sizes are enforced
+K-divisible by the BucketTable, and :meth:`SolveService.reshard`
+re-forms the mesh over survivors when devices are lost mid-service
+(elastic recovery; the clamp keeps batches divisible).
 
 Standard-form requests (min cᵀx, Ax=b, x≥0 — the serving workload) ride
 the bucketed fast path; general-form problems (finite bounds, ranged
@@ -24,18 +42,23 @@ wedged batch costs its members a retry, never a silent drop. Members the
 batch leaves unfinished (stall/iteration limit) take the same solo
 ladder individually.
 
-Telemetry: one JSONL record per request (queue/compile/solve split,
-padding waste, faults), one per dispatched batch, and a service summary
-at shutdown — all through utils/logging.IterLogger.
+Telemetry: one JSONL record per request (queue/pack/compile/solve split,
+padding waste, request shape, faults), one per dispatched batch, and a
+service summary at shutdown — all through utils/logging.IterLogger. The
+bucket ladder can be refined offline from that stream
+(serve/autotune.py) and swapped in live at a safe epoch boundary via
+:meth:`SolveService.apply_ladder` (drain → swap → warm).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import threading
 import time
 from concurrent.futures import Future
-from typing import Callable, List, Optional, Sequence, Union
+from queue import Queue
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -105,7 +128,20 @@ class ServiceConfig:
     # (dispatch_index, bucket_key) before each batch launch; raising makes
     # that dispatch attempt fault.
     fault_injector: Optional[Callable[[int, tuple], None]] = None
+    # Retired fixed poll tick (drain is event-driven now); kept so stored
+    # configs keep loading.
     drain_poll_s: float = 0.005
+    # Batch-axis data parallelism: shard each bucket dispatch over this
+    # many local devices (0/1 = unsharded single-device dispatch; -1 =
+    # every local device). Bucket batch sizes are rounded/validated to be
+    # divisible by this (BucketTable).
+    mesh_devices: int = 0
+    # Dispatch pipeline depth: bound on popped batches sitting between
+    # the scheduler and solve stages. 2 = classic two-deep pipeline
+    # (host pack of batch k+1 runs under the device solve of batch k);
+    # smaller keeps batches in the queues longer so late submits can
+    # still fill them, larger lets the pack stage run further ahead.
+    pipeline_depth: int = 2
 
 
 def standard_form(problem: LPProblem):
@@ -131,6 +167,28 @@ def standard_form(problem: LPProblem):
     )
 
 
+@dataclasses.dataclass
+class _Packed:
+    """Output of the pack stage: a device-resident padded bucket."""
+
+    batch: object  # BatchedLP of device arrays (placed, possibly sharded)
+    active: object  # (B,) device bool mask
+    waste: float
+    pack_ms: float
+    mesh: object = None  # the mesh snapshot this bucket was placed on
+
+
+@dataclasses.dataclass
+class _PackJob:
+    """One popped batch travelling through the pipeline queues."""
+
+    key: QueueKey
+    live: List[PendingRequest]
+    expired: List[PendingRequest]
+    packed: Optional[_Packed] = None
+    pack_error: Optional[Exception] = None
+
+
 class SolveService:
     """In-process async batching front-end over the batched backend."""
 
@@ -147,8 +205,12 @@ class SolveService:
             verbose=False, log_jsonl=None, checkpoint_path=None,
             checkpoint_every=0, profile_dir=None,
         )
+        self._mesh = self._build_mesh(self.config.mesh_devices)
+        n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
         self.scheduler = Scheduler(
-            BucketTable(self.config.buckets, batch=self.config.batch),
+            BucketTable(
+                self.config.buckets, batch=self.config.batch, devices=n_dev
+            ),
             self.config.max_queue_depth,
             self.config.flush_s,
         )
@@ -157,6 +219,7 @@ class SolveService:
         )
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
         self._results: List[RequestResult] = []
         self._next_id = 0
         self._dispatch_seq = 0
@@ -164,17 +227,79 @@ class SolveService:
         self._stopping = False
         self._warm: set = set()
         self._compiles = 0
+        # Pipeline queues: the scheduler thread pushes popped batches, the
+        # pack thread fills in device-resident arrays, the solve thread
+        # dispatches. Bounds keep the pipeline two-deep so batches aren't
+        # popped long before the device can take them (late-arriving
+        # requests still fill later buckets).
+        depth = max(1, self.config.pipeline_depth)
+        self._pack_q: Queue = Queue(maxsize=depth)
+        self._solve_q: Queue = Queue(maxsize=max(1, depth - 1))
+        # Pack-interval telemetry for overlap_ms: recent completed pack
+        # windows plus the start stamp of the pack currently in flight.
+        self._pack_spans: List[tuple] = []
+        self._pack_current: Optional[float] = None
+        self._span_lock = threading.Lock()
+        self._dispatch_rows: List[dict] = []
+        self._overlap_ms_total = 0.0
+        self._pack_ms_total = 0.0
+        # Idle telemetry: how the dispatcher sleeps (satellite: the loop
+        # waits exactly until Scheduler.next_event_in, surfaced here).
+        self._idle_waits = 0
+        self._idle_sleep_s = 0.0
+        self._last_idle_timeout: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
+        self._pack_thread: Optional[threading.Thread] = None
+        self._solve_thread: Optional[threading.Thread] = None
         if auto_start:
             self.start()
+
+    @staticmethod
+    def _build_mesh(mesh_devices: int):
+        if mesh_devices in (0, 1):
+            return None
+        import jax
+
+        from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+        devs = jax.devices()
+        k = len(devs) if mesh_devices == -1 else mesh_devices
+        if k > len(devs):
+            raise ValueError(
+                f"mesh_devices={mesh_devices} but only {len(devs)} local "
+                f"devices are present"
+            )
+        if k <= 1:
+            return None
+        return mesh_lib.make_mesh((k,), axis_names=("batch",), devices=devs[:k])
+
+    @property
+    def mesh_devices(self) -> int:
+        """Devices the batch axis is currently sharded over (1 = unsharded)."""
+        mesh = self._mesh
+        return int(mesh.devices.size) if mesh is not None else 1
+
+    @staticmethod
+    def _mesh_key(mesh):
+        return (
+            None if mesh is None else tuple(int(d.id) for d in mesh.devices.flat)
+        )
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "SolveService":
         if self._thread is None:
             self._thread = threading.Thread(
-                target=self._run, daemon=True, name="dlps-serve-dispatch"
+                target=self._run, daemon=True, name="dlps-serve-sched"
             )
+            self._pack_thread = threading.Thread(
+                target=self._run_pack, daemon=True, name="dlps-serve-pack"
+            )
+            self._solve_thread = threading.Thread(
+                target=self._run_solve, daemon=True, name="dlps-serve-solve"
+            )
+            self._solve_thread.start()
+            self._pack_thread.start()
             self._thread.start()
         return self
 
@@ -184,21 +309,22 @@ class SolveService:
     def __exit__(self, *exc) -> None:
         self.shutdown()
 
+    def _is_idle(self) -> bool:
+        # Requires self._lock. _inflight covers every popped-but-unfinished
+        # request, including batches sitting in the pipeline queues.
+        return self.scheduler.depth() == 0 and self._inflight == 0
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Block until every accepted request has a result. False iff
-        ``timeout`` expired first."""
-        t0 = time.perf_counter()
-        while True:
-            with self._lock:
-                if self.scheduler.depth() == 0 and self._inflight == 0:
-                    return True
-            if timeout is not None and time.perf_counter() - t0 > timeout:
-                return False
-            time.sleep(self.config.drain_poll_s)
+        ``timeout`` expired first. Event-driven: waits on the idle
+        condition the solve stage signals, no poll tick."""
+        with self._idle:
+            return self._idle.wait_for(self._is_idle, timeout)
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop accepting work; by default finish what was accepted
-        (drain), then stop the dispatcher and emit the summary record."""
+        (drain), then stop the pipeline threads and emit the summary
+        record."""
         with self._wake:
             self._stopping = True
             self._wake.notify_all()
@@ -206,9 +332,10 @@ class SolveService:
             self.drain(timeout)
         with self._wake:
             self._wake.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+        for t in (self._thread, self._pack_thread, self._solve_thread):
+            if t is not None:
+                t.join(timeout=10.0)
+        self._thread = self._pack_thread = self._solve_thread = None
         self._logger.event({"event": "service", **self.stats()})
         self._logger.close()
 
@@ -265,7 +392,7 @@ class SolveService:
             self._wake.notify_all()
         return p.future
 
-    # -- dispatcher ------------------------------------------------------
+    # -- pipeline stage 1: scheduler -------------------------------------
 
     def _run(self) -> None:
         while True:
@@ -274,36 +401,135 @@ class SolveService:
                 ready = self.scheduler.ready(now)
                 if not ready:
                     if self._stopping and self.scheduler.depth() == 0:
-                        return
-                    # Part-full buckets flush on a clock; wake for the
-                    # earliest flush/request deadline or a new submit.
-                    self._wake.wait(timeout=self.scheduler.next_event_in(now))
+                        break
+                    # Part-full buckets flush on a clock; sleep for
+                    # exactly the earliest flush/request deadline (or
+                    # until a submit notifies) — never a fixed poll tick.
+                    timeout = self.scheduler.next_event_in(now)
+                    self._idle_waits += 1
+                    self._last_idle_timeout = timeout
+                    t_w = time.perf_counter()
+                    self._wake.wait(timeout=timeout)
+                    self._idle_sleep_s += time.perf_counter() - t_w
                     continue
-                batches = []
+                jobs = []
                 for key in ready:
                     live, expired = self.scheduler.pop(key, now)
-                    batches.append((key, live, expired))
+                    jobs.append(_PackJob(key, live, expired))
                     self._inflight += len(live) + len(expired)
-            for key, live, expired in batches:  # solve outside the lock
+            for job in jobs:  # bounded put: pipeline backpressure
+                self._pack_q.put(job)
+        self._pack_q.put(None)  # sentinel flows sched → pack → solve
+
+    # -- pipeline stage 2: pack ------------------------------------------
+
+    def _run_pack(self) -> None:
+        while True:
+            job = self._pack_q.get()
+            if job is None:
+                self._solve_q.put(None)
+                return
+            if job.live and job.live[0].A is not None:
+                t0 = time.perf_counter()
+                with self._span_lock:
+                    self._pack_current = t0
                 try:
-                    self._dispatch(key, live, expired)
+                    job.packed = self._pack_bucket(job.key, job.live)
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception as e:
-                    # Last-ditch guard: an exception escaping _dispatch
-                    # would kill the sole dispatcher thread and strand
-                    # every queued future forever. Fail the batch's
-                    # unresolved members instead.
-                    self._fail_batch(key, live + expired, e)
-                finally:
-                    with self._lock:
-                        self._inflight -= len(live) + len(expired)
+                    # The solve stage fails the batch's futures; the pack
+                    # thread must survive whatever a malformed request
+                    # throws at it.
+                    job.pack_error = e
+                t1 = time.perf_counter()
+                with self._span_lock:
+                    self._pack_current = None
+                    self._pack_spans.append((t0, t1))
+                    del self._pack_spans[:-128]
+            self._solve_q.put(job)
+
+    def _pack_bucket(self, key: QueueKey, live: List[PendingRequest]) -> _Packed:
+        """Host work of one dispatch: pad each member onto the bucket
+        shape, stack, and transfer to the device(s) — sharded over the
+        serving mesh's batch axis when one is configured. Runs in the
+        pack thread, concurrently with the previous dispatch's solve."""
+        from distributedlpsolver_tpu.backends.batched import place_bucket
+        from distributedlpsolver_tpu.models.generators import BatchedLP
+
+        spec, tol = key
+        B = spec.batch
+        t0 = time.perf_counter()
+        A = np.zeros((B, spec.m, spec.n))
+        b = np.zeros((B, spec.m))
+        c = np.zeros((B, spec.n))
+        active = np.zeros(B, dtype=bool)
+        for k, p in enumerate(live):
+            c[k], A[k], b[k] = pad_standard_form(p.c, p.A, p.b, spec.m, spec.n)
+            active[k] = True
+        for k in range(len(live), B):  # inactive slots: well-posed copies
+            A[k], b[k], c[k] = A[0], b[0], c[0]
+        batch = BatchedLP(c=c, A=A, b=b, name=f"bucket_{spec.m}x{spec.n}")
+        mesh = self._mesh  # snapshot: a reshard mid-pipeline only affects
+        # later packs; this bucket solves on the mesh it was placed on.
+        placed, act = place_bucket(
+            batch, active, self.solver_config.replace(tol=tol), mesh=mesh
+        )
+        pack_ms = (time.perf_counter() - t0) * 1e3
+        return _Packed(
+            batch=placed,
+            active=act,
+            waste=padding_waste(sum(p.m * p.n for p in live), spec),
+            pack_ms=pack_ms,
+            mesh=mesh,
+        )
+
+    def _overlap_ms(self, t1: float, t2: float) -> float:
+        """How much host pack time fell inside the solve window [t1, t2]
+        — the pipeline's measured overlap (pack of batch k+1 concurrent
+        with solve of batch k)."""
+        with self._span_lock:
+            spans = list(self._pack_spans)
+            current = self._pack_current
+        o = 0.0
+        for ps, pe in spans:
+            o += max(0.0, min(t2, pe) - max(t1, ps))
+        if current is not None:  # a pack still in flight at solve end
+            o += max(0.0, t2 - max(t1, current))
+        return o * 1e3
+
+    # -- pipeline stage 3: solve -----------------------------------------
+
+    def _run_solve(self) -> None:
+        while True:
+            job = self._solve_q.get()
+            if job is None:
+                return
+            key, live, expired = job.key, job.live, job.expired
+            try:
+                if job.pack_error is not None:
+                    raise job.pack_error
+                self._dispatch(key, live, expired, job.packed)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # Last-ditch guard: an exception escaping _dispatch
+                # would kill the solve stage and strand every queued
+                # future forever. Fail the batch's unresolved members
+                # instead.
+                self._fail_batch(key, live + expired, e)
+            finally:
+                with self._lock:
+                    self._inflight -= len(live) + len(expired)
+                    if self._is_idle():
+                        self._idle.notify_all()
 
     def _dispatch(
         self,
         key: QueueKey,
         live: List[PendingRequest],
         expired: List[PendingRequest],
+        packed: Optional[_Packed] = None,
     ) -> None:
         now = time.perf_counter()
         for p in expired:
@@ -327,6 +553,8 @@ class SolveService:
                     padding_waste=0.0,
                     t_submit=p.t_submit,
                     t_done=now,
+                    m=p.m,
+                    n=p.n,
                 ),
             )
         if not live:
@@ -335,39 +563,36 @@ class SolveService:
             for p in live:
                 self._solo(p, key, now, [], retried=False)
             return
-        self._dispatch_bucket(key, live, now)
+        self._dispatch_bucket(key, live, now, packed)
 
     def _dispatch_bucket(
-        self, key: QueueKey, live: List[PendingRequest], t_dispatch: float
+        self,
+        key: QueueKey,
+        live: List[PendingRequest],
+        t_dispatch: float,
+        packed: Optional[_Packed] = None,
     ) -> None:
         from distributedlpsolver_tpu.backends.batched import (
             bucket_cache_size,
             solve_bucket,
         )
-        from distributedlpsolver_tpu.models.generators import BatchedLP
 
         spec, tol = key
-        B = spec.batch
-        A = np.zeros((B, spec.m, spec.n))
-        b = np.zeros((B, spec.m))
-        c = np.zeros((B, spec.n))
-        active = np.zeros(B, dtype=bool)
-        for k, p in enumerate(live):
-            c[k], A[k], b[k] = pad_standard_form(p.c, p.A, p.b, spec.m, spec.n)
-            active[k] = True
-        for k in range(len(live), B):  # inactive slots: well-posed copies
-            A[k], b[k], c[k] = A[0], b[0], c[0]
-        batch = BatchedLP(c=c, A=A, b=b, name=f"bucket_{spec.m}x{spec.n}")
+        if packed is None:
+            # Direct-call fallback (tests, pipeline disabled): pack inline.
+            packed = self._pack_bucket(key, live)
+        batch, active, mesh = packed.batch, packed.active, packed.mesh
         cfg = self.solver_config.replace(tol=tol)
-        waste = padding_waste(sum(p.m * p.n for p in live), spec)
+        waste = packed.waste
         seq = self._dispatch_seq
         self._dispatch_seq += 1
 
-        warm_key = (spec.key(), tol, cfg.dtype)
+        warm_key = (spec.key(), tol, cfg.dtype, self._mesh_key(mesh))
         compile_ms = 0.0
 
         faults: List[FaultRecord] = []
         res = None
+        t_sol0 = time.perf_counter()
         for attempt in range(1 + self.config.max_batch_retries):
             try:
                 if self.config.fault_injector is not None:
@@ -379,17 +604,20 @@ class SolveService:
                 # compile_ms on this batch's requests instead of polluting
                 # solve_ms forever after. Inside the fault loop so a
                 # compile failure (XLA OOM, device error) degrades like
-                # any other dispatch fault rather than escaping.
+                # any other dispatch fault rather than escaping. Keyed
+                # per (bucket, tol, dtype, mesh): a re-formed mesh
+                # legitimately compiles once more.
                 if warm_key not in self._warm:
                     size0 = bucket_cache_size()
                     t0 = time.perf_counter()
-                    solve_bucket(batch, active, cfg, max_iter=1)
+                    solve_bucket(batch, active, cfg, mesh=mesh, max_iter=1)
                     compile_ms = (time.perf_counter() - t0) * 1e3
                     self._warm.add(warm_key)
-                    self._compiles += bucket_cache_size() - size0
+                    with self._lock:
+                        self._compiles += bucket_cache_size() - size0
 
                 def _solve():
-                    return solve_bucket(batch, active, cfg)
+                    return solve_bucket(batch, active, cfg, mesh=mesh)
 
                 res = run_with_deadline(
                     _solve, self.config.batch_timeout_s, seq
@@ -424,10 +652,31 @@ class SolveService:
                     "detail": fault.detail[:300],
                 }
             )
+        t_sol1 = time.perf_counter()
+        # Pack work (for LATER batches) that ran inside this dispatch's
+        # device window — the pipeline's realized overlap.
+        overlap_ms = self._overlap_ms(t_sol0, t_sol1)
 
         with self._lock:
             depth = self.scheduler.depth()
             occupancy = self.scheduler.occupancy()
+            self._overlap_ms_total += overlap_ms
+            self._pack_ms_total += packed.pack_ms
+            self._dispatch_rows.append(
+                {
+                    "dispatch": seq,
+                    "bucket": list(spec.key()),
+                    "live": len(live),
+                    "pack_ms": round(packed.pack_ms, 3),
+                    "compile_ms": round(compile_ms, 3),
+                    "solve_ms": round((t_sol1 - t_sol0) * 1e3, 3),
+                    "overlap_ms": round(overlap_ms, 3),
+                    "mesh_devices": (
+                        int(mesh.devices.size) if mesh is not None else 1
+                    ),
+                }
+            )
+            del self._dispatch_rows[:-2048]
         self._logger.event(
             {
                 "event": "batch",
@@ -436,8 +685,13 @@ class SolveService:
                 "tol": tol,
                 "live": len(live),
                 "padding_waste": round(waste, 4),
+                "pack_ms": round(packed.pack_ms, 3),
                 "compile_ms": round(compile_ms, 3),
                 "solve_ms": round(res.solve_time * 1e3, 3) if res else None,
+                "overlap_ms": round(overlap_ms, 3),
+                "mesh_devices": (
+                    int(mesh.devices.size) if mesh is not None else 1
+                ),
                 "attempts": len(faults) + (1 if res is not None else 0),
                 "queue_depth": depth,
                 "occupancy": occupancy,
@@ -495,6 +749,10 @@ class SolveService:
                     faults=list(faults),
                     t_submit=p.t_submit,
                     t_done=done,
+                    m=p.m,
+                    n=p.n,
+                    pack_ms=packed.pack_ms,
+                    overlap_ms=overlap_ms,
                 ),
             )
 
@@ -571,6 +829,8 @@ class SolveService:
                 faults=faults,
                 t_submit=p.t_submit,
                 t_done=done,
+                m=p.m,
+                n=p.n,
             ),
         )
 
@@ -617,6 +877,8 @@ class SolveService:
                     faults=[fault],
                     t_submit=p.t_submit,
                     t_done=now,
+                    m=p.m,
+                    n=p.n,
                 ),
             )
 
@@ -633,19 +895,185 @@ class SolveService:
         if p.future.set_running_or_notify_cancel():
             p.future.set_result(result)
 
+    # -- elasticity & ladder management ----------------------------------
+
+    def reshard(self, exclude: Sequence = ()) -> int:
+        """Elastic recovery: re-form the serving mesh over the surviving
+        devices (``parallel.mesh.reform_mesh`` semantics — ``exclude``
+        lists lost devices or ids). The survivor count is clamped DOWN to
+        the largest count that still divides every bucket's batch, so
+        in-flight and future dispatches stay shardable; at 1 the mesh is
+        dropped and dispatch continues unsharded. Batches already packed
+        on the old mesh finish there. Returns the new device count."""
+        if self._mesh is None:
+            return 1
+        from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+        new = mesh_lib.reform_mesh(self._mesh, exclude=exclude, axis_name="batch")
+        survivors = list(new.devices.flat)
+        with self._lock:
+            table = self.scheduler.table
+            g = table.batch
+            for s in table.specs():
+                g = math.gcd(g, s.batch)
+            k = max(d for d in range(1, len(survivors) + 1) if g % d == 0)
+            if k <= 1:
+                self._mesh = None
+            elif k == len(survivors):
+                self._mesh = new
+            else:
+                self._mesh = mesh_lib.make_mesh(
+                    (k,), axis_names=("batch",), devices=survivors[:k]
+                )
+            n_dev = max(1, k)
+        self._logger.event(
+            {
+                "event": "reshard",
+                "devices": n_dev,
+                "excluded": [int(getattr(d, "id", d)) for d in exclude],
+            }
+        )
+        return n_dev
+
+    def apply_ladder(
+        self,
+        buckets: Sequence[BucketSpec],
+        warm: bool = True,
+        drain_timeout: Optional[float] = None,
+        batch: Optional[int] = None,
+    ) -> int:
+        """Swap the bucket ladder at a safe epoch boundary: drain in-flight
+        work → replace the scheduler's BucketTable (pending requests
+        migrate and re-bucket) → warm every new bucket program so the
+        first post-swap dispatches don't pay compiles (the
+        zero-warm-recompile invariant holds across the swap). The ladder
+        usually comes from serve/autotune.py. Returns the number of
+        bucket programs warmed."""
+        self.drain(drain_timeout)
+        n_dev = self.mesh_devices
+        table = BucketTable(
+            list(buckets), batch=batch or self.config.batch, devices=n_dev
+        )
+        with self._wake:
+            pending = self.scheduler.drain_pending()
+            self.scheduler = Scheduler(
+                table, self.config.max_queue_depth, self.config.flush_s
+            )
+            misfits = []
+            for p in pending:
+                try:
+                    self.scheduler.add(p)
+                except ValueError as e:  # new ladder can't hold this shape
+                    misfits.append((p, e))
+            self._wake.notify_all()
+        for p, e in misfits:
+            self._fail_batch(
+                (BucketSpec(p.m, p.n, 1), p.tol), [p], e
+            )
+        self._logger.event(
+            {
+                "event": "ladder_swap",
+                "buckets": [list(s.key()) for s in table.specs()],
+                "migrated": len(pending),
+                "misfits": len(misfits),
+            }
+        )
+        if warm:
+            return self.warm_buckets(table.specs())
+        return 0
+
+    def warm_buckets(
+        self, specs: Sequence[BucketSpec], tol: Optional[float] = None
+    ) -> int:
+        """Pre-compile the bucket programs for ``specs`` at ``tol``
+        (default: the service tolerance) on the current mesh, so live
+        traffic never pays those compiles. Idempotent per warm key."""
+        from distributedlpsolver_tpu.backends.batched import (
+            bucket_cache_size,
+            place_bucket,
+            solve_bucket,
+        )
+        from distributedlpsolver_tpu.models.generators import random_batched_lp
+
+        tol = self.solver_config.tol if tol is None else tol
+        cfg = self.solver_config.replace(tol=tol)
+        mesh = self._mesh
+        warmed = 0
+        for spec in specs:
+            wk = (spec.key(), tol, cfg.dtype, self._mesh_key(mesh))
+            if wk in self._warm:
+                continue
+            # A feasible+bounded random batch at the exact bucket shape:
+            # max_iter is traced, so this max_iter=1 call compiles the
+            # same executable real dispatches reuse.
+            dummy = random_batched_lp(spec.batch, spec.m, spec.n, seed=0)
+            placed, act = place_bucket(
+                dummy, np.ones(spec.batch, dtype=bool), cfg, mesh=mesh
+            )
+            size0 = bucket_cache_size()
+            t0 = time.perf_counter()
+            try:
+                solve_bucket(placed, act, cfg, mesh=mesh, max_iter=1)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # warm-up failure: traffic pays later
+                self._logger.event(
+                    {
+                        "event": "warmup_error",
+                        "bucket": list(spec.key()),
+                        "detail": f"{type(e).__name__}: {e}"[:300],
+                    }
+                )
+                continue
+            self._warm.add(wk)
+            warmed += 1
+            with self._lock:
+                self._compiles += bucket_cache_size() - size0
+            self._logger.event(
+                {
+                    "event": "warmup",
+                    "bucket": list(spec.key()),
+                    "tol": tol,
+                    "compile_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                }
+            )
+        return warmed
+
     # -- introspection ---------------------------------------------------
+
+    def dispatch_report(self) -> List[dict]:
+        """Per-dispatch timing rows (pack/compile/solve/overlap ms, mesh
+        width) — the serving analogue of the driver's dispatch_timings
+        report; bounded to the most recent 2048 dispatches."""
+        with self._lock:
+            return list(self._dispatch_rows)
 
     def stats(self) -> dict:
         with self._lock:
             results = list(self._results)
             depth = self.scheduler.depth()
             occupancy = self.scheduler.occupancy()
+            overlap_total = self._overlap_ms_total
+            pack_total = self._pack_ms_total
+            idle = {
+                "waits": self._idle_waits,
+                "sleep_s": round(self._idle_sleep_s, 3),
+                "last_timeout_ms": (
+                    None
+                    if self._last_idle_timeout is None
+                    else round(self._last_idle_timeout * 1e3, 3)
+                ),
+            }
         return {
             **latency_summary(results),
             "queue_depth": depth,
             "occupancy": occupancy,
             "dispatches": self._dispatch_seq,
             "programs_compiled": self._compiles,
+            "mesh_devices": self.mesh_devices,
+            "pack_ms_total": round(pack_total, 3),
+            "overlap_ms_total": round(overlap_total, 3),
+            "idle": idle,
             "buckets": [
                 list(s.key()) for s in self.scheduler.table.specs()
             ],

@@ -1,13 +1,19 @@
 """Serve-layer load probe: drive the async batching SolveService with a
 randomly-shaped request stream on the 8-virtual-CPU-device rig and print
 the service's own telemetry — the fastest way to see (and demo)
-continuous batching, deadline handling, fault recovery, and the
+continuous batching, mesh-sharded bucket dispatch, the pipelined
+pack/solve overlap, deadline handling, fault recovery, and the
 zero-recompile warm path without TPU hardware.
 
 Run: python scripts/probe_serve.py [--requests N] [--quick]
+                                   [--mesh-devices K] [--budget-s S]
 Exit 0 iff every in-deadline request is OPTIMAL, the doomed-deadline
-request is TIMEOUT, the injected batch fault is recovered, and a second
-warm wave compiles nothing.
+request is TIMEOUT, the injected batch fault is recovered, a second warm
+wave compiles nothing, the dispatch timing report shows nonzero
+pack/solve overlap (full-size probe only — a handful of quick-mode
+dispatches can legitimately serialize), and the wall clock fits the
+--budget-s envelope when one is given (the tier-1 serving-throughput
+regression guard).
 """
 
 import argparse
@@ -40,7 +46,17 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--quick", action="store_true", help="small smoke load")
+    ap.add_argument(
+        "--mesh-devices", type=int, default=2,
+        help="batch-axis mesh width for bucket dispatches (0 = unsharded)",
+    )
+    ap.add_argument(
+        "--budget-s", type=float, default=0.0,
+        help="fail if the whole probe exceeds this wall time (0 = no "
+        "budget) — the tier-1 serving-throughput envelope",
+    )
     args = ap.parse_args()
+    t_probe = time.perf_counter()
     n = 24 if args.quick else args.requests
     print(f"devices: {len(jax.devices())} × {jax.devices()[0].platform}")
 
@@ -53,6 +69,7 @@ def main() -> int:
 
     cfg = ServiceConfig(
         batch=8, flush_s=0.02, fault_injector=injector,
+        mesh_devices=args.mesh_devices,
     )
     with SolveService(cfg) as svc:
         t0 = time.perf_counter()
@@ -68,32 +85,61 @@ def main() -> int:
 
         # Warm wave: same shapes again — zero recompiles expected.
         cache0 = bucket_cache_size()
-        warm = [svc.submit(p) for p in random_request_stream(16, seed=8)]
+        t1 = time.perf_counter()
+        n_warm = 16 if args.quick else max(16, n // 2)
+        warm = [svc.submit(p) for p in random_request_stream(n_warm, seed=8)]
         svc.drain(timeout=600)
+        warm_wall = time.perf_counter() - t1
         warm_r = [f.result(timeout=10) for f in warm]
         recompiles = bucket_cache_size() - cache0
         stats = svc.stats()
+        report = svc.dispatch_report()
 
     n_opt = sum(r.status is Status.OPTIMAL for r in results + warm_r)
+    overlapped = [r for r in report if r["overlap_ms"] > 0]
     print(
         f"wave 1: {len(results)} requests in {wall:.2f}s "
-        f"({len(results) / wall:.1f} rps incl. compile)"
+        f"({len(results) / wall:.1f} rps incl. compile); warm wave: "
+        f"{len(warm_r)} in {warm_wall:.2f}s ({len(warm_r) / warm_wall:.1f} rps)"
     )
     print(
         f"  p50={stats['latency_ms_p50']:.0f}ms p95={stats['latency_ms_p95']:.0f}ms "
+        f"p99={stats['latency_ms_p99']:.0f}ms "
         f"padding_waste={stats['mean_padding_waste']:.2f} "
-        f"buckets={stats['buckets']}"
+        f"buckets={stats['buckets']} mesh_devices={stats['mesh_devices']}"
+    )
+    print(
+        f"  pipeline: {len(report)} dispatches, pack {stats['pack_ms_total']:.1f}ms "
+        f"total, overlap {stats['overlap_ms_total']:.1f}ms total "
+        f"({len(overlapped)} dispatches overlapped a pack)"
+    )
+    print(
+        f"  idle: {stats['idle']['waits']} waits, "
+        f"{stats['idle']['sleep_s']:.2f}s slept (event-driven, no poll tick)"
     )
     print(
         f"  doomed deadline: {doomed_r.status.value}; injected faults "
         f"recovered: {len(injected)}; warm-wave recompiles: {recompiles}"
     )
+    probe_wall = time.perf_counter() - t_probe
     ok = (
         n_opt == len(results) + len(warm_r)
         and doomed_r.status is Status.TIMEOUT
         and len(injected) == 1
         and recompiles == 0
     )
+    if not args.quick:
+        # Acceptance: the pipelined dispatcher must actually overlap host
+        # pack with device solve under sustained load.
+        if stats["overlap_ms_total"] <= 0.0:
+            print("FAIL: no pack/solve overlap recorded under load")
+            ok = False
+    if args.budget_s and probe_wall > args.budget_s:
+        print(
+            f"FAIL: probe took {probe_wall:.1f}s > budget {args.budget_s:.0f}s"
+        )
+        ok = False
+    print(f"probe wall: {probe_wall:.1f}s")
     print("PASS" if ok else "FAIL")
     return 0 if ok else 1
 

@@ -292,11 +292,11 @@ class TestService:
         orig = svc._dispatch
         state = {"crashed": False}
 
-        def boom(key, live, expired):
+        def boom(key, live, expired, packed=None):
             if not state["crashed"]:
                 state["crashed"] = True
                 raise RuntimeError("escaped dispatch")
-            return orig(key, live, expired)
+            return orig(key, live, expired, packed)
 
         svc._dispatch = boom
         r1 = svc.submit(random_dense_lp(8, 24, seed=1)).result(timeout=300)
@@ -374,18 +374,22 @@ def test_cli_serve_backpressure_survives_overload(tmp_path):
 
 
 def test_probe_serve_smoke():
-    """CI satellite: the service loop is exercised end to end on every
-    tier-1 run through the load probe (quick mode, CPU, well under the
-    30 s budget once jax warms)."""
+    """CI satellite: the 200-request CPU load probe runs on every tier-1
+    pass under a generous wall-time envelope, so a serving-throughput
+    regression (lost pipeline overlap, a recompiling warm path, a stuck
+    dispatcher) is caught without TPU hardware. The probe itself asserts
+    nonzero pack/solve overlap, zero warm recompiles, fault recovery and
+    deadline handling; --budget-s makes it fail on the wall clock too
+    (measured ~6 s warm-cache, ~60 s cold — 240 s is regression-class)."""
     t0 = time.perf_counter()
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "probe_serve.py"),
-         "--quick"],
-        capture_output=True, text=True, timeout=300,
+         "--requests", "200", "--budget-s", "240"],
+        capture_output=True, text=True, timeout=400,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
-    # generous vs the ≤30 s budget: the bound exists to keep this a smoke
-    # test, not a soak; flag it loudly if the probe outgrows its class
-    assert time.perf_counter() - t0 < 120
+    # the probe's own budget is authoritative; this outer bound only
+    # flags it loudly if the probe outgrows its smoke-test class
+    assert time.perf_counter() - t0 < 400
