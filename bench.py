@@ -31,6 +31,49 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _obs_enable() -> None:
+    """Install a process-wide metrics registry for the whole bench run,
+    so every row can embed the snapshot (the BENCH_*.json trajectories
+    become self-describing: a row says how many programs compiled and
+    what the padding waste was, not just how fast it went)."""
+    from distributedlpsolver_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.set_registry(obs_metrics.MetricsRegistry())
+
+
+def _obs_row(platform: str) -> dict:
+    """Compact metrics snapshot stamped into each suite row: platform,
+    cumulative compile/iteration counters, and the serve-path padding
+    waste + pack/solve overlap ratio (None until a serve row ran)."""
+    from distributedlpsolver_tpu.obs import metrics as obs_metrics
+
+    snap = obs_metrics.get_registry().snapshot()
+
+    def _hist(name):
+        h = snap.get(name)
+        return h if isinstance(h, dict) and h.get("count") else None
+
+    waste = _hist("serve_padding_waste")
+    overlap, solve = _hist("serve_overlap_ms"), _hist("serve_solve_ms")
+    return {
+        "platform": platform,
+        "ipm_iterations_total": int(snap.get("ipm_iterations_total", 0)),
+        "bucket_programs_compiled": int(
+            snap.get("bucket_programs_compiled_total", 0)
+        ),
+        "serve_bucket_compiles": int(
+            snap.get("serve_bucket_compiles_total", 0)
+        ),
+        "serve_padding_waste_mean": (
+            round(waste["sum"] / waste["count"], 4) if waste else None
+        ),
+        "serve_overlap_ratio": (
+            round(overlap["sum"] / solve["sum"], 4)
+            if overlap and solve and solve["sum"] > 0 else None
+        ),
+    }
+
+
 def _solve_timed(problem, backend: str, _retries: int = 2, **cfg):
     """solve() with retry on transient tunnel/runtime failures.
 
@@ -347,7 +390,9 @@ def _bench_serve(quick: bool) -> dict:
         wall = time.perf_counter() - t0
         warm_recompiles = bucket_cache_size() - cache0
         stats = svc.stats()
-    lat = sorted(r.total_ms for r in rs)
+    from distributedlpsolver_tpu.obs.stats import percentile
+
+    lat = [r.total_ms for r in rs]
     ok = sum(r.status.value == "optimal" for r in rs)
     row = {
         "backend": "serve(batched bucket dispatch)",
@@ -356,8 +401,8 @@ def _bench_serve(quick: bool) -> dict:
         "cold_optimal": sum(r.status.value == "optimal" for r in cold),
         "time_s": round(wall, 4),
         "rps": round(n / max(wall, 1e-9), 2),
-        "latency_ms_p50": round(float(_np.percentile(lat, 50)), 3),
-        "latency_ms_p99": round(float(_np.percentile(lat, 99)), 3),
+        "latency_ms_p50": round(percentile(lat, 50), 3),
+        "latency_ms_p99": round(percentile(lat, 99), 3),
         "mean_padding_waste": round(
             float(_np.mean([r.padding_waste for r in rs])), 4
         ),
@@ -468,7 +513,9 @@ def run_suite(args) -> list:
     rows = []
 
     def add(config, row):
-        row = {"config": config, **row}
+        # Cumulative-to-here metrics snapshot: each row records the
+        # observability state at the time it completed.
+        row = {"config": config, **row, "metrics": _obs_row(args.platform)}
         rows.append(row)
         _log(json.dumps(row))
 
@@ -848,9 +895,12 @@ def main() -> int:
         _log(f"backend {backend!r} unknown; using 'tpu'")
         backend = args.backend = "tpu"
 
+    _obs_enable()
+
     if args.serve:
         row = _bench_serve(args.quick)
         row["platform"] = args.platform
+        row["metrics"] = _obs_row(args.platform)
         print(json.dumps(row))
         return 0  # serve tier is its own run; no headline solve after
 
@@ -858,6 +908,7 @@ def main() -> int:
         rows = run_scale(args)
         for r in rows:
             r.setdefault("platform", args.platform)
+            r.setdefault("metrics", _obs_row(args.platform))
         out = os.path.join(_REPO, "SCALE_CHECK.json")
         with open(out, "w") as fh:
             json.dump(rows, fh, indent=2)
@@ -881,6 +932,7 @@ def main() -> int:
     problem, config_name = _headline_problem(args)
     _log(f"headline: {config_name} on backend={backend}")
     row = _bench_one(problem, backend, args.baseline_backend)
+    row["metrics"] = _obs_row(args.platform)
 
     print(
         json.dumps(

@@ -38,6 +38,7 @@ from distributedlpsolver_tpu.ipm import core
 from distributedlpsolver_tpu.ipm.config import SolverConfig
 from distributedlpsolver_tpu.ipm.state import IPMState, Status
 from distributedlpsolver_tpu.models.generators import BatchedLP
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
 from distributedlpsolver_tpu.parallel import mesh as mesh_lib
 
 _RUNNING, _OPTIMAL, _MAXITER, _NUMERR = 0, 1, 2, 3
@@ -852,6 +853,7 @@ def solve_bucket(
     setup_time = time.perf_counter() - t0
 
     t1 = time.perf_counter()
+    cache0 = _solve_bucket_jit._cache_size()
     states, status, iters, pinf, dinf, rel_gap, pobj = _solve_bucket_jit(
         A,
         data,
@@ -866,6 +868,14 @@ def solve_bucket(
     )
     jax.block_until_ready(states)
     solve_time = time.perf_counter() - t1
+    compiled = _solve_bucket_jit._cache_size() - cache0
+    if compiled:  # recompile accounting at the cache itself: every
+        # caller (service dispatch, warm_buckets, direct tests) is
+        # covered, and the warm path costs one cache-size read.
+        obs_metrics.get_registry().counter(
+            "bucket_programs_compiled_total",
+            help="batched bucket programs compiled in this process",
+        ).inc(compiled)
 
     code_map = {
         _OPTIMAL: Status.OPTIMAL,

@@ -95,6 +95,18 @@ def _add_solver_flags(ap: argparse.ArgumentParser) -> None:
         "skip every compile cached by an earlier run (cold-bucket serve "
         "compiles included); logs a hit/miss line at startup",
     )
+    ap.add_argument(
+        "--metrics-path",
+        default=None,
+        help="enable the obs/ metrics registry and write a "
+        "Prometheus-text snapshot here at exit (README 'Observability')",
+    )
+    ap.add_argument(
+        "--trace-path",
+        default=None,
+        help="enable the obs/ span tracer and write a Chrome-trace JSON "
+        "here at exit (open at ui.perfetto.dev)",
+    )
 
 
 def _apply_jax_cache(args) -> None:
@@ -117,6 +129,40 @@ def _apply_jax_cache(args) -> None:
         f"({'warm start, cold compiles will be cache hits' if n else 'cold start, compiles will be cached'})",
         file=sys.stderr,
     )
+
+
+def _obs_setup(args):
+    """Install a process-wide metrics registry / span tracer when
+    --metrics-path / --trace-path are given (every layer — driver,
+    supervisor, serve, batched backend — resolves the module defaults,
+    so one switch instruments the whole process). Returns a finalizer
+    that writes both artifacts and restores the no-op defaults."""
+    from distributedlpsolver_tpu.obs import metrics as obs_metrics
+    from distributedlpsolver_tpu.obs import trace as obs_trace
+
+    reg = tracer = None
+    if getattr(args, "metrics_path", None):
+        reg = obs_metrics.MetricsRegistry()
+        obs_metrics.set_registry(reg)
+    if getattr(args, "trace_path", None):
+        tracer = obs_trace.Tracer(args.trace_path)
+        obs_trace.set_tracer(tracer)
+
+    def finalize():
+        if reg is not None:
+            reg.write_prometheus(args.metrics_path)
+            obs_metrics.set_registry(None)
+            print(f"metrics snapshot -> {args.metrics_path}", file=sys.stderr)
+        if tracer is not None:
+            tracer.close()
+            obs_trace.set_tracer(None)
+            print(
+                f"trace ({tracer.event_count()} events) -> "
+                f"{args.trace_path} (open at ui.perfetto.dev)",
+                file=sys.stderr,
+            )
+
+    return finalize
 
 
 def _config_from(args) -> "SolverConfig":
@@ -172,7 +218,14 @@ def cmd_solve(args) -> int:
     from distributedlpsolver_tpu.io.mps import read_mps
 
     _apply_jax_cache(args)
-    problem = read_mps(args.file)
+    finalize_obs = _obs_setup(args)
+    try:
+        return _cmd_solve_inner(args, read_mps(args.file))
+    finally:
+        finalize_obs()
+
+
+def _cmd_solve_inner(args, problem) -> int:
     cfg = _config_from(args)
     if args.supervise or args.step_timeout > 0 or args.adaptive_timeout:
         from distributedlpsolver_tpu.supervisor import (
@@ -254,6 +307,7 @@ def cmd_serve(args) -> int:
     )
 
     _apply_jax_cache(args)
+    finalize_obs = _obs_setup(args)
     buckets = None
     if args.buckets:
         with open(args.buckets) as fh:
@@ -298,15 +352,20 @@ def cmd_serve(args) -> int:
                         time.sleep(svc_cfg.flush_s)
                 submitted.append(fut)
             svc.drain()
+            from distributedlpsolver_tpu.utils.logging import stamp_record
+
             for fut in submitted:
                 r = fut.result()
                 n_failed += r.status.value == "failed"
-                out.write(json.dumps(r.record()) + "\n")
+                # The CLI's result stream rides the same record schema
+                # as every IterLogger stream (cli report merges both).
+                out.write(json.dumps(stamp_record(r.record())) + "\n")
             out.flush()
             print(json.dumps(svc.stats()), file=sys.stderr)
     finally:
         if out is not sys.stdout:
             out.close()
+        finalize_obs()
     return 2 if n_failed else 0
 
 
@@ -341,6 +400,27 @@ def cmd_autotune(args) -> int:
     with open(args.out, "w") as fh:
         fh.write(ladder_to_json(specs) + "\n")
     print(json.dumps(report))
+    return 0
+
+
+def cmd_report(args) -> int:
+    """Merge telemetry JSONL streams (iteration rows, serve records,
+    fault/resume events — stamped or legacy) plus JSON metric snapshots
+    into per-phase latency breakdowns, padding-waste-by-bucket tables,
+    recovery-overhead summaries, and the iters/sec trajectory."""
+    import os
+
+    from distributedlpsolver_tpu.obs import report as obs_report
+
+    for p in args.files:
+        if not os.path.exists(p):
+            print(f"report: {p!r}: file not found", file=sys.stderr)
+            return 2
+    rep = obs_report.report_from_paths(args.files)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print(obs_report.render(rep))
     return 0
 
 
@@ -439,6 +519,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="mesh width bucket batches must divide (serve --mesh-devices)",
     )
     ap_at.set_defaults(fn=cmd_autotune)
+
+    ap_r = sub.add_parser(
+        "report",
+        help="analyze telemetry JSONL streams + metric snapshots: "
+        "per-phase p50/p95/p99, padding waste by bucket, recovery "
+        "overhead, iters/sec trajectory (README 'Observability')",
+    )
+    ap_r.add_argument(
+        "files", nargs="+",
+        help="telemetry JSONL files and/or JSON metric snapshots "
+        "(solve/serve --log-jsonl streams, serve --out records)",
+    )
+    ap_r.add_argument(
+        "--json", action="store_true",
+        help="emit the full report as one JSON object",
+    )
+    ap_r.set_defaults(fn=cmd_report)
 
     ap_b = sub.add_parser("backends", help="list registered backends")
     ap_b.set_defaults(fn=cmd_backends)

@@ -35,6 +35,8 @@ from distributedlpsolver_tpu.models.problem import (
     LPProblem,
     to_interior_form,
 )
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.obs import trace as obs_trace
 from distributedlpsolver_tpu.utils import checkpoint as ckpt
 from distributedlpsolver_tpu.utils.logging import IterLogger
 
@@ -169,6 +171,22 @@ def solve(
     history = []
     last = None
     it = start_iter
+    # Hot-path instruments, resolved ONCE before the loop (a registry
+    # lookup per iteration would be a locked dict hit; a no-op method
+    # call is free). Disabled mode (the default NULL registry) makes
+    # every observe below a no-op with zero allocations.
+    _reg = obs_metrics.get_registry()
+    _m_iters = _reg.counter(
+        "ipm_iterations_total", help="completed IPM iterations"
+    )
+    _m_step = _reg.histogram(
+        "ipm_step_seconds", buckets=obs_metrics.SECONDS_BUCKETS,
+        help="device-synchronized wall time per IPM iteration",
+    )
+    _m_refactor = _reg.counter(
+        "ipm_refactorizations_total",
+        help="bad-step regularization-bump refactorization attempts",
+    )
     t_solve0 = time.perf_counter()
     profile_stack = contextlib.ExitStack()
     try:
@@ -188,6 +206,7 @@ def solve(
                 if not bad:
                     break
                 refactor += 1
+                _m_refactor.inc()
                 if refactor > cfg.max_refactor or not be.bump_regularization():
                     status = Status.NUMERICAL_ERROR
                     break
@@ -196,6 +215,8 @@ def solve(
             state = new_state
             it += 1
             t_it = time.perf_counter() - t_it0
+            _m_iters.inc()
+            _m_step.observe(t_it)
             last = _to_floats(stats)
             rec = IterRecord(iter=it, t_iter=t_it, **last)
             history.append(rec)
@@ -293,6 +314,11 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
     # host. The host-driver path (fused_loop=False) records true per-
     # iteration wall times; the B:2 aggregate metric is exact either way.
     t_avg = solve_time / max(iters, 1)
+    # One aggregate observation per fused solve (there are no host-side
+    # iteration boundaries to time individually).
+    obs_metrics.get_registry().counter(
+        "ipm_iterations_total", help="completed IPM iterations"
+    ).inc(iters)
     history, last = [], None
     for i in range(len(buf)):
         last = dict(zip(_STAT_FIELDS, (float(v) for v in buf[i])))
@@ -308,6 +334,21 @@ def _finalize(
     inf, original, backend, start_iter, extra_iters=None, scaling=None,
     presolve_info=None,
 ):
+    n_iters = extra_iters if extra_iters is not None else len(history)
+    obs_metrics.get_registry().counter(
+        "ipm_solves_total", labels={"status": status.value},
+        help="finished IPM solves by terminal status",
+    ).inc()
+    # One X span per solve on the calling thread's trace lane (reported
+    # after the fact: the span covers the just-finished solve loop).
+    obs_trace.get_tracer().complete(
+        f"ipm.solve {inf.name}", solve_time, cat="ipm",
+        args={
+            "backend": getattr(be, "name", str(backend)),
+            "status": status.value,
+            "iterations": n_iters,
+        },
+    )
     host = be.to_host(state)
     if scaling is not None:
         host = scaling.unscale_state(host)

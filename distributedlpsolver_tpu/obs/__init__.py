@@ -1,0 +1,71 @@
+"""Unified observability layer: metrics registry, span tracer, shared
+stats, and the ``cli report`` analyzer.
+
+The repo's telemetry grew up as crash forensics — per-iteration JSONL
+rows (utils/logging.IterLogger), per-request serve records, supervisor
+fault events — each with its own ad-hoc schema and no aggregation
+tooling. This package is the substrate that turns those streams into
+answers (the accelerator-LP literature's recurring point: per-phase
+timing attribution is what makes a device-side solver tunable — MPAX,
+arXiv:2412.09734; HiOp's accelerator port, arXiv:2605.13736):
+
+- :mod:`obs.metrics` — a thread-safe in-process registry of counters,
+  gauges, and fixed-bucket histograms, with a Prometheus-text snapshot
+  writer and a JSON snapshot (embedded into bench rows and the serve
+  summary event). Disabled by default: the module-level registry is a
+  :data:`~distributedlpsolver_tpu.obs.metrics.NULL` no-op whose
+  instruments allocate nothing per call.
+- :mod:`obs.trace` — a span tracer emitting Chrome-trace-format JSON
+  (load it at ui.perfetto.dev). Async begin/end events keyed by request
+  id connect one request's life across the serve pipeline's three
+  threads; ``X`` spans give each pipeline thread its own lane; instant
+  events mark supervisor faults, reshards, and ladder swaps.
+- :mod:`obs.stats` — the one percentile/summary implementation the
+  serve summary, bench, and probes all share.
+- :mod:`obs.report` — ``cli report``: merge iteration/serve/fault JSONL
+  streams (old unstamped files included) plus metric snapshots into
+  per-phase latency breakdowns, padding-waste-by-bucket tables,
+  recovery-overhead summaries, and an iters/sec trajectory.
+
+Every JSONL record the package writes is stamped with
+``schema_version`` / wall-clock ``ts`` / monotonic ``t_mono``
+(utils/logging.stamp_record) so ``cli report`` can merge streams;
+readers stay backward-compatible with unstamped pre-stamp files.
+"""
+
+# Version of the shared JSONL record schema (the stamp fields
+# schema_version/ts/t_mono plus each stream's own payload). Bump when a
+# stamped field changes meaning; readers must keep accepting records
+# with a missing or older version (pre-stamp files have none).
+SCHEMA_VERSION = 1
+
+from distributedlpsolver_tpu.obs.metrics import (  # noqa: E402
+    MetricsRegistry,
+    NULL as NULL_REGISTRY,
+    get_registry,
+    set_registry,
+)
+from distributedlpsolver_tpu.obs.stats import (  # noqa: E402
+    percentile,
+    summarize,
+)
+from distributedlpsolver_tpu.obs.trace import (  # noqa: E402
+    NULL_TRACER,
+    Tracer,
+    get_tracer,
+    set_tracer,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "MetricsRegistry",
+    "NULL_REGISTRY",
+    "NULL_TRACER",
+    "Tracer",
+    "get_registry",
+    "set_registry",
+    "get_tracer",
+    "set_tracer",
+    "percentile",
+    "summarize",
+]

@@ -11,6 +11,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from distributedlpsolver_tpu.ipm.state import FaultRecord, Status
+from distributedlpsolver_tpu.obs.stats import percentile as _percentile
 
 
 @dataclasses.dataclass
@@ -85,15 +86,12 @@ class RequestResult:
         }
 
 
-def _percentile(values: List[float], q: float) -> float:
-    if not values:
-        return 0.0
-    return float(np.percentile(np.asarray(values), q))
-
-
 def latency_summary(results: List[RequestResult]) -> dict:
     """p50/p95/p99 latency + throughput over completed requests — the
-    service-level summary event emitted at drain/shutdown."""
+    service-level summary event emitted at drain/shutdown. Percentiles
+    come from obs.stats — the one shared implementation (bench and the
+    probes use the same one, so two reports of "p99" agree by
+    construction)."""
     done = [r for r in results if r.status is not Status.TIMEOUT]
     totals = [r.total_ms for r in done]
     queues = [r.queue_ms for r in results]

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
 from distributedlpsolver_tpu.serve.buckets import BucketSpec, BucketTable
 
 
@@ -67,13 +68,28 @@ class Scheduler:
     """Owns the per-bucket queues; all methods require the service lock."""
 
     def __init__(
-        self, table: BucketTable, max_depth: int, flush_s: float
+        self,
+        table: BucketTable,
+        max_depth: int,
+        flush_s: float,
+        metrics: Optional[obs_metrics.MetricsRegistry] = None,
     ):
         self.table = table
         self.max_depth = max_depth
         self.flush_s = flush_s
         self._queues: Dict[QueueKey, deque] = {}
         self._depth = 0
+        # Queue-side instruments (no-ops under the default NULL
+        # registry): depth is the serving system's single most-watched
+        # gauge, and admission rejections are its overload signal.
+        m = metrics if metrics is not None else obs_metrics.get_registry()
+        self._m_depth = m.gauge(
+            "serve_queue_depth", help="requests queued across all buckets"
+        )
+        self._m_rejects = m.counter(
+            "serve_admission_rejections_total",
+            help="submits rejected by admission control",
+        )
 
     def depth(self) -> int:
         return self._depth
@@ -87,6 +103,7 @@ class Scheduler:
 
     def add(self, p: PendingRequest) -> QueueKey:
         if self._depth >= self.max_depth:
+            self._m_rejects.inc()
             raise ServiceOverloaded(
                 f"queue depth {self._depth} at max_queue_depth="
                 f"{self.max_depth}; shed load or raise the bound"
@@ -97,6 +114,7 @@ class Scheduler:
             key = (self.table.spec_for(p.m, p.n), p.tol)
         self._queues.setdefault(key, deque()).append(p)
         self._depth += 1
+        self._m_depth.set(self._depth)
         return key
 
     def ready(self, now: float) -> List[QueueKey]:
@@ -143,6 +161,7 @@ class Scheduler:
                 out.append(q.popleft())
         self._queues.clear()
         self._depth = 0
+        self._m_depth.set(0)
         return out
 
     def pop(
@@ -161,4 +180,5 @@ class Scheduler:
                 expired.append(p)
             else:
                 live.append(p)
+        self._m_depth.set(self._depth)
         return live, expired

@@ -69,6 +69,8 @@ from distributedlpsolver_tpu.ipm.state import (
     Status,
 )
 from distributedlpsolver_tpu.models.problem import LPProblem
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.obs import trace as obs_trace
 from distributedlpsolver_tpu.serve.buckets import (
     BucketSpec,
     BucketTable,
@@ -142,6 +144,16 @@ class ServiceConfig:
     # smaller keeps batches in the queues longer so late submits can
     # still fill them, larger lets the pack stage run further ahead.
     pipeline_depth: int = 2
+    # Observability (obs/): write a Prometheus-text metrics snapshot
+    # here at shutdown (also enables a per-service registry; the JSON
+    # snapshot rides the shutdown summary event). None = inherit the
+    # module-default registry (a no-op unless something enabled it).
+    metrics_path: Optional[str] = None
+    # Write a Chrome-trace (Perfetto-loadable) JSON here at shutdown:
+    # one async track per request connected across the three pipeline
+    # threads, one lane per thread, instant markers for faults /
+    # reshards / ladder swaps. None = inherit the module-default tracer.
+    trace_path: Optional[str] = None
 
 
 def standard_form(problem: LPProblem):
@@ -197,6 +209,8 @@ class SolveService:
         config: Optional[ServiceConfig] = None,
         solver_config: Optional[SolverConfig] = None,
         auto_start: bool = True,
+        metrics: Optional[obs_metrics.MetricsRegistry] = None,
+        tracer=None,
     ):
         self.config = config or ServiceConfig()
         # The bucket path solves raw standard form — presolve/scaling and
@@ -204,6 +218,61 @@ class SolveService:
         self.solver_config = (solver_config or SolverConfig()).replace(
             verbose=False, log_jsonl=None, checkpoint_path=None,
             checkpoint_every=0, profile_dir=None,
+        )
+        # Observability: an explicit registry/tracer wins (bench, tests);
+        # else config paths create per-service ones; else inherit the
+        # module defaults — NULL no-ops unless the CLI enabled them, so
+        # the undecorated path costs nothing (the zero-warm-recompile
+        # and pipeline-timing invariants are measured without obs on).
+        if metrics is not None:
+            self.metrics = metrics
+        elif self.config.metrics_path:
+            self.metrics = obs_metrics.MetricsRegistry()
+        else:
+            self.metrics = obs_metrics.get_registry()
+        if tracer is not None:
+            self.tracer = tracer
+            self._owns_tracer = False
+        elif self.config.trace_path:
+            self.tracer = obs_trace.Tracer(
+                self.config.trace_path, process_name="dlps-serve"
+            )
+            self._owns_tracer = True
+        else:
+            self.tracer = obs_trace.get_tracer()
+            self._owns_tracer = False
+        m = self.metrics
+        self._m_requests_by_status: dict = {}
+        self._m_dispatches = m.counter(
+            "serve_dispatches_total", help="bucket batch dispatches"
+        )
+        self._m_compiles = m.counter(
+            "serve_bucket_compiles_total",
+            help="bucket programs compiled (warm paths must not grow this)",
+        )
+        self._m_solo = m.counter(
+            "serve_solo_fallbacks_total",
+            help="requests routed through the per-request solo ladder",
+        )
+        self._m_queue_ms = m.histogram(
+            "serve_queue_ms", help="submit -> dispatch wait per request"
+        )
+        self._m_total_ms = m.histogram(
+            "serve_total_ms", help="submit -> result latency per request"
+        )
+        self._m_pack_ms = m.histogram(
+            "serve_pack_ms", help="host pack wall per dispatch"
+        )
+        self._m_solve_ms = m.histogram(
+            "serve_solve_ms", help="device solve wall per dispatch"
+        )
+        self._m_overlap_ms = m.histogram(
+            "serve_overlap_ms",
+            help="host pack time under an earlier dispatch's solve window",
+        )
+        self._m_waste = m.histogram(
+            "serve_padding_waste", buckets=obs_metrics.RATIO_BUCKETS,
+            help="padded-entries fraction wasted per dispatch",
         )
         self._mesh = self._build_mesh(self.config.mesh_devices)
         n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
@@ -213,6 +282,7 @@ class SolveService:
             ),
             self.config.max_queue_depth,
             self.config.flush_s,
+            metrics=m,
         )
         self._logger = IterLogger(
             verbose=False, jsonl_path=self.config.log_jsonl
@@ -336,8 +406,17 @@ class SolveService:
             if t is not None:
                 t.join(timeout=10.0)
         self._thread = self._pack_thread = self._solve_thread = None
-        self._logger.event({"event": "service", **self.stats()})
+        summary = {"event": "service", **self.stats()}
+        if self.metrics.enabled:
+            # The summary event carries the JSON metrics snapshot, so a
+            # single JSONL stream is self-describing for `cli report`.
+            summary["metrics"] = self.metrics.snapshot()
+        self._logger.event(summary)
         self._logger.close()
+        if self.config.metrics_path and self.metrics.enabled:
+            self.metrics.write_prometheus(self.config.metrics_path)
+        if self._owns_tracer:
+            self.tracer.close()
 
     # -- submission ------------------------------------------------------
 
@@ -378,8 +457,13 @@ class SolveService:
             p.request_id = self._next_id
             self._next_id += 1
             try:
-                self.scheduler.add(p)
+                key = self.scheduler.add(p)
             except ServiceOverloaded:
+                self.tracer.instant(
+                    "serve.reject",
+                    args={"id": p.request_id, "name": p.name},
+                    cat="serve",
+                )
                 self._logger.event(
                     {
                         "event": "reject",
@@ -389,6 +473,19 @@ class SolveService:
                     }
                 )
                 raise
+            # Request track opens on the submit thread; the nested queue
+            # span (and later pack/solve) begin/end on whichever pipeline
+            # thread handles them — same (cat, id) keeps the track
+            # connected across threads.
+            self.tracer.async_begin(
+                "request", p.request_id,
+                args={
+                    "id": p.request_id, "name": p.name,
+                    "m": p.m, "n": p.n,
+                    "bucket": list(key[0].key()), "tol": key[1],
+                },
+            )
+            self.tracer.async_begin("queue", p.request_id)
             self._wake.notify_all()
         return p.future
 
@@ -417,6 +514,13 @@ class SolveService:
                     live, expired = self.scheduler.pop(key, now)
                     jobs.append(_PackJob(key, live, expired))
                     self._inflight += len(live) + len(expired)
+                    for p in live:
+                        self.tracer.async_end("queue", p.request_id)
+                    for p in expired:
+                        self.tracer.async_end(
+                            "queue", p.request_id,
+                            args={"expired": True},
+                        )
             for job in jobs:  # bounded put: pipeline backpressure
                 self._pack_q.put(job)
         self._pack_q.put(None)  # sentinel flows sched → pack → solve
@@ -430,11 +534,19 @@ class SolveService:
                 self._solve_q.put(None)
                 return
             if job.live and job.live[0].A is not None:
+                spec = job.key[0]
+                for p in job.live:
+                    self.tracer.async_begin("pack", p.request_id)
                 t0 = time.perf_counter()
                 with self._span_lock:
                     self._pack_current = t0
                 try:
-                    job.packed = self._pack_bucket(job.key, job.live)
+                    with self.tracer.span(
+                        f"pack {spec.m}x{spec.n}x{spec.batch}",
+                        cat="pipeline",
+                        args={"live": len(job.live)},
+                    ):
+                        job.packed = self._pack_bucket(job.key, job.live)
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception as e:
@@ -447,6 +559,8 @@ class SolveService:
                     self._pack_current = None
                     self._pack_spans.append((t0, t1))
                     del self._pack_spans[:-128]
+                for p in job.live:
+                    self.tracer.async_end("pack", p.request_id)
             self._solve_q.put(job)
 
     def _pack_bucket(self, key: QueueKey, live: List[PendingRequest]) -> _Packed:
@@ -592,6 +706,8 @@ class SolveService:
 
         faults: List[FaultRecord] = []
         res = None
+        for p in live:
+            self.tracer.async_begin("solve", p.request_id)
         t_sol0 = time.perf_counter()
         for attempt in range(1 + self.config.max_batch_retries):
             try:
@@ -610,11 +726,19 @@ class SolveService:
                 if warm_key not in self._warm:
                     size0 = bucket_cache_size()
                     t0 = time.perf_counter()
-                    solve_bucket(batch, active, cfg, mesh=mesh, max_iter=1)
+                    with self.tracer.span(
+                        f"compile {spec.m}x{spec.n}x{spec.batch}",
+                        cat="pipeline",
+                    ):
+                        solve_bucket(
+                            batch, active, cfg, mesh=mesh, max_iter=1
+                        )
                     compile_ms = (time.perf_counter() - t0) * 1e3
                     self._warm.add(warm_key)
+                    new_programs = bucket_cache_size() - size0
+                    self._m_compiles.inc(new_programs)
                     with self._lock:
-                        self._compiles += bucket_cache_size() - size0
+                        self._compiles += new_programs
 
                 def _solve():
                     return solve_bucket(batch, active, cfg, mesh=mesh)
@@ -642,6 +766,14 @@ class SolveService:
                 )
             fault.at_time = time.time()
             faults.append(fault)
+            self.tracer.instant(
+                "serve.fault",
+                args={
+                    "dispatch": seq, "kind": fault.kind.value,
+                    "action": fault.action,
+                },
+                cat="serve",
+            )
             self._logger.event(
                 {
                     "event": "fault",
@@ -653,9 +785,23 @@ class SolveService:
                 }
             )
         t_sol1 = time.perf_counter()
+        for p in live:
+            self.tracer.async_end("solve", p.request_id)
+        self.tracer.complete(
+            f"solve {spec.m}x{spec.n}x{spec.batch} #{seq}",
+            t_sol1 - t_sol0, cat="pipeline",
+            args={"dispatch": seq, "live": len(live),
+                  "attempts": len(faults) + (1 if res is not None else 0)},
+            end_us=t_sol1 * 1e6,
+        )
         # Pack work (for LATER batches) that ran inside this dispatch's
         # device window — the pipeline's realized overlap.
         overlap_ms = self._overlap_ms(t_sol0, t_sol1)
+        self._m_dispatches.inc()
+        self._m_pack_ms.observe(packed.pack_ms)
+        self._m_solve_ms.observe((t_sol1 - t_sol0) * 1e3)
+        self._m_overlap_ms.observe(overlap_ms)
+        self._m_waste.observe(waste)
 
         with self._lock:
             depth = self.scheduler.depth()
@@ -782,6 +928,10 @@ class SolveService:
                 lb=np.zeros(n), ub=np.full(n, _INF), name=p.name,
             )
         cfg = self.solver_config.replace(tol=p.tol)
+        self._m_solo.inc()
+        self.tracer.async_begin(
+            "solo", p.request_id, args={"retried": retried}
+        )
         t0 = time.perf_counter()
         try:
             if self.config.solo_recovery:
@@ -807,6 +957,7 @@ class SolveService:
                 )
             ]
         done = time.perf_counter()
+        self.tracer.async_end("solo", p.request_id)
         self._finish(
             p,
             RequestResult(
@@ -887,6 +1038,22 @@ class SolveService:
             # Stats only need the scalar fields; retaining every x would
             # grow a long-running service's memory without bound.
             self._results.append(dataclasses.replace(result, x=None))
+        status = result.status.value
+        ctr = self._m_requests_by_status.get(status)
+        if ctr is None:
+            ctr = self.metrics.counter(
+                "serve_requests_total", labels={"status": status},
+                help="finished requests by terminal status",
+            )
+            self._m_requests_by_status[status] = ctr
+        ctr.inc()
+        self._m_queue_ms.observe(result.queue_ms)
+        self._m_total_ms.observe(result.total_ms)
+        self.tracer.async_end(
+            "request", p.request_id,
+            args={"status": status,
+                  "total_ms": round(result.total_ms, 3)},
+        )
         self._logger.event(result.record())
         # A caller may have cancelled its still-pending future (submit
         # never marks it RUNNING, so Future.cancel succeeds). Claiming it
@@ -926,6 +1093,12 @@ class SolveService:
                     (k,), axis_names=("batch",), devices=survivors[:k]
                 )
             n_dev = max(1, k)
+        self.metrics.gauge(
+            "serve_mesh_devices", help="devices under the batch axis"
+        ).set(n_dev)
+        self.tracer.instant(
+            "serve.reshard", args={"devices": n_dev}, cat="serve"
+        )
         self._logger.event(
             {
                 "event": "reshard",
@@ -957,7 +1130,8 @@ class SolveService:
         with self._wake:
             pending = self.scheduler.drain_pending()
             self.scheduler = Scheduler(
-                table, self.config.max_queue_depth, self.config.flush_s
+                table, self.config.max_queue_depth, self.config.flush_s,
+                metrics=self.metrics,
             )
             misfits = []
             for p in pending:
@@ -970,6 +1144,12 @@ class SolveService:
             self._fail_batch(
                 (BucketSpec(p.m, p.n, 1), p.tol), [p], e
             )
+        self.tracer.instant(
+            "serve.ladder_swap",
+            args={"buckets": len(table.specs()), "migrated": len(pending),
+                  "misfits": len(misfits)},
+            cat="serve",
+        )
         self._logger.event(
             {
                 "event": "ladder_swap",
@@ -1027,8 +1207,10 @@ class SolveService:
                 continue
             self._warm.add(wk)
             warmed += 1
+            new_programs = bucket_cache_size() - size0
+            self._m_compiles.inc(new_programs)
             with self._lock:
-                self._compiles += bucket_cache_size() - size0
+                self._compiles += new_programs
             self._logger.event(
                 {
                     "event": "warmup",

@@ -78,6 +78,8 @@ from distributedlpsolver_tpu.ipm.state import (
     IPMResult,
     Status,
 )
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.obs import trace as obs_trace
 from distributedlpsolver_tpu.parallel import mesh as mesh_lib
 from distributedlpsolver_tpu.parallel import runtime as rt
 from distributedlpsolver_tpu.supervisor.adaptive import AdaptiveDeadline
@@ -240,6 +242,20 @@ class _SupervisorHooks(SolveHooks):
             # wall-clock loss without diffing timestamps by hand).
             overhead = time.time() - self.pending_fault.at_time
             self.pending_fault.recovery_overhead_s = overhead
+            obs_metrics.get_registry().histogram(
+                "supervisor_recovery_overhead_seconds",
+                buckets=obs_metrics.SECONDS_BUCKETS,
+                help="fault classification to first post-resume iteration",
+            ).observe(overhead)
+            obs_trace.get_tracer().instant(
+                "supervisor.resume",
+                args={
+                    "backend": self.backend,
+                    "action": self.pending_fault.action,
+                    "recovery_overhead_s": round(overhead, 6),
+                },
+                cat="supervisor",
+            )
             if self.events is not None:
                 self.events.event(
                     {
@@ -543,6 +559,27 @@ def supervised_solve(
 
 
 def _emit_fault(events: Optional[IterLogger], fault: FaultRecord) -> None:
+    # Metrics/trace first: faults must be counted (and visible on the
+    # trace timeline) even when no JSONL stream is configured.
+    obs_metrics.get_registry().counter(
+        "supervisor_faults_total", labels={"kind": fault.kind.value},
+        help="faults classified by the solve supervisor",
+    ).inc()
+    obs_metrics.get_registry().counter(
+        "supervisor_recoveries_total",
+        labels={"action": fault.action.split(":")[0] or "none"},
+        help="recovery-ladder actions taken (rung family)",
+    ).inc()
+    obs_trace.get_tracer().instant(
+        "supervisor.fault",
+        args={
+            "kind": fault.kind.value,
+            "backend": fault.backend,
+            "action": fault.action,
+            "iteration": fault.iteration,
+        },
+        cat="supervisor",
+    )
     if events is None:
         return
     events.event(

@@ -17,6 +17,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+
 
 class StepDeadlineExceeded(RuntimeError):
     """A device step exceeded its watchdog deadline (FaultKind.HANG)."""
@@ -60,6 +62,13 @@ def run_with_deadline(
     t.start()
     t.join(timeout)
     if t.is_alive():
+        # Off the hot path by construction: a deadline hit already costs
+        # a full recovery cycle, so the instrument resolve is fine here
+        # (and the happy path above pays nothing).
+        obs_metrics.get_registry().counter(
+            "watchdog_timeouts_total",
+            help="device dispatches abandoned past their deadline",
+        ).inc()
         raise StepDeadlineExceeded(iteration, timeout)
     if "error" in box:
         raise box["error"]

@@ -11,14 +11,31 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Optional, TextIO
 
 from distributedlpsolver_tpu.ipm.state import IterRecord
+from distributedlpsolver_tpu.obs import SCHEMA_VERSION
 
 _HEADER = (
     f"{'it':>4} {'mu':>10} {'rel_gap':>10} {'pinf':>10} {'dinf':>10} "
     f"{'a_p':>6} {'a_d':>6} {'sigma':>8} {'pobj':>14} {'t_iter':>8}"
 )
+
+
+def stamp_record(payload: dict) -> dict:
+    """Inject the shared record schema into one JSONL payload (in place):
+    ``schema_version``, wall-clock ``ts`` (unix seconds — merging streams
+    across processes), and monotonic ``t_mono`` (``perf_counter`` seconds
+    — ordering within a process, and the clock the Chrome-trace events
+    use, so a trace and a JSONL stream line up exactly). Every writer —
+    IterLogger rows and events, and the CLI's serve output stream —
+    routes through this one helper; ``cli report`` stays backward-
+    compatible with unstamped PR 1–4 files."""
+    payload.setdefault("schema_version", SCHEMA_VERSION)
+    payload.setdefault("ts", round(time.time(), 6))
+    payload.setdefault("t_mono", round(time.perf_counter(), 6))
+    return payload
 
 
 class IterLogger:
@@ -71,24 +88,25 @@ class IterLogger:
                 f"{rec.alpha_d:>6.3f} {rec.sigma:>8.1e} {rec.pobj:>14.6e} "
                 f"{rec.t_iter:>8.4f}"
             )
-        # The handle check lives INSIDE the lock: close() nulls _fh under
-        # it, and a dispatcher thread outliving shutdown's join timeout
-        # must drop records silently, not race a closing handle.
-        with self._lock:
-            if self._fh:
-                self._fh.write(json.dumps(rec.asdict()) + "\n")
-                self._fh.flush()
-                if self._fsync:
-                    os.fsync(self._fh.fileno())
+        self._write(rec.asdict())
 
     def event(self, payload: dict) -> None:
         """Write one non-iteration event record (fault classified, resume
         landed) into the same JSONL stream, flushed like iteration rows.
         Events carry an ``"event"`` key so consumers separate them from
         iteration records (which never have one)."""
+        self._write(payload)
+
+    def _write(self, payload: dict) -> None:
+        # The single JSONL emission point: every record — iteration row
+        # or event — is schema-stamped here and written as one flushed
+        # line. The handle check lives INSIDE the lock: close() nulls
+        # _fh under it, and a dispatcher thread outliving shutdown's
+        # join timeout must drop records silently, not race a closing
+        # handle.
         with self._lock:
             if self._fh:
-                self._fh.write(json.dumps(payload) + "\n")
+                self._fh.write(json.dumps(stamp_record(payload)) + "\n")
                 self._fh.flush()
                 if self._fsync:
                     os.fsync(self._fh.fileno())

@@ -55,6 +55,16 @@ def main() -> int:
         help="fail if the whole probe exceeds this wall time (0 = no "
         "budget) — the tier-1 serving-throughput envelope",
     )
+    ap.add_argument(
+        "--metrics-path", default=None,
+        help="write a Prometheus-text metrics snapshot here and fail "
+        "unless it is produced and non-trivial",
+    )
+    ap.add_argument(
+        "--trace-path", default=None,
+        help="write a Chrome-trace JSON here and fail unless it loads "
+        "and holds a connected cross-thread request track",
+    )
     args = ap.parse_args()
     t_probe = time.perf_counter()
     n = 24 if args.quick else args.requests
@@ -70,6 +80,8 @@ def main() -> int:
     cfg = ServiceConfig(
         batch=8, flush_s=0.02, fault_injector=injector,
         mesh_devices=args.mesh_devices,
+        metrics_path=args.metrics_path,
+        trace_path=args.trace_path,
     )
     with SolveService(cfg) as svc:
         t0 = time.perf_counter()
@@ -139,6 +151,49 @@ def main() -> int:
             f"FAIL: probe took {probe_wall:.1f}s > budget {args.budget_s:.0f}s"
         )
         ok = False
+    # Observability artifacts (written at service shutdown): both must
+    # exist and be VALID, not just present — the tier-1 smoke relies on
+    # this probe to prove the obs layer end-to-end without TPU hardware.
+    if args.metrics_path:
+        try:
+            text = open(args.metrics_path).read()
+            n_samples = sum(
+                1 for l in text.splitlines() if l and not l.startswith("#")
+            )
+            assert "serve_dispatches_total" in text
+            assert "serve_requests_total" in text
+            print(f"  metrics snapshot: {n_samples} samples "
+                  f"-> {args.metrics_path}")
+        except Exception as e:
+            print(f"FAIL: metrics snapshot invalid: {e}")
+            ok = False
+    if args.trace_path:
+        try:
+            import json
+
+            trace = json.load(open(args.trace_path))
+            events = trace["traceEvents"]
+            # ≥1 connected cross-thread request track: some request id
+            # whose async begin/end events span more than one thread.
+            by_id = {}
+            for e in events:
+                if e.get("cat") == "request" and e.get("ph") in ("b", "e"):
+                    by_id.setdefault(e["id"], []).append(e)
+            connected = [
+                rid for rid, evs in by_id.items()
+                if len({e["tid"] for e in evs}) > 1
+                and sum(e["ph"] == "b" for e in evs)
+                == sum(e["ph"] == "e" for e in evs)
+            ]
+            assert connected, "no cross-thread request track"
+            print(
+                f"  trace: {len(events)} events, {len(by_id)} request "
+                f"tracks ({len(connected)} cross-thread) -> "
+                f"{args.trace_path}"
+            )
+        except Exception as e:
+            print(f"FAIL: trace invalid: {e}")
+            ok = False
     print(f"probe wall: {probe_wall:.1f}s")
     print("PASS" if ok else "FAIL")
     return 0 if ok else 1

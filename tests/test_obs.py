@@ -1,0 +1,442 @@
+"""Observability-layer tests (obs/): metrics-registry thread safety,
+histogram bucket edges, Prometheus/JSON exporters, Chrome-trace JSON
+validity (spans nest, cross-thread request tracks connect), the
+zero-cost no-op mode, schema stamping + legacy-file compatibility, and
+the acceptance run — a 200-request service whose `cli report` totals
+reconcile exactly with ``SolveService.stats()``."""
+
+import json
+import os
+import sys
+import threading
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.obs import SCHEMA_VERSION
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.obs import report as obs_report
+from distributedlpsolver_tpu.obs import trace as obs_trace
+from distributedlpsolver_tpu.obs.stats import percentile, summarize
+
+pytestmark = pytest.mark.obs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestMetricsRegistry:
+    def test_counter_thread_safety(self):
+        """Concurrent increments from many threads must lose nothing —
+        the registry is written from the submit, scheduler, pack, and
+        solve threads simultaneously in production."""
+        reg = obs_metrics.MetricsRegistry()
+        c = reg.counter("hits_total")
+        h = reg.histogram("lat_ms")
+        g = reg.gauge("depth")
+        n_threads, n_iter = 8, 5_000
+
+        def worker():
+            for i in range(n_iter):
+                c.inc()
+                h.observe(float(i % 100))
+                g.set(i)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * n_iter
+        assert h.count == n_threads * n_iter
+
+    def test_histogram_bucket_edges(self):
+        """Prometheus ``le`` semantics: an observation exactly at an edge
+        lands in that edge's bucket; above the last edge only count/sum
+        grow (the implicit +Inf bucket)."""
+        h = obs_metrics.Histogram(edges=(1.0, 5.0, 10.0))
+        for v in (0.5, 1.0, 1.0001, 5.0, 9.99, 10.0, 10.0001, 1e9):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["buckets"] == {"1": 2, "5": 2, "10": 2}
+        assert snap["count"] == 8
+        assert snap["sum"] == pytest.approx(0.5 + 1.0 + 1.0001 + 5.0 + 9.99
+                                            + 10.0 + 10.0001 + 1e9)
+
+    def test_histogram_rejects_unsorted_edges(self):
+        with pytest.raises(ValueError):
+            obs_metrics.Histogram(edges=(5.0, 1.0))
+        with pytest.raises(ValueError):
+            obs_metrics.Histogram(edges=(1.0, 1.0))
+
+    def test_labels_are_distinct_instruments(self):
+        reg = obs_metrics.MetricsRegistry()
+        a = reg.counter("req_total", labels={"status": "ok"})
+        b = reg.counter("req_total", labels={"status": "bad"})
+        assert a is not b
+        a.inc(3)
+        b.inc()
+        snap = reg.snapshot()
+        assert snap['req_total{status="ok"}'] == 3
+        assert snap['req_total{status="bad"}'] == 1
+        # same (name, labels) -> same object, any key order
+        assert reg.counter("req_total", labels={"status": "ok"}) is a
+
+    def test_kind_confusion_rejected(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("x_total")
+        with pytest.raises(TypeError):
+            reg.gauge("x_total")
+
+    def test_prometheus_text_format(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("a_total", help="things").inc(2)
+        h = reg.histogram("d_ms", buckets=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(50.0)
+        text = reg.to_prometheus_text()
+        assert "# HELP a_total things" in text
+        assert "# TYPE a_total counter" in text
+        assert "a_total 2" in text
+        # cumulative buckets + +Inf + sum/count
+        assert 'd_ms_bucket{le="1"} 1' in text
+        assert 'd_ms_bucket{le="10"} 2' in text
+        assert 'd_ms_bucket{le="+Inf"} 3' in text
+        assert "d_ms_count 3" in text
+
+    def test_null_registry_emits_nothing(self):
+        null = obs_metrics.NULL
+        c = null.counter("anything")
+        c.inc()
+        c.observe(1.0)
+        c.set(2.0)
+        assert null.snapshot() == {}
+        assert null.to_prometheus_text() == ""
+        # all null instruments are the one shared object
+        assert null.histogram("h") is null.gauge("g") is c
+
+    def test_null_mode_no_per_call_allocations(self):
+        """The no-op path must not allocate per call: instrumented hot
+        loops (one inc + one observe per IPM iteration) may add method
+        calls but no garbage. Measured with tracemalloc over 10k calls."""
+        c = obs_metrics.NULL.counter("x")
+        t = obs_trace.NULL_TRACER
+        # warm anything lazily created by the first calls
+        c.inc()
+        c.observe(1.0)
+        t.instant("w")
+        tracemalloc.start()
+        before = tracemalloc.take_snapshot()
+        for i in range(10_000):
+            c.inc()
+            c.observe(1.0)
+            t.instant("x")
+            t.async_begin("r", i)
+        after = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        growth = sum(
+            s.size_diff for s in after.compare_to(before, "filename")
+            if s.size_diff > 0
+        )
+        # tracemalloc's own bookkeeping costs a few KB; 10k no-op calls
+        # allocating anything per call would show ~MBs here.
+        assert growth < 64 * 1024, f"no-op mode allocated {growth} bytes"
+
+
+class TestStats:
+    def test_percentile_matches_numpy(self):
+        vals = [float(v) for v in np.random.default_rng(0).normal(size=500)]
+        for q in (50, 95, 99):
+            assert percentile(vals, q) == pytest.approx(
+                float(np.percentile(np.asarray(vals), q))
+            )
+        assert percentile([], 50) == 0.0
+
+    def test_summarize_shape(self):
+        s = summarize([1.0, 2.0, 3.0, 4.0])
+        assert s["count"] == 4
+        assert s["p50"] == pytest.approx(2.5)
+        assert s["max"] == 4.0
+        empty = summarize([])
+        assert empty["count"] == 0 and empty["p99"] == 0.0
+
+
+class TestTracer:
+    def test_trace_json_valid_spans_nest(self, tmp_path):
+        path = tmp_path / "t.json"
+        tr = obs_trace.Tracer(str(path))
+        with tr.span("outer"):
+            with tr.span("inner"):
+                time.sleep(0.002)
+        tr.instant("marker", args={"k": 1})
+        tr.close()
+        doc = json.loads(path.read_text())
+        evs = doc["traceEvents"]
+        xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+        assert set(xs) == {"outer", "inner"}
+        # inner nests inside outer on the same lane
+        assert xs["inner"]["tid"] == xs["outer"]["tid"]
+        assert xs["outer"]["ts"] <= xs["inner"]["ts"]
+        assert (
+            xs["inner"]["ts"] + xs["inner"]["dur"]
+            <= xs["outer"]["ts"] + xs["outer"]["dur"] + 1.0
+        )
+        assert any(e["ph"] == "i" and e["name"] == "marker" for e in evs)
+        # thread metadata names the lane
+        assert any(
+            e["ph"] == "M" and e["name"] == "thread_name" for e in evs
+        )
+
+    def test_cross_thread_request_track_connected(self, tmp_path):
+        """Async b/e events with one (cat, id) emitted from different
+        threads form one connected track — the serve pipeline's
+        submit -> scheduler -> pack -> solve handoff in miniature."""
+        path = tmp_path / "t.json"
+        tr = obs_trace.Tracer(str(path))
+        tr.async_begin("request", 7)
+        tr.async_begin("queue", 7)
+
+        def stage():
+            tr.async_end("queue", 7)
+            tr.async_begin("solve", 7)
+            tr.async_end("solve", 7)
+            tr.async_end("request", 7)
+
+        t = threading.Thread(target=stage, name="other-thread")
+        t.start()
+        t.join()
+        tr.close()
+        evs = [
+            e for e in json.loads(path.read_text())["traceEvents"]
+            if e.get("cat") == "request" and e.get("id") == 7
+        ]
+        assert sum(e["ph"] == "b" for e in evs) == 3
+        assert sum(e["ph"] == "e" for e in evs) == 3
+        assert len({e["tid"] for e in evs}) == 2  # genuinely cross-thread
+        # begins and ends pair up per name (balanced track)
+        for name in ("request", "queue", "solve"):
+            named = [e for e in evs if e["name"] == name]
+            assert [e["ph"] for e in sorted(named, key=lambda e: e["ts"])] \
+                == ["b", "e"]
+
+    def test_event_cap_drops_not_grows(self, tmp_path):
+        path = tmp_path / "t.json"
+        tr = obs_trace.Tracer(str(path))
+        cap_save = obs_trace.MAX_EVENTS
+        try:
+            obs_trace.MAX_EVENTS = 10
+            for i in range(50):
+                tr.instant(f"e{i}")
+        finally:
+            obs_trace.MAX_EVENTS = cap_save
+        tr.close()
+        doc = json.loads(path.read_text())
+        assert len(doc["traceEvents"]) <= 10
+        assert doc["otherData"]["dropped_events"] > 0
+
+
+class TestSchemaStamp:
+    def test_iterlogger_stamps_rows_and_events(self, tmp_path):
+        from distributedlpsolver_tpu.ipm.state import IterRecord
+        from distributedlpsolver_tpu.utils.logging import IterLogger
+
+        path = tmp_path / "log.jsonl"
+        lg = IterLogger(jsonl_path=str(path))
+        lg.log(
+            IterRecord(
+                iter=1, mu=1.0, gap=1.0, rel_gap=1.0, pinf=0.1, dinf=0.1,
+                alpha_p=0.9, alpha_d=0.9, sigma=0.1, pobj=1.0, dobj=0.5,
+                t_iter=0.01,
+            )
+        )
+        lg.event({"event": "fault", "kind": "crash"})
+        lg.close()
+        recs = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(recs) == 2
+        for r in recs:
+            assert r["schema_version"] == SCHEMA_VERSION
+            assert r["ts"] > 1e9  # unix wall clock
+            assert r["t_mono"] > 0
+        assert "event" not in recs[0] and recs[1]["event"] == "fault"
+
+    def test_report_reads_legacy_unstamped_files(self, tmp_path):
+        """PR 1-4 JSONL files carry no stamps; the loader classifies by
+        shape and the report must not care."""
+        path = tmp_path / "old.jsonl"
+        rows = [
+            {"iter": 1, "t_iter": 0.5, "rel_gap": 1e-2},
+            {"iter": 2, "t_iter": 0.5, "rel_gap": 1e-9},
+            {"event": "fault", "kind": "hang", "action": "rollback"},
+            {"event": "resume", "recovery_overhead_s": 0.25},
+        ]
+        path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        rep = obs_report.report_from_paths([str(path)])
+        assert rep["stamped_records"] == 0
+        assert rep["iterations"]["count"] == 2
+        assert rep["iterations"]["iters_per_sec"] == pytest.approx(2.0)
+        assert rep["faults"]["by_kind"] == {"hang": 1}
+        assert rep["recovery"]["overhead_s_total"] == pytest.approx(0.25)
+        # truncated/garbage lines are skipped, not fatal (crash logs)
+        path.write_text(path.read_text() + '{"iter": 3, "t_it')
+        rep2 = obs_report.report_from_paths([str(path)])
+        assert rep2["iterations"]["count"] == 2
+
+
+@pytest.mark.serve
+class TestServiceReconciliation:
+    def test_200_request_report_reconciles_with_stats(self, tmp_path):
+        """Acceptance: a 200-request service run; `cli report` over its
+        JSONL + snapshot artifacts must print per-phase percentiles and
+        a padding-waste-by-bucket table whose request/dispatch totals
+        match ``SolveService.stats()`` exactly, and the trace must be
+        valid Chrome-trace JSON with >= 1 connected cross-thread
+        request track."""
+        from distributedlpsolver_tpu.models.generators import (
+            random_request_stream,
+        )
+        from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+
+        log = tmp_path / "svc.jsonl"
+        prom = tmp_path / "svc.prom"
+        trace_path = tmp_path / "svc.trace.json"
+        cfg = ServiceConfig(
+            batch=8, flush_s=0.02, log_jsonl=str(log),
+            metrics_path=str(prom), trace_path=str(trace_path),
+        )
+        with SolveService(cfg) as svc:
+            futs = [
+                svc.submit(p) for p in random_request_stream(200, seed=11)
+            ]
+            assert svc.drain(timeout=600)
+            results = [f.result(timeout=30) for f in futs]
+            stats = svc.stats()
+        assert sum(r.status.value == "optimal" for r in results) == 200
+
+        # ---- report over the artifacts the run just wrote ----
+        rep = obs_report.report_from_paths([str(log)])
+        assert rep["requests"]["count"] == stats["requests"] == 200
+        assert rep["dispatches"]["count"] == stats["dispatches"]
+        # per-bucket dispatch totals reconcile too
+        assert (
+            sum(
+                row["dispatches"]
+                for row in rep["padding_by_bucket"].values()
+            )
+            == stats["dispatches"]
+        )
+        # per-phase percentiles agree with the service's own summary
+        # (same shared implementation, same data)
+        assert rep["requests"]["phases"]["total_ms"]["p50"] \
+            == pytest.approx(stats["latency_ms_p50"], rel=1e-6)
+
+        # the summary event embeds the metrics snapshot (self-describing
+        # stream), and its counters reconcile as well
+        service_events = [
+            json.loads(l)
+            for l in log.read_text().splitlines()
+            if '"service"' in l
+        ]
+        summary = [
+            e for e in service_events if e.get("event") == "service"
+        ][-1]
+        snap = summary["metrics"]
+        assert snap["serve_dispatches_total"] == stats["dispatches"]
+        assert (
+            sum(
+                v for k, v in snap.items()
+                if k.startswith("serve_requests_total")
+            )
+            == 200
+        )
+
+        # ---- rendered report prints the promised tables ----
+        text = obs_report.render(rep)
+        assert "per-phase latency (ms)" in text
+        assert "padding waste by bucket" in text
+        assert "p50" in text and "p95" in text and "p99" in text
+
+        # ---- prometheus + trace artifacts ----
+        prom_text = prom.read_text()
+        assert "serve_requests_total" in prom_text
+        assert "serve_queue_depth 0" in prom_text  # drained
+        doc = json.loads(trace_path.read_text())
+        by_id: dict = {}
+        for e in doc["traceEvents"]:
+            if e.get("cat") == "request" and e.get("ph") in ("b", "e"):
+                by_id.setdefault(e["id"], []).append(e)
+        assert len(by_id) == 200
+        connected = [
+            rid for rid, evs in by_id.items()
+            if len({e["tid"] for e in evs}) > 1
+        ]
+        assert connected  # >= 1 cross-thread request track
+
+    def test_disabled_obs_unchanged_invariants(self):
+        """With observability off (the default), the service keeps the
+        NULL registry/tracer, warm dispatch compiles nothing, and no
+        artifacts appear — the zero-cost-when-disabled contract."""
+        from distributedlpsolver_tpu.backends.batched import (
+            bucket_cache_size,
+        )
+        from distributedlpsolver_tpu.models.generators import (
+            random_request_stream,
+        )
+        from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+
+        with SolveService(ServiceConfig(batch=4, flush_s=0.01)) as svc:
+            assert svc.metrics is obs_metrics.NULL
+            assert svc.tracer is obs_trace.NULL_TRACER
+            futs = [
+                svc.submit(p) for p in random_request_stream(8, seed=13)
+            ]
+            assert svc.drain(timeout=600)
+            [f.result(timeout=30) for f in futs]
+            cache0 = bucket_cache_size()
+            futs = [
+                svc.submit(p) for p in random_request_stream(8, seed=13)
+            ]
+            assert svc.drain(timeout=600)
+            rs = [f.result(timeout=30) for f in futs]
+            # the invariant the obs layer must not perturb
+            assert bucket_cache_size() - cache0 == 0
+            assert all(r.status.value == "optimal" for r in rs)
+
+
+class TestCliReport:
+    def test_cli_report_over_mixed_streams(self, tmp_path, capsys):
+        from distributedlpsolver_tpu.cli import main
+
+        jsonl = tmp_path / "s.jsonl"
+        rows = [
+            {"event": "request", "id": 0, "status": "optimal",
+             "bucket": [8, 32, 4], "queue_ms": 5.0, "pack_ms": 1.0,
+             "compile_ms": 0.0, "solve_ms": 2.0, "total_ms": 8.0,
+             "padding_waste": 0.25, "dispatch": 0},
+            {"event": "batch", "dispatch": 0, "bucket": [8, 32, 4],
+             "live": 1, "pack_ms": 1.0, "solve_ms": 2.0,
+             "overlap_ms": 0.5, "attempts": 1},
+            {"iter": 1, "t_iter": 0.1, "rel_gap": 1e-9},
+        ]
+        jsonl.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        snap = tmp_path / "m.json"
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("ipm_iterations_total").inc(42)
+        reg.write_snapshot(str(snap))
+        rc = main(["report", str(jsonl), str(snap)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "per-phase latency" in out
+        assert "8x32x4" in out
+        assert "ipm_iterations_total: 42" in out
+        rc = main(["report", str(jsonl), "--json"])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["requests"]["count"] == 1
+        assert rep["dispatches"]["count"] == 1
+
+    def test_cli_report_missing_file(self, capsys):
+        from distributedlpsolver_tpu.cli import main
+
+        assert main(["report", "/nonexistent/x.jsonl"]) == 2

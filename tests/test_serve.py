@@ -373,23 +373,36 @@ def test_cli_serve_backpressure_survives_overload(tmp_path):
     assert all(r["status"] == "optimal" for r in records)
 
 
-def test_probe_serve_smoke():
+def test_probe_serve_smoke(tmp_path):
     """CI satellite: the 200-request CPU load probe runs on every tier-1
     pass under a generous wall-time envelope, so a serving-throughput
     regression (lost pipeline overlap, a recompiling warm path, a stuck
     dispatcher) is caught without TPU hardware. The probe itself asserts
     nonzero pack/solve overlap, zero warm recompiles, fault recovery and
     deadline handling; --budget-s makes it fail on the wall clock too
-    (measured ~6 s warm-cache, ~60 s cold — 240 s is regression-class)."""
+    (measured ~6 s warm-cache, ~60 s cold — 240 s is regression-class).
+    The obs flags make it also prove the observability layer end-to-end:
+    the probe fails unless the metrics snapshot and the Chrome trace are
+    produced AND valid (connected cross-thread request track included),
+    and `cli report` over the trace-side JSONL must parse here."""
+    metrics_path = tmp_path / "probe.prom"
+    trace_path = tmp_path / "probe.trace.json"
     t0 = time.perf_counter()
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "probe_serve.py"),
-         "--requests", "200", "--budget-s", "240"],
+         "--requests", "200", "--budget-s", "240",
+         "--metrics-path", str(metrics_path),
+         "--trace-path", str(trace_path)],
         capture_output=True, text=True, timeout=400,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+    # Artifact validity is asserted inside the probe; re-assert the
+    # basics here so a silently-skipped probe check cannot pass CI.
+    assert "serve_requests_total" in metrics_path.read_text()
+    trace = json.loads(trace_path.read_text())
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
     # the probe's own budget is authoritative; this outer bound only
     # flags it loudly if the probe outgrows its smoke-test class
     assert time.perf_counter() - t0 < 400
