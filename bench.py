@@ -861,9 +861,19 @@ def main() -> int:
     ap.add_argument("--backend", default="tpu")
     ap.add_argument("--baseline-backend", default="cpu-native")
     ap.add_argument("--mps", default=None, help="bench this MPS file instead")
+    ap.add_argument(
+        "--require-tpu", action="store_true",
+        help="hard-fail (exit 4) instead of falling back to CPU when the "
+        "accelerator is unavailable — a fallback round produces only "
+        'unquotable "cpu-fallback" rows (BENCH_r05)',
+    )
     args = ap.parse_args()
     if args.mps and not os.path.exists(args.mps):
         ap.error(f"--mps {args.mps!r}: file not found")  # before any solve
+
+    from distributedlpsolver_tpu.utils.accel import require_tpu
+
+    require_tpu(args.require_tpu)  # abort BEFORE the fallback path below
 
     import jax
 

@@ -1,0 +1,48 @@
+"""graftcheck: the repo's static-analysis suite, wired into tier-1 as a
+CI gate (``cli check distributedlpsolver_tpu/`` must exit 0).
+
+Four rule families enforce the invariants the runtime tests can only
+spot-check (README "Static analysis" has the catalogue and suppression
+syntax):
+
+- jit/recompile hygiene — ``jit-nonhoisted``, ``jit-scalar-default``,
+  ``jit-donate``, ``host-sync`` (rules_jit)
+- dtype discipline — ``dtype-explicit``, ``dtype-narrow`` (rules_dtype)
+- lock discipline — ``guarded-by`` (rules_locks), paired with the
+  dynamic :mod:`~distributedlpsolver_tpu.analysis.lockorder` recorder
+- JSONL schema conformance — ``jsonl-fields``, ``jsonl-stamp``
+  (rules_schema)
+
+Stdlib-only on purpose: the gate runs on CPU CI in well under a second,
+with no jax import.
+"""
+
+from distributedlpsolver_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    all_rules,
+    check_file,
+    check_paths,
+    iter_py_files,
+    render_json,
+    render_text,
+    rule,
+)
+from distributedlpsolver_tpu.analysis.lockorder import (
+    LockOrderRecorder,
+    LockOrderViolation,
+)
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "LockOrderRecorder",
+    "LockOrderViolation",
+    "all_rules",
+    "check_file",
+    "check_paths",
+    "iter_py_files",
+    "render_json",
+    "render_text",
+    "rule",
+]

@@ -1,0 +1,170 @@
+"""Repo-specific graftcheck tuning: which scopes are hot, which modules
+may narrow precision, which programs must donate, and the JSONL record
+schema catalogue. Rules read these tables; changing project policy means
+editing here, not the rule logic.
+"""
+
+from __future__ import annotations
+
+# -- host-sync (rules_jit) ---------------------------------------------------
+# Function scopes where a host↔device synchronization is a pipeline
+# stall: the serve dispatcher's pack/solve thread bodies (a sync there
+# serializes the two-deep pipeline PR 4 built) and the IPM driver's
+# per-iteration loop (a sync there caps iters/sec). Keys are
+# package-relative paths; values are qualnames ("Class.method" or bare
+# function names). Deliberate sync points inside these scopes carry
+# line-level ``# graftcheck: disable=host-sync`` comments explaining why.
+HOT_SCOPES = {
+    "serve/service.py": {
+        "SolveService._run_pack",
+        "SolveService._pack_bucket",
+        "SolveService._run_solve",
+        "SolveService._dispatch",
+        "SolveService._dispatch_bucket",
+    },
+    "ipm/driver.py": {
+        "solve",
+        "_step_once",
+    },
+}
+
+# -- jit-donate (rules_jit) --------------------------------------------------
+# Programs whose big per-call buffers are consumed by the call and dead
+# afterwards; their jit definitions must carry donate_argnums so the
+# device reuses the buffers in place. NOT in this table (deliberately):
+# the serve bucket programs (_solve_bucket_jit) — their inputs are
+# re-dispatched verbatim on batch retry and shared with warm-up calls,
+# so donating them would poison the retry path; and A/data of the
+# segment program, which are loop-invariant across segments.
+DONATE_EXPECTED = {
+    # (pkg_path, function name) -> human description of the donated arg
+    ("backends/batched.py", "_batched_segment_jit"): "carry (arg 2)",
+    ("backends/dense.py", "_eg_scale_reg"): "M (arg 0)",
+}
+
+# -- dtype rules (rules_dtype) -----------------------------------------------
+# Package dirs where every jnp constructor must pin its dtype: these are
+# the device-math layers where "whatever the default is" has already
+# produced silent f32-on-TPU / x64-flag surprises.
+DTYPE_SCOPE_DIRS = ("ops", "ipm", "backends")
+
+# jnp constructors and the positional index their signature accepts
+# dtype at (the repo writes both ``jnp.zeros(n, jnp.f32)`` and
+# ``dtype=``). ``*_like`` variants inherit and are exempt; ``arange`` is
+# exempt — its int default is the index-arithmetic convention here.
+DTYPE_CONSTRUCTORS = {
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+    "eye": 3,
+    "identity": 1,
+    "array": 1,
+    "asarray": 1,
+}
+
+# Modules sanctioned to narrow f64→f32: the mixed-precision schedule
+# owners (ROUND5_NOTES — the f32-gram/f64c and df32 schedules, the
+# two-phase f32 factorization ladder, and the MXU panel kernels).
+# Anywhere else, an ``.astype(float32)`` is a silent precision loss the
+# two-phase design never sanctioned.
+NARROW_SANCTIONED = {
+    "ops/chol_mxu.py",
+    "ops/normal_eq.py",
+    "backends/dense.py",
+    "backends/block_angular.py",
+    "backends/batched.py",
+}
+
+# -- JSONL schema (rules_schema) ---------------------------------------------
+# Event types the telemetry streams may carry (IterLogger.event payloads
+# and RequestResult.record). ``cli report`` and the autotuner dispatch on
+# these; an uncatalogued type is invisible to every consumer.
+JSONL_EVENT_TYPES = {
+    "batch",
+    "dispatch_error",
+    "fault",
+    "ladder_swap",
+    "reject",
+    "request",
+    "reshard",
+    "resume",
+    "service",
+    "warmup",
+    "warmup_error",
+}
+
+# Every field a stamped JSONL record may carry, across all streams: the
+# stamp_record fields, iteration-row fields (ipm.state.IterRecord), the
+# serve request/batch/service records, and the supervisor fault/resume
+# events. The checker flags literal keys outside this set — adding a
+# field is fine, but it must be catalogued here (and picked up by
+# obs/report) in the same change.
+JSONL_FIELDS = {
+    # stamp_record
+    "schema_version",
+    "t_mono",
+    "ts",
+    # IterRecord rows
+    "alpha_d",
+    "alpha_p",
+    "dinf",
+    "dobj",
+    "gap",
+    "iter",
+    "mu",
+    "pinf",
+    "pobj",
+    "rel_gap",
+    "sigma",
+    "t_iter",
+    # event discriminator
+    "event",
+    # serve request records (serve/records.py RequestResult.record)
+    "bucket",
+    "compile_ms",
+    "dispatch",
+    "faults",
+    "id",
+    "iterations",
+    "m",
+    "n",
+    "name",
+    "objective",
+    "overlap_ms",
+    "pack_ms",
+    "padding_waste",
+    "queue_ms",
+    "retried_solo",
+    "slot",
+    "solve_ms",
+    "status",
+    "total_ms",
+    # serve batch/fault/lifecycle events (serve/service.py)
+    "action",
+    "attempts",
+    "buckets",
+    "detail",
+    "devices",
+    "excluded",
+    "kind",
+    "live",
+    "mesh_devices",
+    "metrics",
+    "migrated",
+    "misfits",
+    "occupancy",
+    "queue_depth",
+    "tol",
+    # supervisor fault/resume events (supervisor/supervisor.py)
+    "backend",
+    "iteration",
+    "recovery_overhead_s",
+    "t",
+}
+
+# ``X.write(json.dumps(...))`` record emission points that must stamp:
+# every JSONL stream a consumer merges needs schema_version/ts/t_mono.
+# (Chrome-trace and metric-snapshot files use ``json.dump(obj, fh)`` and
+# are whole-file JSON, not JSONL records — the pattern doesn't match
+# them, by design.)

@@ -1,0 +1,154 @@
+"""Lock-discipline rule: the ``# guarded-by:`` annotation convention.
+
+The serve dispatcher is a three-thread pipeline (scheduler → pack →
+solve) sharing mutable state with submitters and introspection calls;
+the metrics registry, tracer, and JSONL logger are written from all of
+them. The repo's convention makes each shared attribute's lock explicit
+at its birthplace:
+
+    def __init__(self):
+        self._results = []      # guarded-by: _lock
+        self._wake = threading.Condition(self._lock)
+
+and this rule verifies, lexically, that every later read or write of an
+annotated attribute happens inside ``with self.<lock>`` (or a
+``threading.Condition`` the checker saw constructed over that lock —
+entering the condition acquires it). Methods whose *callers* hold the
+lock declare it on the def line:
+
+    def _is_idle(self):  # holds: _lock
+
+``__init__`` is exempt: construction happens-before publication.
+
+The static check is lexical by design — it cannot see cross-function
+lock flow, which is why it pairs with the *dynamic* lock-order recorder
+(analysis/lockorder.py): tests wrap the live locks, drain a real
+3-thread service, and assert the acquisition graph stays acyclic. The
+static rule catches unguarded access; the recorder catches ordering
+inversions between guards the static rule approved.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from distributedlpsolver_tpu.analysis.core import FileContext, Finding, rule
+
+_GUARDED = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_HOLDS = re.compile(r"#\s*holds:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _self_attr(node: ast.AST) -> str:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _collect_annotations(ctx: FileContext, init: ast.FunctionDef):
+    """(guards, aliases) from a class's __init__: guards maps attr ->
+    lock attr; aliases maps condition attr -> underlying lock attr
+    (``self.C = threading.Condition(self.L)``)."""
+    guards: Dict[str, str] = {}
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(init):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        attrs = [a for a in (_self_attr(t) for t in targets) if a]
+        if not attrs:
+            continue
+        m = _GUARDED.search(ctx.line(node.lineno))
+        if m:
+            for a in attrs:
+                guards[a] = m.group(1)
+        value = node.value
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "Condition"
+            and value.args
+        ):
+            base = _self_attr(value.args[0])
+            if base:
+                for a in attrs:
+                    aliases[a] = base
+    return guards, aliases
+
+
+def _held_locks(ctx: FileContext, node: ast.AST, aliases: Dict[str, str]) -> Set[str]:
+    """Lock attrs lexically held at ``node``: enclosing ``with
+    self.<lock>`` items (conditions resolved through aliases) plus any
+    ``# holds:`` annotation on an enclosing def."""
+    held: Set[str] = set()
+    chain = [node] + list(ctx.ancestors(node))
+    for anc in chain:
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                a = _self_attr(item.context_expr)
+                if a:
+                    held.add(aliases.get(a, a))
+        elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for line in range(anc.lineno, anc.body[0].lineno):
+                m = _HOLDS.search(ctx.line(line))
+                if m:
+                    lock = m.group(1)
+                    held.add(aliases.get(lock, lock))
+    return held
+
+
+@rule(
+    "guarded-by",
+    "annotated shared attributes accessed only under their lock",
+)
+def check_guarded_by(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        init = next(
+            (
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+            ),
+            None,
+        )
+        if init is None:
+            continue
+        guards, aliases = _collect_annotations(ctx, init)
+        if not guards:
+            continue
+        for method in cls.body:
+            if (
+                not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef))
+                or method.name == "__init__"
+            ):
+                continue
+            for node in ast.walk(method):
+                attr = _self_attr(node)
+                if attr not in guards:
+                    continue
+                lock = guards[attr]
+                if lock in _held_locks(ctx, node, aliases):
+                    continue
+                kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+                out.append(
+                    Finding(
+                        rule="guarded-by",
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"{kind} of {cls.name}.{attr} (guarded-by "
+                            f"{lock}) outside `with self.{lock}` in "
+                            f"{method.name}()"
+                        ),
+                    )
+                )
+    return out

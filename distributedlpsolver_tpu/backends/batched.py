@@ -174,6 +174,11 @@ def _batched_phase(
         "params", "factor_dtype", "stall_window", "stall_status",
         "cg_iters", "cg_tol",
     ),
+    # The carry is consumed: drive_segments rebinds it on every segment
+    # and nothing reads the old one, so the (B, n)/(B, m, m) state
+    # buffers recycle in place instead of doubling peak device memory.
+    # A/data are loop-invariant across segments and must NOT donate.
+    donate_argnums=(2,),
 )
 def _batched_segment_jit(
     A, data, carry, it_stop, max_iter, max_refactor, reg_grow, params,

@@ -106,7 +106,9 @@ def _err_of(matvec, rmatvec, data, x, y):
     return jnp.maximum(pinf, jnp.maximum(dinf, gap))
 
 
-@functools.partial(jax.jit, static_argnames=("check_every", "restart_len"))
+@functools.partial(
+    jax.jit, static_argnames=("check_every", "restart_len", "restart_beta")
+)
 def _pdhg_solve(
     A, AT, data, x0, y0, eta, omega0, err_restart0, max_iter, tol,
     check_every=40, restart_len=2000, restart_beta=0.5,

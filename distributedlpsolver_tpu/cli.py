@@ -8,6 +8,7 @@ surface, BASELINE.json:2). Subcommands:
     solve      solve an MPS file (or a generated problem) to tolerance
     serve      async batching solve service (JSONL/MPS requests in)
     autotune   refine a serve bucket ladder from telemetry JSONL
+    check      graftcheck static-analysis suite (the tier-1 CI gate)
     backends   list registered SolverBackend names
     generate   write a generated benchmark problem to MPS
 
@@ -424,6 +425,38 @@ def cmd_report(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """graftcheck: run the repo's static-analysis suite (jit/recompile
+    hygiene, dtype discipline, lock discipline, JSONL schema) over the
+    given paths. Exit 0 iff there are no unsuppressed findings — this is
+    the tier-1 CI gate (README "Static analysis"). Pure stdlib: no jax
+    import, sub-second on CPU."""
+    import os
+
+    from distributedlpsolver_tpu import analysis
+
+    if args.list_rules:
+        for name, doc in analysis.all_rules().items():
+            print(f"{name}: {doc}")
+        return 0
+    paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"check: {p!r}: path not found", file=sys.stderr)
+            return 2
+    rules = args.rules.split(",") if args.rules else None
+    try:
+        findings = analysis.check_paths(paths, rules=rules)
+    except ValueError as e:  # unknown rule name
+        print(f"check: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(analysis.render_json(findings))
+    else:
+        print(analysis.render_text(findings, show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
 def cmd_backends(_args) -> int:
     from distributedlpsolver_tpu.backends import available_backends
 
@@ -536,6 +569,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="emit the full report as one JSON object",
     )
     ap_r.set_defaults(fn=cmd_report)
+
+    ap_c = sub.add_parser(
+        "check",
+        help="graftcheck static-analysis suite: jit/recompile hygiene, "
+        "dtype discipline, lock discipline, JSONL schema — the tier-1 "
+        "CI gate (README 'Static analysis')",
+    )
+    ap_c.add_argument(
+        "paths", nargs="*",
+        help="files/directories to check (default: the installed "
+        "distributedlpsolver_tpu package)",
+    )
+    ap_c.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings (the gate's artifact format)",
+    )
+    ap_c.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule subset (see --list-rules)",
+    )
+    ap_c.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    ap_c.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print findings silenced by graftcheck directives",
+    )
+    ap_c.set_defaults(fn=cmd_check)
 
     ap_b = sub.add_parser("backends", help="list registered backends")
     ap_b.set_defaults(fn=cmd_backends)

@@ -671,7 +671,7 @@ def fused_solve(
     state, it, reg, _, status, buf, _, since = carry
     if finalize:
         stalled = (
-            (since > stall_window) if stall_window else jnp.asarray(False)
+            (since > stall_window) if stall_window else jnp.asarray(False, bool)
         )
         status = jnp.where(
             status == STATUS_RUNNING,
@@ -808,7 +808,10 @@ def segment_phase_reset(carry, reg0):
         import jax
         import jax.numpy as jnp
 
-        @jax.jit
+        # Cached in the module-level _PHASE_RESET_JIT slot: the wrapper
+        # is built ONCE (core.py keeps jax out of its import path), so
+        # this is a hoist in disguise, not a per-call jit.
+        @jax.jit  # graftcheck: disable=jit-nonhoisted (cached lazy init)
         def _reset(carry, reg0):
             st, it, _, _, _, buf, _, _ = carry
             z = jnp.asarray(0, jnp.int32)

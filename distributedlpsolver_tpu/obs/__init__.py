@@ -39,6 +39,35 @@ readers stay backward-compatible with unstamped pre-stamp files.
 # with a missing or older version (pre-stamp files have none).
 SCHEMA_VERSION = 1
 
+import threading  # noqa: E402
+
+
+class DefaultSlot:
+    """The one module-default holder metrics and trace both use (they
+    each grew an identical ``_default`` + ``_default_lock`` pair; this
+    is the shared shape). ``set`` installs a new default and returns the
+    previous one so callers can restore it (tests, scoped CLI runs);
+    ``None`` restores the null instance. ``get`` is deliberately
+    lockless — the default is resolved on hot paths and a torn read is
+    impossible for a single reference."""
+
+    def __init__(self, null):
+        self._null = null
+        self._lock = threading.Lock()
+        self._value = null
+
+    def get(self):
+        return self._value
+
+    def set(self, value):
+        with self._lock:
+            prev = self._value
+            self._value = value if value is not None else self._null
+        return prev
+
+
+# NOTE: DefaultSlot must be defined ABOVE these imports — metrics and
+# trace import it from the partially-initialized package.
 from distributedlpsolver_tpu.obs.metrics import (  # noqa: E402
     MetricsRegistry,
     NULL as NULL_REGISTRY,
@@ -58,6 +87,7 @@ from distributedlpsolver_tpu.obs.trace import (  # noqa: E402
 
 __all__ = [
     "SCHEMA_VERSION",
+    "DefaultSlot",
     "MetricsRegistry",
     "NULL_REGISTRY",
     "NULL_TRACER",

@@ -201,9 +201,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._instruments: Dict[_Key, object] = {}
-        self._kinds: Dict[str, str] = {}
-        self._help: Dict[str, str] = {}
+        self._instruments: Dict[_Key, object] = {}  # guarded-by: _lock
+        self._kinds: Dict[str, str] = {}  # guarded-by: _lock
+        self._help: Dict[str, str] = {}  # guarded-by: _lock
 
     def _get(self, kind: str, name: str, labels, help_, factory):
         key = _key(name, labels)
@@ -345,20 +345,17 @@ NULL = NullRegistry()
 # test) installs a real registry. Components resolve it at construction
 # time, so a registry installed after a service started does not
 # retroactively instrument it.
-_default: MetricsRegistry = NULL
-_default_lock = threading.Lock()
+from distributedlpsolver_tpu.obs import DefaultSlot  # noqa: E402
+
+_DEFAULT = DefaultSlot(NULL)
 
 
 def get_registry() -> MetricsRegistry:
-    return _default
+    return _DEFAULT.get()
 
 
 def set_registry(registry: Optional[MetricsRegistry]) -> MetricsRegistry:
     """Install ``registry`` as the module default (None restores the
     no-op NULL). Returns the previous default so callers can restore it
     (tests, scoped CLI runs)."""
-    global _default
-    with _default_lock:
-        prev = _default
-        _default = registry if registry is not None else NULL
-    return prev
+    return _DEFAULT.set(registry)

@@ -53,10 +53,10 @@ class Tracer:
     def __init__(self, path: str, process_name: str = "distributedlpsolver"):
         self.path = path
         self._lock = threading.Lock()
-        self._events: list = []
-        self._named_threads: set = set()
-        self._dropped = 0
-        self._closed = False
+        self._events: list = []  # guarded-by: _lock
+        self._named_threads: set = set()  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         self._events.append(
             {
                 "ph": "M", "name": "process_name", "pid": 1, "tid": 0,
@@ -221,19 +221,16 @@ class _NullTracer:
 _NULL_CONTEXT = contextlib.nullcontext()
 NULL_TRACER = _NullTracer()
 
-_default = NULL_TRACER
-_default_lock = threading.Lock()
+from distributedlpsolver_tpu.obs import DefaultSlot  # noqa: E402
+
+_DEFAULT = DefaultSlot(NULL_TRACER)
 
 
 def get_tracer():
-    return _default
+    return _DEFAULT.get()
 
 
 def set_tracer(tracer) -> object:
     """Install ``tracer`` as the module default (None restores the no-op
     tracer); returns the previous default for scoped restore."""
-    global _default
-    with _default_lock:
-        prev = _default
-        _default = tracer if tracer is not None else NULL_TRACER
-    return prev
+    return _DEFAULT.set(tracer)

@@ -142,6 +142,14 @@ def _run_under_deadline(fn, deadline: float) -> bool:
     return (not t.is_alive()) and ("error" not in box)
 
 
+@jax.jit
+def _ping_sum_sq(v):
+    # Module-level wrapper: one compile per (shape, device placement),
+    # reused across every probe of that device — a per-call jit would
+    # re-trace on each health check.
+    return (v * v).sum()
+
+
 def probe_device(device, deadline: float = 2.0) -> bool:
     """One device's health: place a tiny buffer and run a jitted
     reduction on it under ``deadline`` seconds of wall clock. A device
@@ -152,7 +160,7 @@ def probe_device(device, deadline: float = 2.0) -> bool:
 
     def _ping():
         buf = jax.device_put(np.arange(4, dtype=np.float32), device)
-        out = jax.jit(lambda v: (v * v).sum())(buf)
+        out = _ping_sum_sq(buf)
         jax.block_until_ready(out)
         return out
 

@@ -274,9 +274,9 @@ class SolveService:
             "serve_padding_waste", buckets=obs_metrics.RATIO_BUCKETS,
             help="padded-entries fraction wasted per dispatch",
         )
-        self._mesh = self._build_mesh(self.config.mesh_devices)
+        self._mesh = self._build_mesh(self.config.mesh_devices)  # guarded-by: _lock
         n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
-        self.scheduler = Scheduler(
+        self.scheduler = Scheduler(  # guarded-by: _lock
             BucketTable(
                 self.config.buckets, batch=self.config.batch, devices=n_dev
             ),
@@ -290,13 +290,13 @@ class SolveService:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._idle = threading.Condition(self._lock)
-        self._results: List[RequestResult] = []
-        self._next_id = 0
-        self._dispatch_seq = 0
-        self._inflight = 0
-        self._stopping = False
-        self._warm: set = set()
-        self._compiles = 0
+        self._results: List[RequestResult] = []  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+        self._dispatch_seq = 0  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
+        self._stopping = False  # guarded-by: _lock
+        self._warm: set = set()  # guarded-by: _lock
+        self._compiles = 0  # guarded-by: _lock
         # Pipeline queues: the scheduler thread pushes popped batches, the
         # pack thread fills in device-resident arrays, the solve thread
         # dispatches. Bounds keep the pipeline two-deep so batches aren't
@@ -307,17 +307,17 @@ class SolveService:
         self._solve_q: Queue = Queue(maxsize=max(1, depth - 1))
         # Pack-interval telemetry for overlap_ms: recent completed pack
         # windows plus the start stamp of the pack currently in flight.
-        self._pack_spans: List[tuple] = []
-        self._pack_current: Optional[float] = None
+        self._pack_spans: List[tuple] = []  # guarded-by: _span_lock
+        self._pack_current: Optional[float] = None  # guarded-by: _span_lock
         self._span_lock = threading.Lock()
-        self._dispatch_rows: List[dict] = []
-        self._overlap_ms_total = 0.0
-        self._pack_ms_total = 0.0
+        self._dispatch_rows: List[dict] = []  # guarded-by: _lock
+        self._overlap_ms_total = 0.0  # guarded-by: _lock
+        self._pack_ms_total = 0.0  # guarded-by: _lock
         # Idle telemetry: how the dispatcher sleeps (satellite: the loop
         # waits exactly until Scheduler.next_event_in, surfaced here).
-        self._idle_waits = 0
-        self._idle_sleep_s = 0.0
-        self._last_idle_timeout: Optional[float] = None
+        self._idle_waits = 0  # guarded-by: _lock
+        self._idle_sleep_s = 0.0  # guarded-by: _lock
+        self._last_idle_timeout: Optional[float] = None  # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
         self._pack_thread: Optional[threading.Thread] = None
         self._solve_thread: Optional[threading.Thread] = None
@@ -346,7 +346,8 @@ class SolveService:
     @property
     def mesh_devices(self) -> int:
         """Devices the batch axis is currently sharded over (1 = unsharded)."""
-        mesh = self._mesh
+        with self._lock:
+            mesh = self._mesh
         return int(mesh.devices.size) if mesh is not None else 1
 
     @staticmethod
@@ -379,9 +380,9 @@ class SolveService:
     def __exit__(self, *exc) -> None:
         self.shutdown()
 
-    def _is_idle(self) -> bool:
-        # Requires self._lock. _inflight covers every popped-but-unfinished
-        # request, including batches sitting in the pipeline queues.
+    def _is_idle(self) -> bool:  # holds: _lock
+        # _inflight covers every popped-but-unfinished request, including
+        # batches sitting in the pipeline queues.
         return self.scheduler.depth() == 0 and self._inflight == 0
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -584,8 +585,10 @@ class SolveService:
         for k in range(len(live), B):  # inactive slots: well-posed copies
             A[k], b[k], c[k] = A[0], b[0], c[0]
         batch = BatchedLP(c=c, A=A, b=b, name=f"bucket_{spec.m}x{spec.n}")
-        mesh = self._mesh  # snapshot: a reshard mid-pipeline only affects
-        # later packs; this bucket solves on the mesh it was placed on.
+        # Snapshot: a reshard mid-pipeline only affects later packs; this
+        # bucket solves on the mesh it was placed on.
+        with self._lock:
+            mesh = self._mesh
         placed, act = place_bucket(
             batch, active, self.solver_config.replace(tol=tol), mesh=mesh
         )
@@ -698,8 +701,9 @@ class SolveService:
         batch, active, mesh = packed.batch, packed.active, packed.mesh
         cfg = self.solver_config.replace(tol=tol)
         waste = packed.waste
-        seq = self._dispatch_seq
-        self._dispatch_seq += 1
+        with self._lock:
+            seq = self._dispatch_seq
+            self._dispatch_seq += 1
 
         warm_key = (spec.key(), tol, cfg.dtype, self._mesh_key(mesh))
         compile_ms = 0.0
@@ -723,7 +727,9 @@ class SolveService:
                 # any other dispatch fault rather than escaping. Keyed
                 # per (bucket, tol, dtype, mesh): a re-formed mesh
                 # legitimately compiles once more.
-                if warm_key not in self._warm:
+                with self._lock:
+                    cold = warm_key not in self._warm
+                if cold:
                     size0 = bucket_cache_size()
                     t0 = time.perf_counter()
                     with self.tracer.span(
@@ -734,10 +740,10 @@ class SolveService:
                             batch, active, cfg, mesh=mesh, max_iter=1
                         )
                     compile_ms = (time.perf_counter() - t0) * 1e3
-                    self._warm.add(warm_key)
                     new_programs = bucket_cache_size() - size0
                     self._m_compiles.inc(new_programs)
                     with self._lock:
+                        self._warm.add(warm_key)
                         self._compiles += new_programs
 
                 def _solve():
@@ -877,13 +883,15 @@ class SolveService:
                     status=status,
                     # Real-column objective: pad rows pin their pad
                     # columns at cost 1 each, so the padded pobj is
-                    # offset — recompute on the request's own c.
-                    objective=float(p.c @ x_real),
+                    # offset — recompute on the request's own c. These
+                    # float() reads are the sanctioned demux point:
+                    # solve_bucket already synchronized, res is host-side.
+                    objective=float(p.c @ x_real),  # graftcheck: disable=host-sync (demux)
                     x=x_real,
                     iterations=int(res.iterations[k]),
-                    rel_gap=float(res.rel_gap[k]),
-                    pinf=float(res.pinf[k]),
-                    dinf=float(res.dinf[k]),
+                    rel_gap=float(res.rel_gap[k]),  # graftcheck: disable=host-sync (demux)
+                    pinf=float(res.pinf[k]),  # graftcheck: disable=host-sync (demux)
+                    dinf=float(res.dinf[k]),  # graftcheck: disable=host-sync (demux)
                     bucket=spec.key(),
                     queue_ms=(t_dispatch - p.t_submit) * 1e3,
                     compile_ms=compile_ms,
@@ -1072,11 +1080,13 @@ class SolveService:
         in-flight and future dispatches stay shardable; at 1 the mesh is
         dropped and dispatch continues unsharded. Batches already packed
         on the old mesh finish there. Returns the new device count."""
-        if self._mesh is None:
+        with self._lock:
+            mesh = self._mesh
+        if mesh is None:
             return 1
         from distributedlpsolver_tpu.parallel import mesh as mesh_lib
 
-        new = mesh_lib.reform_mesh(self._mesh, exclude=exclude, axis_name="batch")
+        new = mesh_lib.reform_mesh(mesh, exclude=exclude, axis_name="batch")
         survivors = list(new.devices.flat)
         with self._lock:
             table = self.scheduler.table
@@ -1177,11 +1187,14 @@ class SolveService:
 
         tol = self.solver_config.tol if tol is None else tol
         cfg = self.solver_config.replace(tol=tol)
-        mesh = self._mesh
+        with self._lock:
+            mesh = self._mesh
         warmed = 0
         for spec in specs:
             wk = (spec.key(), tol, cfg.dtype, self._mesh_key(mesh))
-            if wk in self._warm:
+            with self._lock:
+                already = wk in self._warm
+            if already:
                 continue
             # A feasible+bounded random batch at the exact bucket shape:
             # max_iter is traced, so this max_iter=1 call compiles the
@@ -1205,11 +1218,11 @@ class SolveService:
                     }
                 )
                 continue
-            self._warm.add(wk)
             warmed += 1
             new_programs = bucket_cache_size() - size0
             self._m_compiles.inc(new_programs)
             with self._lock:
+                self._warm.add(wk)
                 self._compiles += new_programs
             self._logger.event(
                 {
@@ -1235,8 +1248,11 @@ class SolveService:
             results = list(self._results)
             depth = self.scheduler.depth()
             occupancy = self.scheduler.occupancy()
+            dispatches = self._dispatch_seq
+            compiles = self._compiles
             overlap_total = self._overlap_ms_total
             pack_total = self._pack_ms_total
+            buckets = [list(s.key()) for s in self.scheduler.table.specs()]
             idle = {
                 "waits": self._idle_waits,
                 "sleep_s": round(self._idle_sleep_s, 3),
@@ -1250,13 +1266,11 @@ class SolveService:
             **latency_summary(results),
             "queue_depth": depth,
             "occupancy": occupancy,
-            "dispatches": self._dispatch_seq,
-            "programs_compiled": self._compiles,
+            "dispatches": dispatches,
+            "programs_compiled": compiles,
             "mesh_devices": self.mesh_devices,
             "pack_ms_total": round(pack_total, 3),
             "overlap_ms_total": round(overlap_total, 3),
             "idle": idle,
-            "buckets": [
-                list(s.key()) for s in self.scheduler.table.specs()
-            ],
+            "buckets": buckets,
         }

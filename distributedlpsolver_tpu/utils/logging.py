@@ -66,7 +66,7 @@ class IterLogger:
         # handles write whole flushed lines at the file end.
         self.verbose = verbose
         mode = "a" if append else "w"
-        self._fh: Optional[TextIO] = (
+        self._fh: Optional[TextIO] = (  # guarded-by: _lock
             open(jsonl_path, mode) if jsonl_path else None
         )
         self._fsync = fsync

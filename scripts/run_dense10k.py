@@ -11,6 +11,14 @@ import time
 
 sys.path.insert(0, "/root/repo")
 
+# Measurement envelope: `--require-tpu` aborts (exit 4) instead of
+# silently measuring host CPU when the accelerator is missing (the
+# BENCH_r05 failure class).
+from distributedlpsolver_tpu.utils.accel import require_tpu
+
+require_tpu("--require-tpu" in sys.argv)
+sys.argv = [a for a in sys.argv if a != "--require-tpu"]
+
 m, n = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 else (10000, 50000)
 max_iter = int(sys.argv[3]) if len(sys.argv) > 3 else 200
 # CG sweep cap: one PCG-phase Mehrotra iteration is ONE device program

@@ -10,6 +10,14 @@ Writes /root/repo/.neos3_sparse.json.
 import json, resource, sys, time
 
 sys.path.insert(0, "/root/repo")
+
+# Measurement envelope: `--require-tpu` aborts (exit 4) instead of
+# silently measuring host CPU when the accelerator is missing (the
+# BENCH_r05 failure class).
+from distributedlpsolver_tpu.utils.accel import require_tpu
+
+require_tpu("--require-tpu" in sys.argv)
+sys.argv = [a for a in sys.argv if a != "--require-tpu"]
 import numpy as np
 
 m, n, density = 20000, 40000, 0.0005

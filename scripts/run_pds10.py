@@ -22,6 +22,14 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+# Measurement envelope: `--require-tpu` aborts (exit 4) instead of
+# silently measuring host CPU when the accelerator is missing (the
+# BENCH_r05 failure class).
+from distributedlpsolver_tpu.utils.accel import require_tpu
+
+require_tpu("--require-tpu" in sys.argv)
+sys.argv = [a for a in sys.argv if a != "--require-tpu"]
+
 mode = sys.argv[1] if len(sys.argv) > 1 else "tpu"
 if mode == "cpu":
     import jax

@@ -17,6 +17,14 @@ import time
 
 sys.path.insert(0, "/root/repo")
 
+# Measurement envelope: `--require-tpu` aborts (exit 4) instead of
+# silently measuring host CPU when the accelerator is missing (the
+# BENCH_r05 failure class).
+from distributedlpsolver_tpu.utils.accel import require_tpu
+
+require_tpu("--require-tpu" in sys.argv)
+sys.argv = [a for a in sys.argv if a != "--require-tpu"]
+
 on_mesh = len(sys.argv) > 1 and sys.argv[1] == "mesh"
 if on_mesh:
     import jax

@@ -15,6 +15,14 @@ import time
 
 sys.path.insert(0, "/root/repo")
 
+# Measurement envelope: `--require-tpu` aborts (exit 4) instead of
+# silently measuring host CPU when the accelerator is missing (the
+# BENCH_r05 failure class).
+from distributedlpsolver_tpu.utils.accel import require_tpu
+
+require_tpu("--require-tpu" in sys.argv)
+sys.argv = [a for a in sys.argv if a != "--require-tpu"]
+
 K, mb, nb, link = (
     (int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     if len(sys.argv) > 4 else (256, 80, 160, 48)

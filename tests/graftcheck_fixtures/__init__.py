@@ -1,0 +1,4 @@
+# Seeded-violation fixtures for the graftcheck analyzer tests. These
+# modules are parsed by the checker, never imported — each fx_*_bad.py
+# seeds exactly the violations its test expects, and each fx_*_clean.py
+# is the compliant twin that must stay silent.

@@ -1,0 +1,15 @@
+# Seeds: dtype-explicit x3 (constructor, literal asarray, full) and
+# dtype-narrow x2. Checked with pkg_path="ipm/fx.py" (in scope, not a
+# sanctioned narrowing module).
+import jax.numpy as jnp
+
+f32 = jnp.float32
+
+
+def build(x):
+    a = jnp.zeros((4, 4))  # dtype-explicit
+    b = jnp.asarray(0.5)  # dtype-explicit (literal mints the dtype)
+    c = jnp.full((2,), 1.0)  # dtype-explicit
+    d = x.astype(jnp.float32)  # dtype-narrow
+    e = x.astype(f32)  # dtype-narrow
+    return a, b, c, d, e

@@ -1,0 +1,14 @@
+# Compliant twin of fx_dtype_bad: dtypes pinned (kwarg or the repo's
+# positional short form), passthrough asarray of an array value, index
+# arange, and narrowing absent. Same pkg_path="ipm/fx.py".
+import jax.numpy as jnp
+
+
+def build(x, dt):
+    a = jnp.zeros((4, 4), jnp.float64)
+    b = jnp.asarray(0.5, dtype=dt)
+    c = jnp.full((2,), 1.0, dt)
+    d = jnp.asarray(x)  # passthrough: inherits x.dtype, exempt
+    e = jnp.arange(4)  # index arithmetic, exempt by convention
+    f = x.astype(jnp.float64)  # widening is never flagged
+    return a, b, c, d, e, f

@@ -1,0 +1,23 @@
+# Seeds: host-sync x4 (float / .item / np.asarray / block_until_ready),
+# one of them inside a nested closure. Checked with
+# pkg_path="serve/service.py" so the SolveService hot scopes apply.
+import jax
+import numpy as np
+
+
+class SolveService:
+    def _run_solve(self, res, k):
+        v = float(res[k])  # host-sync
+        w = res.item()  # host-sync
+        return v + w
+
+    def _pack_bucket(self, batch):
+        jax.block_until_ready(batch)  # host-sync
+
+        def helper():
+            return np.asarray(batch)  # host-sync (closure on hot thread)
+
+        return helper()
+
+    def cold_path(self, res):
+        return float(res)  # not a hot scope: silent

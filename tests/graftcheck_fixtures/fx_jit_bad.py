@@ -1,0 +1,27 @@
+# Seeds: jit-nonhoisted (x2), jit-scalar-default, jit-donate.
+# Checked with pkg_path="backends/batched.py" so the donate catalogue
+# entry for _batched_segment_jit applies.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _batched_segment_jit(A, carry, params, scale=2.0):
+    # scale=2.0 is a traced scalar default -> jit-scalar-default
+    # missing donate_argnums -> jit-donate
+    return carry * scale
+
+
+def per_call_wrapper(v):
+    # a fresh jit per call -> jit-nonhoisted
+    return jax.jit(lambda x: (x * x).sum())(v)
+
+
+def nested_decorator(v):
+    @jax.jit  # defined per call of nested_decorator -> jit-nonhoisted
+    def inner(x):
+        return x + 1
+
+    return inner(v)

@@ -1,0 +1,22 @@
+# Compliant twin of fx_jit_bad: module-level wrappers, statics declared,
+# donate_argnums present on the catalogued program.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "scale"), donate_argnums=(1,)
+)
+def _batched_segment_jit(A, carry, params, scale=2.0):
+    return carry * scale
+
+
+@jax.jit
+def _sum_sq(x):
+    return (x * x).sum()
+
+
+def per_call_wrapper(v):
+    return _sum_sq(v)

@@ -1,0 +1,21 @@
+# Seeds: guarded-by x3 (unguarded read, unguarded write, wrong lock).
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._span_lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._results = []  # guarded-by: _lock
+        self._spans = []  # guarded-by: _span_lock
+
+    def unguarded_read(self):
+        return len(self._results)  # guarded-by violation
+
+    def unguarded_write(self, r):
+        self._results = list(r)  # guarded-by violation (store)
+
+    def wrong_lock(self):
+        with self._span_lock:
+            return list(self._results)  # guarded-by violation

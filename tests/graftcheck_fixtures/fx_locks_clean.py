@@ -1,0 +1,28 @@
+# Compliant twin of fx_locks_bad: direct lock, condition alias, and the
+# caller-holds annotation all satisfy the rule; __init__ is exempt.
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._span_lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._results = []  # guarded-by: _lock
+        self._spans = []  # guarded-by: _span_lock
+        self._results.append("init")  # construction happens-before
+
+    def direct(self, r):
+        with self._lock:
+            self._results.append(r)
+
+    def via_condition(self):
+        with self._wake:
+            return len(self._results)
+
+    def caller_holds(self):  # holds: _lock
+        return list(self._results)
+
+    def spans(self):
+        with self._span_lock:
+            return list(self._spans)

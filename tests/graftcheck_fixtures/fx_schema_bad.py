@@ -1,0 +1,15 @@
+# Seeds: jsonl-fields x2 (stray field, unknown event type) and
+# jsonl-stamp (record written without stamp_record).
+import json
+
+
+def emit(logger, out, rec):
+    logger.event(
+        {
+            "event": "request",
+            "id": 1,
+            "bogus_field": True,  # jsonl-fields: not catalogued
+        }
+    )
+    logger.event({"event": "totally_new_event"})  # jsonl-fields: type
+    out.write(json.dumps(rec) + "\n")  # jsonl-stamp: unstamped

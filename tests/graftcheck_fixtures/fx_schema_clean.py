@@ -1,0 +1,17 @@
+# Compliant twin of fx_schema_bad: catalogued fields/types only, and the
+# out-stream write routes through stamp_record.
+import json
+
+from distributedlpsolver_tpu.utils.logging import stamp_record
+
+
+def emit(logger, out, rec):
+    logger.event(
+        {
+            "event": "request",
+            "id": 1,
+            "status": "optimal",
+            "queue_ms": 0.5,
+        }
+    )
+    out.write(json.dumps(stamp_record(rec)) + "\n")
