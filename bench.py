@@ -409,6 +409,14 @@ def _bench_serve(quick: bool) -> dict:
         "warm_recompiles": int(warm_recompiles),
         "overlap_ms_total": stats["overlap_ms_total"],
         "buckets": stats["buckets"],
+        # Mixed-precision attribution: which precision schedule the
+        # bucket programs ran (per-phase iteration totals by engine) and
+        # how many IPM iterations each device while-trip fused — future
+        # BENCH rows can attribute serving wins to the df32/fused-k
+        # levers instead of guessing.
+        "schedule": stats["schedule"],
+        "phase_iters": stats["phase_iters"],
+        "fused_iters": stats["fused_iters"],
         "tol": 1e-8,
         "vs_baseline": None,
     }
@@ -416,7 +424,9 @@ def _bench_serve(quick: bool) -> dict:
         f"  serve: {n} requests at {row['rps']} rps warm, "
         f"p50={row['latency_ms_p50']:.0f}ms p99={row['latency_ms_p99']:.0f}ms, "
         f"waste={row['mean_padding_waste']:.2f}, "
-        f"warm recompiles={warm_recompiles}"
+        f"warm recompiles={warm_recompiles}, "
+        f"schedule={row['schedule']} (phase iters {row['phase_iters']}), "
+        f"fused_iters={row['fused_iters']}"
     )
     return row
 

@@ -32,13 +32,16 @@ HOT_SCOPES = {
 # Programs whose big per-call buffers are consumed by the call and dead
 # afterwards; their jit definitions must carry donate_argnums so the
 # device reuses the buffers in place. NOT in this table (deliberately):
-# the serve bucket programs (_solve_bucket_jit) — their inputs are
+# the fused bucket program's INPUTS (_solve_bucket_jit) — they are
 # re-dispatched verbatim on batch retry and shared with warm-up calls,
 # so donating them would poison the retry path; and A/data of the
-# segment program, which are loop-invariant across segments.
+# segment programs, which are loop-invariant across segments. The bucket
+# SEGMENT carry (_bucket_segment_jit) is internal to one dispatch and
+# rebound per segment, so it donates like the batched one.
 DONATE_EXPECTED = {
     # (pkg_path, function name) -> human description of the donated arg
     ("backends/batched.py", "_batched_segment_jit"): "carry (arg 2)",
+    ("backends/batched.py", "_bucket_segment_jit"): "carry (arg 2)",
     ("backends/dense.py", "_eg_scale_reg"): "M (arg 0)",
 }
 
@@ -70,6 +73,7 @@ DTYPE_CONSTRUCTORS = {
 # two-phase design never sanctioned.
 NARROW_SANCTIONED = {
     "ops/chol_mxu.py",
+    "ops/df32.py",  # the two-float layer: every df32 narrowing lives there
     "ops/normal_eq.py",
     "backends/dense.py",
     "backends/block_angular.py",
@@ -144,9 +148,11 @@ JSONL_FIELDS = {
     "action",
     "attempts",
     "buckets",
+    "cache",
     "detail",
     "devices",
     "excluded",
+    "fused_iters",
     "kind",
     "live",
     "mesh_devices",
@@ -155,6 +161,7 @@ JSONL_FIELDS = {
     "misfits",
     "occupancy",
     "queue_depth",
+    "schedule",
     "tol",
     # supervisor fault/resume events (supervisor/supervisor.py)
     "backend",

@@ -59,8 +59,13 @@ class BatchedResult:
     solve_time: float = 0.0
     setup_time: float = 0.0
     # Per-phase iters/wall rows (segmented path; per CHUNK when chunked) —
-    # the utilization split the scale artifacts record.
+    # the utilization split the scale artifacts record. Bucket solves
+    # fill it with their precision-schedule rows
+    # ({"phase", "engine", "tol", "iters"}) instead.
     phase_report: Optional[list] = None
+    # Iterations fused per while-loop trip of the device loop (the
+    # serve telemetry's fused-iterations-per-dispatch figure).
+    fused_iters: int = 1
 
     @property
     def n_optimal(self) -> int:
@@ -87,7 +92,7 @@ def _single_start(A, data, reg, params, factor_dtype, Af=None):
 def _batched_phase(
     A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
     it_stop=None, stall_window=0, stall_status=_RUNNING, A32=None,
-    cg_iters=0, cg_tol=0.0,
+    cg_iters=0, cg_tol=0.0, fuse_iters=1,
 ):
     """One masked batched IPM while_loop phase over the whole batch.
 
@@ -100,15 +105,26 @@ def _batched_phase(
     this, f32-stalled problems grind the whole max_iter budget).
     ``it_stop`` (traced) additionally bounds this call for host
     segmentation (core.drive_segments' watchdog guard).
+
+    ``fuse_iters`` (static) > 1 fuses that many masked micro-steps into
+    ONE while-loop trip via an inner ``fori_loop``: each micro-step
+    re-evaluates the loop guard itself and commits its writes only under
+    it, so results are bitwise-identical in k while the while predicate
+    — the only cross-device collective of a mesh-sharded batch — and the
+    loop bookkeeping run k× less often. At most k−1 guarded no-op steps
+    are wasted where a block straddles the finish.
     """
     B = A.shape[0]
 
-    def cond(carry):
-        _, active, it, *_ = carry
+    def guard(active, it):
         go = jnp.any(active) & (it < max_iter)
         if it_stop is not None:
             go = go & (it < it_stop)
         return go
+
+    def cond(carry):
+        _, active, it, *_ = carry
+        return guard(active, it)
 
     def body(carry):
         states, active, it, regs, badcount, status, iters, best, since = carry
@@ -165,6 +181,22 @@ def _batched_phase(
         active = active & ~newly_opt & ~give_up & ~stalled
         return states, active, it + 1, regs, badcount, status, iters, best, since
 
+    if fuse_iters > 1:
+        def micro(carry):
+            # The while cond admits the whole k-block; each micro-step
+            # re-checks the same guard on its own carry and commits only
+            # under it — the guarded tail steps are exact no-ops, so the
+            # fused loop's accepted-state sequence matches k=1 bitwise.
+            go = guard(carry[1], carry[2])
+            new = body(carry)
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(go, n, o), new, carry
+            )
+
+        fused_body = lambda c: jax.lax.fori_loop(
+            0, fuse_iters, lambda _, cc: micro(cc), c
+        )
+        return jax.lax.while_loop(cond, fused_body, carry)
     return jax.lax.while_loop(cond, body, carry)
 
 
@@ -172,7 +204,7 @@ def _batched_phase(
     jax.jit,
     static_argnames=(
         "params", "factor_dtype", "stall_window", "stall_status",
-        "cg_iters", "cg_tol",
+        "cg_iters", "cg_tol", "fuse_iters",
     ),
     # The carry is consumed: drive_segments rebinds it on every segment
     # and nothing reads the old one, so the (B, n)/(B, m, m) state
@@ -183,12 +215,12 @@ def _batched_phase(
 def _batched_segment_jit(
     A, data, carry, it_stop, max_iter, max_refactor, reg_grow, params,
     factor_dtype, stall_window=0, stall_status=_RUNNING, A32=None,
-    cg_iters=0, cg_tol=0.0,
+    cg_iters=0, cg_tol=0.0, fuse_iters=1,
 ):
     out = _batched_phase(
         A, data, carry, params, max_iter, max_refactor, reg_grow,
         jnp.dtype(factor_dtype), it_stop, stall_window, stall_status, A32,
-        cg_iters, cg_tol,
+        cg_iters, cg_tol, fuse_iters,
     )
     # Packed [it, status, n_active, n_unfinished] in core.drive_segments'
     # meta layout (one device→host transfer per segment — separate scalar
@@ -228,12 +260,13 @@ def _batched_start_jit(A, data, reg0, params, factor_dtype):
     jax.jit,
     static_argnames=(
         "params", "params_p1", "factor_dtype", "two_phase", "stall_window",
-        "cg_iters", "cg_tol",
+        "cg_iters", "cg_tol", "fuse_iters",
     ),
 )
 def _solve_batched_jit(
     A, data, reg0, params, params_p1, max_iter, max_refactor, reg_grow,
     factor_dtype, two_phase, stall_window=0, cg_iters=0, cg_tol=0.0,
+    fuse_iters=1,
 ):
     # max_iter / max_refactor / reg_grow are traced scalars so one compile
     # serves every iteration-limit config (warm-up shares the timed compile).
@@ -267,6 +300,7 @@ def _solve_batched_jit(
         carry = _batched_phase(
             A, data, carry, params_p1, max_iter, max_refactor, reg_grow,
             jnp.dtype(jnp.float32), None, stall_window, _RUNNING, A32,
+            fuse_iters=fuse_iters,
         )
         # keep states + per-problem iters; reset provisional verdicts
         carry = _fresh_batch_carry(carry[0], carry[6], B, reg0, dtype)
@@ -278,7 +312,7 @@ def _solve_batched_jit(
         carry = _batched_phase(
             A, data, carry, params, max_iter, max_refactor, reg_grow,
             jnp.dtype(jnp.float32), None, stall_window, _RUNNING, A32,
-            cg_iters, cg_tol,
+            cg_iters, cg_tol, fuse_iters,
         )
         carry = _fresh_batch_carry(
             carry[0], carry[6], B, reg0, dtype, status=carry[5]
@@ -286,7 +320,7 @@ def _solve_batched_jit(
     states, active, _, _, _, status, iters, _, _ = _batched_phase(
         A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
         None, 2 * stall_window if stall_window else 0, _STALL,
-        A32 if fdt == f32 else None,
+        A32 if fdt == f32 else None, fuse_iters=fuse_iters,
     )
     status = jnp.where(status == _RUNNING, _MAXITER, status)
 
@@ -450,7 +484,7 @@ def _scatter_out(outs, order, carry):
 
 def _solve_batched_segmented(
     A, data, cfg, params, params_p1, fname, two_phase, seg, cg=(0, 0.0),
-    compact_ok=False,
+    compact_ok=False, fuse_iters=1,
 ):
     """Host-segmented batched solve: same phases as _solve_batched_jit but
     each device program is bounded to ~15s (execution-watchdog guard —
@@ -556,7 +590,7 @@ def _solve_batched_segmented(
                 return _batched_segment_jit(
                     Ax, dx, c, jnp.asarray(stop, jnp.int32), mi, mr,
                     rg.astype(Ax.dtype), pp, ff, w, ws, A32x, ci,
-                    cgt if ci else 0.0,
+                    cgt if ci else 0.0, fuse_iters,
                 )
 
             return run_seg
@@ -697,17 +731,45 @@ def _drive_compacting(
 # program per bucket shape, reused verbatim across service dispatches.
 
 
+def _bucket_phase_carry(states, iters, B, reg0, dtype, active0, status=None):
+    """Bucket phase-entry carry: :func:`_fresh_batch_carry` with the
+    padding mask re-applied — padding slots are inactive and report a
+    placeholder _OPTIMAL in EVERY schedule phase, not just the first
+    (the all-settled loop predicate and the demux logic treat them as
+    finished; serve/service.py demuxes by slot index, so a padding
+    verdict is never read)."""
+    c = _fresh_batch_carry(states, iters, B, reg0, dtype, status=status)
+    states, active, it, regs, bad, st, iters, best, since = c
+    return (
+        states,
+        active & active0,
+        it,
+        regs,
+        bad,
+        jnp.where(active0, st, _OPTIMAL),
+        iters,
+        best,
+        since,
+    )
+
+
 @functools.partial(
-    jax.jit, static_argnames=("params", "factor_dtype", "stall_window")
+    jax.jit,
+    static_argnames=("schedule", "factor_dtype", "stall_window", "fuse_iters"),
 )
 def _solve_bucket_jit(
-    A, data, active0, reg0, max_iter, max_refactor, reg_grow, params,
-    factor_dtype, stall_window,
+    A, data, active0, reg0, max_iter, max_refactor, reg_grow, schedule,
+    factor_dtype, stall_window, fuse_iters=1,
 ):
-    # Single-phase schedule on purpose: serving members sit far below
-    # _PHASED_MEMBER_ENTRIES (the phased schedules are a large-member
-    # optimization that LOSES at bucket shapes — see the measurements
-    # there), and one phase means one program per bucket. max_iter /
+    # ``schedule`` is the static per-tolerance-tier precision ladder from
+    # SolverConfig.bucket_phases — a tuple of (engine, StepParams) pairs,
+    # sequenced as masked phases INSIDE this one program, so one compiled
+    # executable still serves every dispatch of a (bucket, tol) pair.
+    # The legacy behavior is the single-phase ("f64", params) schedule.
+    # Serving members sit far below _PHASED_MEMBER_ENTRIES, where the
+    # LARGE-member schedules (PCG, all-f32 state) lose; the df32 ladder
+    # is different — it attacks the elementwise emulation tax, which IS
+    # the wall at bucket shapes (ROUND5_NOTES lever 3). max_iter /
     # max_refactor / reg_grow are traced so per-request iteration budgets
     # never fork the compile cache; ``active0`` masks padding slots
     # inactive from iteration 0 — the same machinery that freezes
@@ -715,31 +777,45 @@ def _solve_bucket_jit(
     fdt = jnp.dtype(factor_dtype)
     B = A.shape[0]
     dtype = A.dtype
+    # Starting point at the RESOLVED factor dtype regardless of an f32
+    # first phase — it is one factorization amortized over the whole
+    # solve, and an f32 least-squares start can strand an
+    # ill-conditioned member (see _solve_batched_jit).
+    start_params = schedule[-1][1]
     states0 = jax.vmap(
-        lambda a, d: _single_start(a, d, reg0, params, fdt)
+        lambda a, d: _single_start(a, d, reg0, start_params, fdt)
     )(A, data)
-    states, active, it, regs, bad, status, iters, best, since = (
-        _fresh_batch_carry(states0, jnp.zeros(B, jnp.int32), B, reg0, dtype)
+    need_f32 = any(e == "f32" for e, _ in schedule)
+    # Loop-invariant precast copy: f32 phases factor AND assemble from it
+    # on the MXU instead of in emulated f64 (dense._cholesky_ops).
+    A32 = A.astype(jnp.float32) if need_f32 else None
+    final_tol = schedule[-1][1].tol
+    carry = _bucket_phase_carry(
+        states0, jnp.zeros(B, jnp.int32), B, reg0, dtype, active0
     )
-    carry = (
-        states,
-        active & active0,
-        it,
-        regs,
-        bad,
-        # Padding slots report _OPTIMAL so the all-settled loop predicate
-        # and the cleanup/demux logic treat them as finished; consumers
-        # must ignore slots they never filled (serve/service.py demuxes
-        # by slot index, so a padding verdict is never read).
-        jnp.where(active0, status, _OPTIMAL),
-        iters,
-        best,
-        since,
-    )
-    states, _, _, _, _, status, iters, _, _ = _batched_phase(
-        A, data, carry, params, max_iter, max_refactor, reg_grow, fdt,
-        None, 2 * stall_window if stall_window else 0, _STALL,
-    )
+    phase_its = []
+    for pi, (engine, pp) in enumerate(schedule):
+        final = pi == len(schedule) - 1
+        fdt_p = jnp.dtype(jnp.float32) if engine == "f32" else fdt
+        win = (2 * stall_window if stall_window else 0) if final else stall_window
+        carry = _batched_phase(
+            A, data, carry, pp, max_iter, max_refactor, reg_grow, fdt_p,
+            None, win, _STALL if final else _RUNNING,
+            A32 if engine == "f32" else None, fuse_iters=fuse_iters,
+        )
+        phase_its.append(carry[2])
+        if not final:
+            # Phase boundary: iterates kept, provisional verdicts reset.
+            # A phase that ran at the FINAL tolerance judged its members
+            # with honest full-precision residuals (state, residual
+            # norms, and convergence tests stay f64 in every engine), so
+            # its OPTIMAL verdicts survive; loosened-tol phases are
+            # provisional and every member re-enters.
+            carry = _bucket_phase_carry(
+                carry[0], carry[6], B, reg0, dtype, active0,
+                status=carry[5] if pp.tol <= final_tol else None,
+            )
+    states, _, _, _, _, status, iters, _, _ = carry
     status = jnp.where(status == _RUNNING, _MAXITER, status)
 
     def final_norms(a, d, st):
@@ -748,17 +824,195 @@ def _solve_bucket_jit(
         return pinf, dinf, rel_gap, pobj
 
     pinf, dinf, rel_gap, pobj = jax.vmap(final_norms)(A, data, states)
-    return states, status, iters, pinf, dinf, rel_gap, pobj
+    return states, status, iters, pinf, dinf, rel_gap, pobj, jnp.stack(phase_its)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "factor_dtype"))
+def _bucket_start_jit(A, data, reg0, params, factor_dtype):
+    """Starting point of the SEGMENTED bucket drive (own cache so
+    :func:`bucket_cache_size` accounts every bucket-path program)."""
+    fdt = jnp.dtype(factor_dtype)
+    return jax.vmap(lambda a, d: _single_start(a, d, reg0, params, fdt))(A, data)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "params", "factor_dtype", "stall_window", "stall_status", "fuse_iters",
+    ),
+    # The carry is consumed: the bucket segment drive rebinds it on every
+    # dispatch and nothing reads the old one, so the per-bucket state
+    # buffers recycle in place (donation satellite — same rationale as
+    # _batched_segment_jit; verified by bucket_donation_report / the
+    # compiled program's memory analysis). A / data / A32 are
+    # loop-invariant across segments and shared with retry dispatches,
+    # so they must NOT donate.
+    donate_argnums=(2,),
+)
+def _bucket_segment_jit(
+    A, data, carry, it_stop, max_iter, max_refactor, reg_grow, params,
+    factor_dtype, stall_window=0, stall_status=_RUNNING, A32=None,
+    fuse_iters=1,
+):
+    out = _batched_phase(
+        A, data, carry, params, max_iter, max_refactor, reg_grow,
+        jnp.dtype(factor_dtype), it_stop, stall_window, stall_status, A32,
+        fuse_iters=fuse_iters,
+    )
+    f = A.dtype
+    settled = jnp.where(jnp.any(out[1]), core.STATUS_RUNNING, core.STATUS_OPTIMAL)
+    unfinished = jnp.sum(out[5] != _OPTIMAL)
+    meta = jnp.stack(
+        [out[2].astype(f), settled.astype(f), jnp.sum(out[1]).astype(f),
+         unfinished.astype(f)]
+    )
+    return out, meta
+
+
+@functools.partial(jax.jit, static_argnames=("factor_dtype",))
+def _bucket_norms_jit(A, data, states, factor_dtype):
+    """Final per-member diagnostics of the segmented bucket drive (own
+    cache — see :func:`_bucket_start_jit`)."""
+    fdt = jnp.dtype(factor_dtype)
+
+    def final_norms(a, d, st):
+        ops = _make_ops(a, jnp.asarray(0.0, a.dtype), fdt, 0)
+        pinf, dinf, _, rel_gap, pobj, _, _ = core.residual_norms(ops, d, st)
+        return pinf, dinf, rel_gap, pobj
+
+    return jax.vmap(final_norms)(A, data, states)
+
+
+def _solve_bucket_segmented(A, data, active0, cfg, schedule, fname, seg, fuse):
+    """Host-segmented bucket drive (TPU watchdog guard, same design as
+    _solve_batched_segmented): each device dispatch is one bounded
+    :func:`_bucket_segment_jit` continuation with the carry DONATED —
+    the bucket's state buffers recycle in place across dispatches — and
+    ``fuse`` IPM iterations fused per while-loop trip, so the serve
+    solve thread crosses the host boundary once per segment instead of
+    once per iteration. No compaction (bucket batches are small and may
+    be mesh-sharded) and no cleanup (the service owns the retry
+    budget)."""
+    B = A.shape[0]
+    dtype = A.dtype
+    fdt = jnp.dtype(fname)
+    reg0 = jnp.asarray(cfg.reg_dual, dtype)
+    mi = jnp.asarray(cfg.max_iter, jnp.int32)
+    mr = jnp.asarray(cfg.max_refactor, jnp.int32)
+    rg = jnp.asarray(cfg.reg_grow, dtype)
+    need_f32 = any(e == "f32" for e, _ in schedule)
+    A32 = A.astype(jnp.float32) if need_f32 else None
+    states0 = _bucket_start_jit(A, data, reg0, schedule[-1][1], fname)
+    carry = _bucket_phase_carry(
+        states0, jnp.zeros(B, jnp.int32), B, reg0, dtype, active0
+    )
+    final_tol = schedule[-1][1].tol
+    w = cfg.stall_window
+    phase_its = []
+    for pi, (engine, pp) in enumerate(schedule):
+        final = pi == len(schedule) - 1
+        fdt_name = "float32" if engine == "f32" else jnp.dtype(fdt).name
+        win = (2 * w if w else 0) if final else w
+        wstat = _STALL if final else _RUNNING
+        A32p = A32 if engine == "f32" else None
+
+        def run_seg(c, stop, _pp=pp, _f=fdt_name, _w=win, _ws=wstat,
+                    _a32=A32p):
+            return _bucket_segment_jit(
+                A, data, c, jnp.asarray(stop, jnp.int32), mi, mr, rg,
+                _pp, _f, _w, _ws, _a32, fuse,
+            )
+
+        carry, (it, _, _, _) = core.drive_segments(
+            run_seg, carry, cfg.max_iter, 0, seg
+        )
+        phase_its.append(it)
+        if not final:
+            carry = _bucket_phase_carry(
+                carry[0], carry[6], B, reg0, dtype, active0,
+                status=carry[5] if pp.tol <= final_tol else None,
+            )
+    states, _, _, _, _, status, iters, _, _ = carry
+    status = jnp.where(status == _RUNNING, _MAXITER, status)
+    pinf, dinf, rel_gap, pobj = _bucket_norms_jit(A, data, states, fname)
+    return states, status, iters, pinf, dinf, rel_gap, pobj, phase_its
 
 
 def bucket_cache_size() -> int:
     """Number of compiled bucket programs in this process — the serve
     layer's recompile telemetry, and the warm-bucket zero-recompile
     assertion in tests (repeat dispatches to a warm bucket must not grow
-    this). The cache keys include the input shardings, so the invariant
-    holds per (bucket, mesh) pair: the same bucket dispatched over a
-    different mesh compiles once more, then stays warm there too."""
-    return _solve_bucket_jit._cache_size()
+    this). Sums every bucket-path program: the fused single-program
+    route plus the segmented start/segment/norms route. The cache keys
+    include the input shardings, so the invariant holds per
+    (bucket, mesh) pair: the same bucket dispatched over a different
+    mesh compiles once more, then stays warm there too."""
+    return (
+        _solve_bucket_jit._cache_size()
+        + _bucket_start_jit._cache_size()
+        + _bucket_segment_jit._cache_size()
+        + _bucket_norms_jit._cache_size()
+    )
+
+
+def bucket_donation_report(
+    m: int, n: int, batch: int, config: Optional[SolverConfig] = None
+):
+    """AOT-compile the bucket segment program at the given shape and
+    return its memory-analysis figures — ``alias_bytes`` is the donated
+    input/output aliasing XLA actually established (0 would mean the
+    donated carry is being COPIED, defeating the in-place reuse). Uses
+    ``jit.lower().compile()``, which bypasses the dispatch cache, so the
+    zero-warm-recompile accounting is untouched. Returns None where the
+    backend exposes no memory analysis."""
+    cfg = config or SolverConfig()
+    dtype = jnp.dtype(cfg.dtype)
+    B = batch
+    A = jnp.zeros((B, m, n), dtype)
+    b = jnp.ones((B, m), dtype)
+    c = jnp.ones((B, n), dtype)
+    u = jnp.full((B, n), jnp.inf, dtype=dtype)
+    data = jax.vmap(
+        lambda cc, bb, uu: core.make_problem_data(jnp, cc, bb, uu, dtype)
+    )(c, b, u)
+    states0 = IPMState(
+        x=jnp.ones((B, n), dtype), y=jnp.zeros((B, m), dtype),
+        s=jnp.ones((B, n), dtype), w=jnp.ones((B, n), dtype),
+        z=jnp.zeros((B, n), dtype),
+    )
+    reg0 = jnp.asarray(cfg.reg_dual, dtype)
+    carry = _bucket_phase_carry(
+        states0, jnp.zeros(B, jnp.int32), B, reg0, dtype,
+        jnp.ones(B, dtype=bool),
+    )
+    pp = cfg.bucket_phase_params("f64", cfg.tol)
+    lowered = _bucket_segment_jit.lower(
+        A, data, carry, jnp.asarray(8, jnp.int32),
+        jnp.asarray(cfg.max_iter, jnp.int32),
+        jnp.asarray(cfg.max_refactor, jnp.int32),
+        jnp.asarray(cfg.reg_grow, dtype), pp,
+        jnp.dtype(cfg.factor_dtype_resolved()).name, 0, _RUNNING, None, 1,
+    )
+    try:
+        ma = lowered.compile().memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+
+    def _get(attr):
+        try:
+            v = getattr(ma, attr)
+        except Exception:
+            return None
+        return None if v is None else int(v)
+
+    return {
+        "alias_bytes": _get("alias_size_in_bytes"),
+        "argument_bytes": _get("argument_size_in_bytes"),
+        "output_bytes": _get("output_size_in_bytes"),
+        "temp_bytes": _get("temp_size_in_bytes"),
+    }
 
 
 def place_bucket(
@@ -824,18 +1078,29 @@ def solve_bucket(
     Inputs already placed by :func:`place_bucket` (the serve pipeline's
     pack stage) are used as-is.
 
-    Unlike :func:`solve_batched` there is no chunking, no phase schedule
-    and no solo cleanup: the service owns the retry budget of unfinished
-    members (supervisor ladder / solo re-solve), and the one jitted
-    program per (B, m, n, dtype, params, sharding) key is reused across
-    every dispatch — a warm bucket never recompiles
-    (:func:`bucket_cache_size`).
+    Unlike :func:`solve_batched` there is no chunking and no solo
+    cleanup: the service owns the retry budget of unfinished members
+    (supervisor ladder / solo re-solve). The per-bucket PRECISION
+    schedule (``config.bucket_schedule`` → :meth:`SolverConfig.
+    bucket_phases`: f32-gram early phase → df32-elementwise mid → f64c
+    finisher, tiered by the request tolerance) runs as masked phases
+    inside the one jitted program per (B, m, n, dtype, tol, schedule,
+    sharding) key, reused across every dispatch — a warm bucket never
+    recompiles (:func:`bucket_cache_size`). On TPU the drive is
+    host-segmented (watchdog guard) with the carry donated per segment;
+    results are identical either way.
     """
     cfg = config or SolverConfig()
     if config_overrides:
         cfg = cfg.replace(**config_overrides)
     dtype = jnp.dtype(cfg.dtype)
     fname = jnp.dtype(cfg.factor_dtype_resolved()).name
+    platform = jax.default_backend()
+    tiers = cfg.bucket_phases(cfg.tol, platform)
+    schedule = tuple(
+        (e, cfg.bucket_phase_params(e, t)) for e, t in tiers
+    )
+    fuse = cfg.fused_iters_resolved(platform)
 
     t0 = time.perf_counter()
     if isinstance(batch.A, jax.Array) and batch.A.dtype == dtype:
@@ -858,22 +1123,37 @@ def solve_bucket(
     setup_time = time.perf_counter() - t0
 
     t1 = time.perf_counter()
-    cache0 = _solve_bucket_jit._cache_size()
-    states, status, iters, pinf, dinf, rel_gap, pobj = _solve_bucket_jit(
-        A,
-        data,
-        active,
-        jnp.asarray(cfg.reg_dual, dtype),
-        jnp.asarray(cfg.max_iter, jnp.int32),
-        jnp.asarray(cfg.max_refactor, jnp.int32),
-        jnp.asarray(cfg.reg_grow, dtype),
-        cfg.step_params(),
-        fname,
-        cfg.stall_window,
-    )
+    cache0 = bucket_cache_size()
+    seg_cfg = cfg.segment_iters
+    if core.use_segments(seg_cfg, platform):
+        (states, status, iters, pinf, dinf, rel_gap, pobj,
+         phase_its) = _solve_bucket_segmented(
+            A, data, active, cfg, schedule, fname,
+            seg_cfg if seg_cfg else 8, fuse,
+        )
+    else:
+        (states, status, iters, pinf, dinf, rel_gap, pobj,
+         phase_its) = _solve_bucket_jit(
+            A,
+            data,
+            active,
+            jnp.asarray(cfg.reg_dual, dtype),
+            jnp.asarray(cfg.max_iter, jnp.int32),
+            jnp.asarray(cfg.max_refactor, jnp.int32),
+            jnp.asarray(cfg.reg_grow, dtype),
+            schedule,
+            fname,
+            cfg.stall_window,
+            fuse,
+        )
     jax.block_until_ready(states)
     solve_time = time.perf_counter() - t1
-    compiled = _solve_bucket_jit._cache_size() - cache0
+    compiled = bucket_cache_size() - cache0
+    phase_report = [
+        {"phase": pi, "engine": tiers[pi][0], "tol": tiers[pi][1],
+         "iters": int(v)}
+        for pi, v in enumerate(np.asarray(phase_its))
+    ]
     if compiled:  # recompile accounting at the cache itself: every
         # caller (service dispatch, warm_buckets, direct tests) is
         # covered, and the warm path costs one cache-size read.
@@ -901,6 +1181,8 @@ def solve_bucket(
         dinf=np.asarray(dinf, dtype=np.float64),
         solve_time=solve_time,
         setup_time=setup_time,
+        phase_report=phase_report,
+        fused_iters=fuse,
     )
 
 
@@ -1049,6 +1331,7 @@ def solve_batched(
     two_phase, use_pcg, n_phases = _phase_plan(cfg, member_entries=m * n)
     params_p1 = cfg.phase1_params()
     cg = (cfg.cg_iters, cfg.cg_tol) if use_pcg else (0, 0.0)
+    fuse = cfg.fused_iters_resolved(jax.default_backend())
     seg = cfg.segment_iters
     if seg is None:
         seg = 8 if jax.default_backend() == "tpu" else 0
@@ -1057,7 +1340,7 @@ def solve_batched(
         (states, status, iters, pinf, dinf, rel_gap, pobj,
          phase_report) = _solve_batched_segmented(
             A, data, cfg, params, params_p1, fname, two_phase, seg, cg,
-            compact_ok=mesh is None,
+            compact_ok=mesh is None, fuse_iters=fuse,
         )
         # Same row shape chunked or not (the chunked path tags rows in
         # _concat_results) — consumers never branch on chunking.
@@ -1077,6 +1360,7 @@ def solve_batched(
             cfg.stall_window,
             cg[0],
             cg[1],
+            fuse,
         )
     jax.block_until_ready(states)
 

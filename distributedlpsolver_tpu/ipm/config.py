@@ -110,6 +110,25 @@ class SolverConfig:
     # distribution (sharded backends)
     mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices
     mesh_axis: str = "cols"  # axis name for the variable-sharded mesh dim
+    # Per-bucket mixed-precision schedule of the SERVING path
+    # (backends/batched.solve_bucket): "df32" runs the tolerance-tiered
+    # f32-gram → df32-elementwise → f64c-finisher phase ladder (see
+    # :meth:`bucket_phases` — the round-5 dense/block schedules pushed
+    # into the bucket programs), "f64" forces the legacy single-phase
+    # bucket loop at ``factor_dtype_resolved``. None/"auto" = "df32" on
+    # TPU (where emulated-f64 elementwise is the measured wall,
+    # ROUND5_NOTES lever 3), "f64" elsewhere (native f64 beats the extra
+    # phases on CPU). The schedule is a static key of the one compiled
+    # program per (bucket, tol) — it never adds warm recompiles.
+    bucket_schedule: Optional[str] = None
+    # Iterations fused per while-loop trip of the batched/bucket device
+    # loops (traced inner fori_loop over the masked step): the loop
+    # predicate — the only cross-device collective of a sharded bucket
+    # dispatch — and the segment-boundary bookkeeping run k× less often.
+    # Semantics are exactly k=1 (each fused micro-step re-checks the
+    # loop guard and masks all writes), so results are bitwise stable in
+    # k. None = auto: 8 on TPU, 1 elsewhere.
+    fused_iters: Optional[int] = None
     # Fused on-device solve loop (lax.while_loop over iterations; no
     # per-iteration host round trip). None = auto: used when the backend
     # supports it and per-iteration checkpointing is off.
@@ -155,6 +174,18 @@ class SolverConfig:
                 f"solve_mode must be None, 'direct', or 'pcg'; "
                 f"got {self.solve_mode!r}"
             )
+        if self.bucket_schedule not in (None, "auto", "f64", "df32"):
+            # A typo ("DF32", "mixed") silently selecting the legacy
+            # single-phase loop would drop the mixed-precision win
+            # without a trace — reject like solve_mode does.
+            raise ValueError(
+                f"bucket_schedule must be None, 'auto', 'f64', or "
+                f"'df32'; got {self.bucket_schedule!r}"
+            )
+        if self.fused_iters is not None and self.fused_iters < 1:
+            raise ValueError(
+                f"fused_iters must be None or >= 1; got {self.fused_iters!r}"
+            )
 
     def replace(self, **kw) -> "SolverConfig":
         return dataclasses.replace(self, **kw)
@@ -170,6 +201,50 @@ class SolverConfig:
         """Whether the f32→f64 two-phase fused solve should be used."""
         return self.factor_dtype == "auto" and platform == "tpu"
 
+    def bucket_schedule_resolved(self, platform: str) -> str:
+        """Concrete bucket schedule name ("df32" or "f64") — auto picks
+        "df32" exactly on TPU (ROUND5_NOTES lever 3: the emulated-f64
+        elementwise wall the schedule removes doesn't exist on CPU)."""
+        bs = self.bucket_schedule
+        if bs in (None, "auto"):
+            return "df32" if platform == "tpu" else "f64"
+        return bs
+
+    def fused_iters_resolved(self, platform: str) -> int:
+        """Concrete fused-iterations-per-while-trip for the batched and
+        bucket device loops (auto: 8 on TPU, 1 elsewhere)."""
+        if self.fused_iters is not None:
+            return self.fused_iters
+        return 8 if platform == "tpu" else 1
+
+    def bucket_phases(self, tol: float, platform: str):
+        """The serving bucket's precision-phase ladder for one tolerance
+        tier: a static tuple of ``(engine, phase_tol)`` pairs consumed by
+        backends/batched._solve_bucket_jit as part of its compile key
+        (one program per (bucket, tol) — the schedule never forks the
+        warm cache).
+
+        Engines: ``"f32"`` — f32 factorization + assembly on the precast
+        copy (gram-form MXU route; iterates/residuals stay f64, so its
+        verdicts are honest whenever its phase tol equals the final
+        tol); ``"df32"`` — full-precision factorization route with the
+        KKT back-substitution and scaling elementwise chains in df32
+        (ops/df32.py, ~1e-13 direction error); ``"f64"`` — the plain
+        full-precision loop (the f64c finisher on TPU, where f64 is the
+        emulated two-float chain). Tiers mirror what round 5 gave the
+        dense/block backends: tight tolerances take all three phases,
+        mid tiers stop at df32 (its noise floor is orders below), loose
+        tiers run f32 alone.
+        """
+        if self.bucket_schedule_resolved(platform) != "df32":
+            return (("f64", tol),)
+        p1 = max(tol, self.phase1_tol)
+        if tol <= 1e-6:
+            return (("f32", p1), ("df32", tol), ("f64", tol))
+        if tol <= 1e-3:
+            return (("f32", p1), ("df32", tol))
+        return (("f32", tol),)
+
     def phase1_params(self) -> "StepParams":
         """Step params of the two-phase f32 phase: tol loosened to the
         handoff tolerance (single source of the handoff rule — the
@@ -183,7 +258,7 @@ class SolverConfig:
         )
 
     def step_params(self, mu_pinf_floor: float = 0.0,
-                    mcc: int = 0) -> "StepParams":
+                    mcc: int = 0, elementwise: str = "native") -> "StepParams":
         return StepParams(
             tol=self.tol,
             eta=self.eta,
@@ -195,6 +270,21 @@ class SolverConfig:
             kkt_refine=self.kkt_refine,
             mu_pinf_floor=mu_pinf_floor,
             mcc=mcc,
+            elementwise=elementwise,
+        )
+
+    def bucket_phase_params(self, engine: str, phase_tol: float) -> "StepParams":
+        """StepParams of one :meth:`bucket_phases` phase. The f32 phase
+        carries the μ-vs-pinf balance floor exactly like
+        :meth:`phase1_params` (limited-precision directions bound how
+        fast pinf can fall); the df32 phase flips the step's elementwise
+        engine and needs no floor — its ~1e-13 noise sits five orders
+        under the 1e-8 tolerance."""
+        base = self.replace(tol=phase_tol)
+        if engine == "f32":
+            return base.step_params(mu_pinf_floor=0.03)
+        return base.step_params(
+            elementwise="df32" if engine == "df32" else "native"
         )
 
 
@@ -241,3 +331,11 @@ class StepParams:
     # near-pure-centering σ across its 41–48 — the textbook signature
     # these correctors fix). 0 = off (every non-endgame path).
     mcc: int = 0
+    # Elementwise engine of the KKT back-substitution and scaling chains
+    # inside the traced step: "native" runs them in the iterate dtype
+    # (emulated f64 on TPU); "df32" routes them through the two-float
+    # layer (ops/df32.py — f32 VPU speed, ~1e-13 relative error), the
+    # round-5 lever-3 schedule of the serving bucket programs. Residuals,
+    # matvecs, factorizations, and the convergence tests stay native, so
+    # a df32 phase's OPTIMAL verdicts are honest. jax paths only.
+    elementwise: str = "native"

@@ -91,9 +91,31 @@ def make_problem_data(xp, c, b, u, dtype) -> ProblemData:
     )
 
 
-def _solve_kkt_once(ops: LinOps, state: IPMState, hub, d, factors, r_p, r_u, r_d, r_xs, r_wz):
-    """Back-substitute one Newton solve through the normal equations."""
+def _solve_kkt_once(ops: LinOps, state: IPMState, hub, d, factors, r_p, r_u,
+                    r_d, r_xs, r_wz, elementwise: str = "native"):
+    """Back-substitute one Newton solve through the normal equations.
+
+    ``elementwise="df32"`` (StepParams.elementwise) runs the elementwise
+    chains — the division-heavy h/dx/ds/dw/dz blocks that dominate the
+    batched step on emulated-f64 hardware — through the two-float layer
+    (ops/df32.py, ~1e-13 relative); the matvecs and the normal-equations
+    solve keep their native route either way. jax-only (resolved at
+    trace time: ``elementwise`` rides the static StepParams key).
+    """
     x, y, s, w, z = state
+    if elementwise == "df32":
+        # Lazy import keeps jax out of this module's import path (the
+        # eager numpy backends pass elementwise="native" and never reach
+        # here).
+        from distributedlpsolver_tpu.ops import df32 as _df32
+
+        h = _df32.kkt_h(r_d, r_xs, x, r_wz, z, r_u, w)
+        dy = ops.solve(factors, r_p + ops.matvec(_df32.mul64(d, h)))
+        dx = _df32.kkt_dx(d, ops.rmatvec(dy), h)
+        ds = _df32.kkt_ds(r_xs, s, dx, x)
+        dw = _df32.sub64(r_u, dx)
+        dz = _df32.kkt_dz(hub, r_wz, z, dw, w)
+        return dx, dy, ds, dw, dz
     h = r_d - r_xs / x + (r_wz - z * r_u) / w
     dy = ops.solve(factors, r_p + ops.matvec(d * h))
     dx = d * (ops.rmatvec(dy) - h)
@@ -104,7 +126,8 @@ def _solve_kkt_once(ops: LinOps, state: IPMState, hub, d, factors, r_p, r_u, r_d
 
 
 def _solve_kkt(
-    ops: LinOps, state: IPMState, hub, d, factors, r_p, r_u, r_d, r_xs, r_wz, refine: int
+    ops: LinOps, state: IPMState, hub, d, factors, r_p, r_u, r_d, r_xs, r_wz,
+    refine: int, elementwise: str = "native",
 ):
     """Newton solve + ``refine`` rounds of KKT-level iterative refinement.
 
@@ -118,16 +141,19 @@ def _solve_kkt(
     """
     x, y, s, w, z = state
     dx, dy, ds, dw, dz = _solve_kkt_once(
-        ops, state, hub, d, factors, r_p, r_u, r_d, r_xs, r_wz
+        ops, state, hub, d, factors, r_p, r_u, r_d, r_xs, r_wz, elementwise
     )
     for _ in range(refine):
+        # KKT residuals stay native: they are the accuracy arbiter each
+        # refinement round corrects toward, so they must not inherit the
+        # df32 chains' (tiny but nonzero) rounding.
         e_p = r_p - ops.matvec(dx)
         e_u = hub * (r_u - (dx + dw))
         e_d = r_d - (ops.rmatvec(dy) + ds - dz)
         e_xs = r_xs - (s * dx + x * ds)
         e_wz = hub * (r_wz - (z * dw + w * dz))
         cx, cy, cs, cw, cz = _solve_kkt_once(
-            ops, state, hub, d, factors, e_p, e_u, e_d, e_xs, e_wz
+            ops, state, hub, d, factors, e_p, e_u, e_d, e_xs, e_wz, elementwise
         )
         dx, dy, ds, dw, dz = dx + cx, dy + cy, ds + cs, dw + cw, dz + cz
     if ops.primal_project is not None:
@@ -295,8 +321,14 @@ def scaling_d(state: IPMState, data: ProblemData, cfg: StepParams):
     One definition shared by :func:`mehrotra_step` and backends that
     precompute factorizations outside the step program (the dense
     endgame phase splits one iteration across dispatches and must form
-    the SAME d the step will use)."""
+    the SAME d the step will use). With ``cfg.elementwise == "df32"``
+    the division chain runs through the two-float layer (jax paths
+    only; see :func:`_solve_kkt_once`)."""
     x, y, s, w, z = state
+    if cfg.elementwise == "df32":
+        from distributedlpsolver_tpu.ops import df32 as _df32
+
+        return _df32.scaling_d(x, s, w, z, data.hub, cfg.reg_primal)
     dinv = s / x + data.hub * z / w + cfg.reg_primal
     return 1.0 / dinv
 
@@ -356,7 +388,7 @@ def mehrotra_step(
         rwz_aff = -(w * z) * hub
         dxa, dya, dsa, dwa, dza = _solve_kkt(
             ops, state, hub, d, factors, r_p, r_u, r_d, rxs_aff, rwz_aff,
-            cfg.kkt_refine
+            cfg.kkt_refine, cfg.elementwise
         )
         ap_aff = _max_step(xp, x, dxa, w, dwa, hub)
         ad_aff = _max_step(xp, s, dsa, z, dza, hub)
@@ -376,7 +408,8 @@ def mehrotra_step(
         rxs = target - x * s - dxa * dsa
         rwz = hub * (target - w * z - dwa * dza)
     dx, dy, ds, dw, dz = _solve_kkt(
-        ops, state, hub, d, factors, r_p, r_u, r_d, rxs, rwz, cfg.kkt_refine
+        ops, state, hub, d, factors, r_p, r_u, r_d, rxs, rwz, cfg.kkt_refine,
+        cfg.elementwise
     )
 
     ap_raw = _max_step(xp, x, dx, w, dw, hub)
@@ -405,7 +438,8 @@ def mehrotra_step(
             cxs = xp.clip(v_xs, 0.1 * target, 10.0 * target) - v_xs
             cwz = hub * (xp.clip(v_wz, 0.1 * target, 10.0 * target) - v_wz)
             gx, gy, gs, gw, gz = _solve_kkt_once(
-                ops, state, hub, d, factors, zm, zn, zn, cxs, cwz
+                ops, state, hub, d, factors, zm, zn, zn, cxs, cwz,
+                cfg.elementwise
             )
             dx2, dy2, ds2, dw2, dz2 = dx + gx, dy + gy, ds + gs, dw + gw, dz + gz
             ap2 = _max_step(xp, x, dx2, w, dw2, hub)

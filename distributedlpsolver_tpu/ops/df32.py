@@ -1,0 +1,206 @@
+"""Double-f32 ("two-float") elementwise arithmetic — the round-5 lever 3
+engine for the batched serve hot path.
+
+A value is carried as an unevaluated pair of f32 arrays ``(hi, lo)`` with
+``hi = fl32(hi + lo)`` (renormalized), giving an effective 48-bit
+significand. On TPUs f64 is software-emulated and *scalarized* elementwise
+f64 chains (divisions in the KKT back-substitution and scaling, the ratio
+grids) are the measured wall of the batched step (ROUND5_NOTES lever 3);
+a df32 chain runs the same arithmetic as ~5–20 native-f32 VPU ops per
+result element — f32 speed, ~f64 accuracy.
+
+Error model (u = 2⁻²⁴, the f32 unit roundoff; bounds from Joldes, Muller
+& Popescu, "Tight and rigorous error bounds for basic building blocks of
+double-word arithmetic", ACM TOMS 2017, instantiated for binary32):
+
+* ``pack``     : |x − (hi+lo)| ≤ 2⁻⁴⁹·|x|  (hi, lo each correctly rounded)
+* ``add/sub``  : relative error ≤ 3u² ≈ 1.1e-14   (AccurateDWPlusDW)
+* ``mul``      : relative error ≤ 5u² ≈ 1.8e-14   (DWTimesDW, Dekker split)
+* ``div``      : relative error ≤ 15u² ≈ 5.3e-14  (DWDivDW2)
+* chain of k ops: ≲ 15·k·u² — the KKT chains here are ≤ 6 ops deep, so a
+  direction component carries ≲ 1e-13 relative error, five orders below
+  the 1e-8 convergence tolerance (the f64c finisher phase owns the rest).
+
+Validity range: the Dekker splitting constant multiplies operands by
+2¹²+1, so |values| must stay below ~2¹¹⁵ (≈4e34) for full accuracy, and
+a result's low limb holds bits down to |x|·2⁻⁴⁸ — once that falls under
+the f32 subnormal floor (1.4e-45, i.e. |x| ≲ 4e-31) accuracy degrades
+gracefully toward plain f32. Late-IPM scaling diagonals span ~1e±12 —
+comfortably inside. Non-finite inputs propagate: any NaN/±inf operand yields a
+non-finite result (the exact value — inf vs NaN — is unspecified; the
+solver's bad-step detection only tests finiteness).
+
+The algorithms rely on IEEE-exact f32 add/sub/mul (error-free
+transformations): XLA preserves per-op float semantics (no fast-math
+reassociation), so the sequences below survive jit/fusion verbatim.
+
+This module is a sanctioned mixed-precision schedule owner
+(analysis/config.NARROW_SANCTIONED): every f64→f32 narrowing of the df32
+engine lives HERE — callers (ipm/core.py) pass f64 arrays to the chain
+helpers and get f64 back, and never narrow themselves.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_F32 = jnp.float32
+_F64 = jnp.float64
+# Dekker splitting constant for a 24-bit significand: 2^ceil(24/2) + 1.
+_SPLIT = np.float32(4097.0)
+
+
+# -- error-free transformations (f32 in, f32 pair out) -----------------------
+
+
+def two_sum(a, b):
+    """Knuth 2Sum: ``a + b = s + e`` exactly (s = fl(a+b))."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def fast_two_sum(a, b):
+    """Dekker Fast2Sum: exact under |a| ≥ |b| (or a = 0) — the
+    renormalization step, where the precondition holds by construction."""
+    s = a + b
+    e = b - (s - a)
+    return s, e
+
+
+def two_prod(a, b):
+    """Dekker 2Prod via splitting: ``a · b = p + e`` exactly (no FMA —
+    XLA exposes none portably; the split form is exact on IEEE f32)."""
+    p = a * b
+    aa = _SPLIT * a
+    a_hi = aa - (aa - a)
+    a_lo = a - a_hi
+    bb = _SPLIT * b
+    b_hi = bb - (bb - b)
+    b_lo = b - b_hi
+    e = ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo
+    return p, e
+
+
+# -- df32 pair algebra -------------------------------------------------------
+
+
+def renorm(hi, lo):
+    """Re-establish the pair invariant |lo| ≤ ulp(hi)/2."""
+    return fast_two_sum(hi, lo)
+
+
+def pack(x):
+    """f64 → df32: ``hi = fl32(x)``, ``lo = fl32(x − hi)`` — both
+    roundings correct, so the pair holds x to ~2⁻⁴⁹ relative."""
+    hi = x.astype(_F32)
+    lo = (x - hi.astype(_F64)).astype(_F32)
+    return hi, lo
+
+
+def unpack(d):
+    """df32 → f64 (exact: both components are f32, their f64 sum is
+    representable)."""
+    hi, lo = d
+    return hi.astype(_F64) + lo.astype(_F64)
+
+
+def const(v, like_hi):
+    """A Python-float constant as a df32 pair broadcast against
+    ``like_hi`` (split exactly at trace time with numpy)."""
+    hi = np.float32(v)
+    lo = np.float32(v - float(hi))
+    return (
+        jnp.full_like(like_hi, hi),
+        jnp.full_like(like_hi, lo),
+    )
+
+
+def neg(x):
+    return -x[0], -x[1]
+
+
+def add(x, y):
+    """AccurateDWPlusDW (Joldes et al. alg. 6): rel. err ≤ 3u²."""
+    sh, sl = two_sum(x[0], y[0])
+    th, tl = two_sum(x[1], y[1])
+    c = sl + th
+    vh, vl = fast_two_sum(sh, c)
+    w = tl + vl
+    return fast_two_sum(vh, w)
+
+
+def sub(x, y):
+    return add(x, neg(y))
+
+
+def mul(x, y):
+    """DWTimesDW (Joldes et al. alg. 12, FMA-free): rel. err ≤ 5u²."""
+    ph, pl = two_prod(x[0], y[0])
+    pl = pl + (x[0] * y[1] + x[1] * y[0])
+    return fast_two_sum(ph, pl)
+
+
+def div(x, y):
+    """DWDivDW2 (Joldes et al. alg. 17): rel. err ≤ 15u²."""
+    th = x[0] / y[0]
+    # r = x − th·y, computed in df32 (exact two_prod inside mul).
+    rh, rl = sub(x, mul((th, jnp.zeros_like(th)), y))
+    tl = rh / y[0]
+    return fast_two_sum(th, tl)
+
+
+# -- f64-in / f64-out chain helpers for the IPM hot path ---------------------
+#
+# These are the ONLY entry points ipm/core.py uses: pack the f64 operands,
+# run the whole elementwise chain at df32, unpack once. Each mirrors one
+# elementwise block of the KKT back-substitution / scaling (core.py's
+# _solve_kkt_once and scaling_d) — keeping the chain definitions next to
+# the arithmetic makes the error-bound accounting local to this file.
+
+
+def mul64(a, b):
+    """``a ∘ b`` through df32 (f64 in/out)."""
+    return unpack(mul(pack(a), pack(b)))
+
+
+def sub64(a, b):
+    """``a − b`` through df32 (f64 in/out)."""
+    return unpack(sub(pack(a), pack(b)))
+
+
+def scaling_d(x, s, w, z, hub, reg_primal):
+    """``1 / (s/x + hub·z/w + reg_primal)`` — the normal-equations
+    diagonal (core.scaling_d) as one df32 chain. ``hub`` is the 0/1
+    finite-upper-bound mask (exact in f32)."""
+    X, S, W, Z = pack(x), pack(s), pack(w), pack(z)
+    hub32 = hub.astype(_F32)
+    zw = div(Z, W)
+    zw = (zw[0] * hub32, zw[1] * hub32)  # exact: mask is 0/1
+    dinv = add(add(div(S, X), zw), const(reg_primal, X[0]))
+    return unpack(div(const(1.0, X[0]), dinv))
+
+
+def kkt_h(r_d, r_xs, x, r_wz, z, r_u, w):
+    """``h = r_d − r_xs/x + (r_wz − z·r_u)/w`` (back-substitution RHS)."""
+    RD, RXS, X = pack(r_d), pack(r_xs), pack(x)
+    RWZ, Z, RU, W = pack(r_wz), pack(z), pack(r_u), pack(w)
+    t = div(sub(RWZ, mul(Z, RU)), W)
+    return unpack(add(sub(RD, div(RXS, X)), t))
+
+
+def kkt_dx(d, aty, h):
+    """``dx = d · (Aᵀdy − h)``; the matvec ``aty`` arrives in f64."""
+    return unpack(mul(pack(d), sub(pack(aty), pack(h))))
+
+
+def kkt_ds(r_xs, s, dx, x):
+    """``ds = (r_xs − s·dx)/x``."""
+    return unpack(div(sub(pack(r_xs), mul(pack(s), pack(dx))), pack(x)))
+
+
+def kkt_dz(hub, r_wz, z, dw, w):
+    """``dz = hub · (r_wz − z·dw)/w`` (mask applied in f64 — exact)."""
+    return hub * unpack(div(sub(pack(r_wz), mul(pack(z), pack(dw))), pack(w)))

@@ -274,6 +274,18 @@ class SolveService:
             "serve_padding_waste", buckets=obs_metrics.RATIO_BUCKETS,
             help="padded-entries fraction wasted per dispatch",
         )
+        # Mixed-precision schedule telemetry: iterations per precision
+        # engine (f32/df32/f64), phase switches per dispatch, and the
+        # fused-iterations-per-while-trip the bucket programs run with.
+        self._m_phase_iters: dict = {}  # engine -> counter (created lazily)
+        self._m_phase_switches = m.counter(
+            "serve_phase_switches_total",
+            help="precision-phase transitions across bucket dispatches",
+        )
+        self._m_fused = m.gauge(
+            "serve_fused_iters",
+            help="IPM iterations fused per device while-loop trip",
+        )
         self._mesh = self._build_mesh(self.config.mesh_devices)  # guarded-by: _lock
         n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
         self.scheduler = Scheduler(  # guarded-by: _lock
@@ -313,6 +325,7 @@ class SolveService:
         self._dispatch_rows: List[dict] = []  # guarded-by: _lock
         self._overlap_ms_total = 0.0  # guarded-by: _lock
         self._pack_ms_total = 0.0  # guarded-by: _lock
+        self._phase_iters: dict = {}  # engine -> total iters; guarded-by: _lock
         # Idle telemetry: how the dispatcher sleeps (satellite: the loop
         # waits exactly until Scheduler.next_event_in, surfaced here).
         self._idle_waits = 0  # guarded-by: _lock
@@ -808,12 +821,38 @@ class SolveService:
         self._m_solve_ms.observe((t_sol1 - t_sol0) * 1e3)
         self._m_overlap_ms.observe(overlap_ms)
         self._m_waste.observe(waste)
+        # Precision-schedule telemetry (phase rows come back host-side on
+        # the BatchedResult — no device sync here): per-engine iteration
+        # counters, phase-switch count, and the fused-k the program ran.
+        sched_rows = (res.phase_report or []) if res is not None else []
+        schedule_str = "→".join(
+            f"{r['engine']}@{r['tol']:g}" for r in sched_rows
+        ) or None
+        fused_k = res.fused_iters if res is not None else None
+        for r in sched_rows:
+            ctr = self._m_phase_iters.get(r["engine"])
+            if ctr is None:
+                ctr = self.metrics.counter(
+                    "serve_phase_iters_total",
+                    labels={"engine": r["engine"]},
+                    help="bucket IPM iterations by precision engine",
+                )
+                self._m_phase_iters[r["engine"]] = ctr
+            ctr.inc(r["iters"])
+        if len(sched_rows) > 1:
+            self._m_phase_switches.inc(len(sched_rows) - 1)
+        if fused_k is not None:
+            self._m_fused.set(fused_k)
 
         with self._lock:
             depth = self.scheduler.depth()
             occupancy = self.scheduler.occupancy()
             self._overlap_ms_total += overlap_ms
             self._pack_ms_total += packed.pack_ms
+            for r in sched_rows:
+                self._phase_iters[r["engine"]] = (
+                    self._phase_iters.get(r["engine"], 0) + r["iters"]
+                )
             self._dispatch_rows.append(
                 {
                     "dispatch": seq,
@@ -823,6 +862,8 @@ class SolveService:
                     "compile_ms": round(compile_ms, 3),
                     "solve_ms": round((t_sol1 - t_sol0) * 1e3, 3),
                     "overlap_ms": round(overlap_ms, 3),
+                    "schedule": schedule_str,
+                    "fused_iters": fused_k,
                     "mesh_devices": (
                         int(mesh.devices.size) if mesh is not None else 1
                     ),
@@ -841,6 +882,8 @@ class SolveService:
                 "compile_ms": round(compile_ms, 3),
                 "solve_ms": round(res.solve_time * 1e3, 3) if res else None,
                 "overlap_ms": round(overlap_ms, 3),
+                "schedule": schedule_str,
+                "fused_iters": fused_k,
                 "mesh_devices": (
                     int(mesh.devices.size) if mesh is not None else 1
                 ),
@@ -1172,12 +1215,38 @@ class SolveService:
             return self.warm_buckets(table.specs())
         return 0
 
+    @staticmethod
+    def _cache_dir_snapshot():
+        """(dir, entries) of JAX's persistent compilation cache — the
+        ``--jax-cache-dir`` satellite: warm-up compiles go through it
+        when configured, and the per-bucket warmup line classifies each
+        compile as a cache hit (no new entry written) or miss."""
+        import os
+
+        import jax
+
+        d = jax.config.jax_compilation_cache_dir
+        if not d or not os.path.isdir(d):
+            return d, None
+        try:
+            return d, set(os.listdir(d))
+        except OSError:
+            return d, None
+
     def warm_buckets(
         self, specs: Sequence[BucketSpec], tol: Optional[float] = None
     ) -> int:
         """Pre-compile the bucket programs for ``specs`` at ``tol``
         (default: the service tolerance) on the current mesh, so live
-        traffic never pays those compiles. Idempotent per warm key."""
+        traffic never pays those compiles. Idempotent per warm key.
+
+        Compiles go through the persistent compilation cache when one is
+        configured (``--jax-cache-dir`` / TPULP_COMPILE_CACHE), and every
+        warmed bucket logs a ``cache: hit|miss|off`` line — ``hit`` means
+        the executable was served without writing a new cache entry (a
+        restart after a ladder swap pays deserialization, not XLA), so
+        ladder swaps against a warm cache are cheap to verify from the
+        JSONL stream alone."""
         from distributedlpsolver_tpu.backends.batched import (
             bucket_cache_size,
             place_bucket,
@@ -1204,6 +1273,7 @@ class SolveService:
                 dummy, np.ones(spec.batch, dtype=bool), cfg, mesh=mesh
             )
             size0 = bucket_cache_size()
+            cache_dir, entries0 = self._cache_dir_snapshot()
             t0 = time.perf_counter()
             try:
                 solve_bucket(placed, act, cfg, mesh=mesh, max_iter=1)
@@ -1224,11 +1294,22 @@ class SolveService:
             with self._lock:
                 self._warm.add(wk)
                 self._compiles += new_programs
+            if not cache_dir:
+                cache = "off"
+            else:
+                _, entries1 = self._cache_dir_snapshot()
+                wrote = (
+                    entries0 is not None
+                    and entries1 is not None
+                    and bool(entries1 - entries0)
+                )
+                cache = "miss" if wrote else "hit"
             self._logger.event(
                 {
                     "event": "warmup",
                     "bucket": list(spec.key()),
                     "tol": tol,
+                    "cache": cache,
                     "compile_ms": round((time.perf_counter() - t0) * 1e3, 3),
                 }
             )
@@ -1244,6 +1325,9 @@ class SolveService:
             return list(self._dispatch_rows)
 
     def stats(self) -> dict:
+        import jax
+
+        platform = jax.default_backend()
         with self._lock:
             results = list(self._results)
             depth = self.scheduler.depth()
@@ -1252,6 +1336,7 @@ class SolveService:
             compiles = self._compiles
             overlap_total = self._overlap_ms_total
             pack_total = self._pack_ms_total
+            phase_iters = dict(self._phase_iters)
             buckets = [list(s.key()) for s in self.scheduler.table.specs()]
             idle = {
                 "waits": self._idle_waits,
@@ -1271,6 +1356,9 @@ class SolveService:
             "mesh_devices": self.mesh_devices,
             "pack_ms_total": round(pack_total, 3),
             "overlap_ms_total": round(overlap_total, 3),
+            "schedule": self.solver_config.bucket_schedule_resolved(platform),
+            "fused_iters": self.solver_config.fused_iters_resolved(platform),
+            "phase_iters": phase_iters,
             "idle": idle,
             "buckets": buckets,
         }

@@ -1,0 +1,13 @@
+# Seeds: dtype-narrow x2 — a df32-style pack/split written OUTSIDE the
+# sanctioned two-float module. Checked with pkg_path="ipm/fx.py": the
+# narrowing belongs in ops/df32.py (NARROW_SANCTIONED), anywhere else it
+# is unbudgeted precision loss.
+import jax.numpy as jnp
+
+f32 = jnp.float32
+
+
+def pack_pair(x):
+    hi = x.astype(jnp.float32)  # dtype-narrow
+    lo = (x - hi.astype(jnp.float64)).astype(f32)  # dtype-narrow
+    return hi, lo

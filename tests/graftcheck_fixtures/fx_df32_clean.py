@@ -1,0 +1,17 @@
+# Compliant twin of fx_df32_bad: the IDENTICAL pack narrowing is exempt
+# when it lives in the sanctioned two-float module — checked with
+# pkg_path="ops/df32.py" (analysis/config.NARROW_SANCTIONED). Constructors
+# still pin dtypes (dtype-explicit applies everywhere in ops/).
+import jax.numpy as jnp
+
+f32 = jnp.float32
+
+
+def pack_pair(x):
+    hi = x.astype(jnp.float32)  # sanctioned: this IS the df32 engine
+    lo = (x - hi.astype(jnp.float64)).astype(f32)
+    return hi, lo
+
+
+def const_pair(n):
+    return jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32)

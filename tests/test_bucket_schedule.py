@@ -1,0 +1,199 @@
+"""The df32 mixed-precision bucket engine on the serve hot path
+(ISSUE 7 acceptance, CPU tier-1):
+
+* df32-scheduled bucket solves match the all-f64 path to 1e-8 on the
+  200-request probe shapes,
+* results are bitwise-stable across dispatches,
+* the zero-warm-recompile invariant holds (bucket_cache_size unchanged
+  across repeat dispatches and across a 200-request service run),
+* fused-k iteration fusion is bitwise-equivalent to k = 1,
+* the segmented dispatch path donates its carry (no aliasing copy,
+  asserted via the compiled program's memory analysis where available),
+* the service stamps schedule / fused-iters telemetry and warm_buckets
+  logs a compile-cache hit/miss line per bucket.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from distributedlpsolver_tpu.backends.batched import (
+    bucket_cache_size,
+    bucket_donation_report,
+    solve_bucket,
+)
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import Status
+from distributedlpsolver_tpu.models.generators import (
+    random_batched_lp,
+    random_request_stream,
+)
+from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+from distributedlpsolver_tpu.serve.buckets import (
+    BucketSpec,
+    BucketTable,
+    pad_standard_form,
+)
+from distributedlpsolver_tpu.serve.service import standard_form
+
+pytestmark = pytest.mark.serve
+
+_DF32 = SolverConfig(bucket_schedule="df32")
+_F64 = SolverConfig(bucket_schedule="f64")
+
+
+def _probe_buckets(n_requests=200, batch=8, seed=13):
+    """The 200-request probe stream bucketed exactly as the service
+    would: one padded (B, m, n) batch per distinct bucket shape, filled
+    with the stream's own problems."""
+    table = BucketTable(batch=batch)
+    per_bucket = {}
+    for p in random_request_stream(n_requests, seed=seed):
+        c, A, b = standard_form(p)
+        spec = table.spec_for(*A.shape)
+        per_bucket.setdefault(spec.key(), (spec, []))[1].append((c, A, b))
+    out = []
+    for spec, members in per_bucket.values():
+        B = spec.batch
+        A = np.zeros((B, spec.m, spec.n))
+        b = np.zeros((B, spec.m))
+        c = np.zeros((B, spec.n))
+        active = np.zeros(B, dtype=bool)
+        for k, (cc, AA, bb) in enumerate(members[:B]):
+            c[k], A[k], b[k] = pad_standard_form(cc, AA, bb, spec.m, spec.n)
+            active[k] = True
+        for k in range(int(active.sum()), B):
+            A[k], b[k], c[k] = A[0], b[0], c[0]
+        from distributedlpsolver_tpu.models.generators import BatchedLP
+
+        out.append((spec, BatchedLP(c=c, A=A, b=b, name="probe"), active))
+    return out
+
+
+class TestScheduleEquivalence:
+    def test_df32_matches_f64_on_probe_shapes(self):
+        """Acceptance: every active member of every probe-shape bucket is
+        OPTIMAL under the df32 schedule and agrees with the all-f64
+        reference to 1e-8 relative."""
+        buckets = _probe_buckets()
+        assert len(buckets) >= 2  # the probe stream spans ≥2 shapes
+        for spec, batch, active in buckets:
+            r_df = solve_bucket(batch, active, config=_DF32)
+            r_64 = solve_bucket(batch, active, config=_F64)
+            sched = [row["engine"] for row in r_df.phase_report]
+            assert sched == ["f32", "df32", "f64"]  # the 1e-8 tier
+            for k in np.flatnonzero(active):
+                assert r_df.status[k] is Status.OPTIMAL, (spec, k)
+                assert r_64.status[k] is Status.OPTIMAL, (spec, k)
+                assert abs(r_df.objective[k] - r_64.objective[k]) <= 1e-8 * (
+                    1.0 + abs(r_64.objective[k])
+                ), (spec, k)
+                assert r_df.rel_gap[k] <= 1e-8
+                assert r_df.pinf[k] <= 1e-7 and r_df.dinf[k] <= 1e-7
+
+    def test_bitwise_stable_and_zero_warm_recompiles(self):
+        batch = random_batched_lp(8, 12, 40, seed=21)
+        active = np.ones(8, dtype=bool)
+        r1 = solve_bucket(batch, active, config=_DF32)
+        cache0 = bucket_cache_size()
+        r2 = solve_bucket(batch, active, config=_DF32)
+        assert bucket_cache_size() == cache0  # warm bucket: no recompile
+        assert np.array_equal(r1.x, r2.x)  # bitwise-stable dispatches
+        assert np.array_equal(r1.iterations, r2.iterations)
+
+    def test_loose_tier_drops_finisher_phases(self):
+        # tolerance tiers: 1e-4 stops at df32, 1e-2 runs f32 alone —
+        # both with honest OPTIMAL verdicts.
+        batch = random_batched_lp(4, 8, 24, seed=3)
+        active = np.ones(4, dtype=bool)
+        r_mid = solve_bucket(batch, active, config=_DF32.replace(tol=1e-4))
+        assert [r["engine"] for r in r_mid.phase_report] == ["f32", "df32"]
+        r_loose = solve_bucket(batch, active, config=_DF32.replace(tol=1e-2))
+        assert [r["engine"] for r in r_loose.phase_report] == ["f32"]
+        for r in (r_mid, r_loose):
+            assert all(s is Status.OPTIMAL for s in r.status)
+
+    def test_fused_iters_bitwise_equivalent(self):
+        batch = random_batched_lp(6, 10, 32, seed=8)
+        active = np.array([True] * 5 + [False])
+        r1 = solve_bucket(batch, active, config=_F64.replace(fused_iters=1))
+        r4 = solve_bucket(batch, active, config=_F64.replace(fused_iters=4))
+        assert r4.fused_iters == 4
+        assert np.array_equal(r1.x, r4.x)
+        assert np.array_equal(r1.iterations, r4.iterations)
+        assert list(r1.status) == list(r4.status)
+        # and composed with the df32 schedule
+        d1 = solve_bucket(batch, active, config=_DF32.replace(fused_iters=1))
+        d3 = solve_bucket(batch, active, config=_DF32.replace(fused_iters=3))
+        assert np.array_equal(d1.x, d3.x)
+
+
+class TestSegmentedDispatch:
+    def test_segmented_matches_fused_and_donates(self):
+        batch = random_batched_lp(8, 12, 40, seed=5)
+        active = np.ones(8, dtype=bool)
+        cfg = _DF32.replace(segment_iters=4)
+        r_seg = solve_bucket(batch, active, cfg)
+        r_one = solve_bucket(batch, active, _DF32)
+        assert all(s is Status.OPTIMAL for s in r_seg.status)
+        np.testing.assert_allclose(r_seg.x, r_one.x, rtol=1e-8, atol=1e-10)
+        # repeat dispatch through the segmented path: warm, stable
+        cache0 = bucket_cache_size()
+        r_seg2 = solve_bucket(batch, active, cfg)
+        assert bucket_cache_size() == cache0
+        assert np.array_equal(r_seg.x, r_seg2.x)
+
+    def test_donation_no_aliasing_copy(self):
+        # The compiled segment program must alias the donated carry into
+        # its outputs (alias bytes cover at least the (B, n) f64 state
+        # lanes) — 0 would mean the donation is silently copied.
+        report = bucket_donation_report(12, 40, 8)
+        if report is None or report.get("alias_bytes") is None:
+            pytest.skip("backend exposes no memory analysis")
+        assert report["alias_bytes"] >= 8 * 40 * 8  # one (B, n) f64 lane
+
+
+class TestServiceIntegration:
+    def test_service_df32_schedule_telemetry_and_zero_recompile(self, tmp_path):
+        log = tmp_path / "serve.jsonl"
+        cfg = ServiceConfig(batch=8, flush_s=0.02, log_jsonl=str(log))
+        with SolveService(cfg, solver_config=_DF32) as svc:
+            futs = [svc.submit(p) for p in random_request_stream(40, seed=5)]
+            assert svc.drain(timeout=600)
+            results = [f.result(timeout=30) for f in futs]
+            cache0 = bucket_cache_size()
+            warm = [svc.submit(p) for p in random_request_stream(24, seed=6)]
+            assert svc.drain(timeout=600)
+            warm_results = [f.result(timeout=30) for f in warm]
+            assert bucket_cache_size() == cache0  # zero warm recompiles
+            stats = svc.stats()
+        assert all(
+            r.status is Status.OPTIMAL for r in results + warm_results
+        )
+        assert stats["schedule"] == "df32"
+        assert stats["fused_iters"] >= 1
+        assert stats["phase_iters"].get("f32", 0) > 0
+        assert stats["phase_iters"].get("df32", 0) > 0
+        events = [json.loads(l) for l in log.read_text().splitlines()]
+        batches = [e for e in events if e["event"] == "batch"]
+        assert batches
+        for e in batches:
+            assert e["schedule"] == "f32@3e-05→df32@1e-08→f64@1e-08"
+            assert e["fused_iters"] >= 1
+
+    def test_warm_buckets_logs_cache_line(self, tmp_path):
+        log = tmp_path / "warm.jsonl"
+        # A shape no other test warms, so this service really compiles.
+        spec = BucketSpec(9, 44, 4)
+        with SolveService(
+            ServiceConfig(batch=4, log_jsonl=str(log)), auto_start=True
+        ) as svc:
+            assert svc.warm_buckets([spec]) == 1
+        events = [json.loads(l) for l in log.read_text().splitlines()]
+        warm = [e for e in events if e["event"] == "warmup"]
+        assert len(warm) == 1
+        assert warm[0]["bucket"] == [9, 44, 4]
+        assert warm[0]["cache"] in ("hit", "miss", "off")

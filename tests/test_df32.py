@@ -1,0 +1,221 @@
+"""Property tests for the double-f32 arithmetic layer (ops/df32.py):
+error bounds vs f64 across magnitude ranges, renormalization invariants,
+NaN/inf propagation, and the f64-in/out KKT chain helpers the IPM core
+routes through under StepParams.elementwise == "df32"."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from distributedlpsolver_tpu.ops import df32  # noqa: E402
+
+# Per-op bounds are ~15u² ≈ 5.3e-14 (module docstring); chains compound a
+# handful of ops plus the 2⁻⁴⁹ pack error. 1e-12 relative leaves ~20×
+# slack without ever passing a plain-f32 (1e-7) regression.
+_REL = 1e-12
+# Magnitude decades well inside the documented df32 validity range:
+# |x| ≲ 4e34 (Dekker split) and |results| ≳ 4e-31 (low limb above the
+# f32 subnormal floor) — products at the extreme scales stay legal.
+_SCALES = (1e-12, 1e-6, 1.0, 1e6, 1e12)
+
+
+def _rel_err(got, ref):
+    got, ref = np.asarray(got), np.asarray(ref)
+    return np.max(np.abs(got - ref) / (np.abs(ref) + 1e-300))
+
+
+def _rand(rng, n, scale):
+    # Bounded away from zero so relative error is meaningful and sums
+    # are well-conditioned (cancellation amplifies the *input* rounding
+    # of any finite representation — that is conditioning, not an
+    # arithmetic defect, so it is excluded here by construction).
+    return scale * (rng.uniform(0.1, 10.0, n) * rng.choice([-1.0, 1.0], n))
+
+
+class TestErrorBounds:
+    @pytest.mark.parametrize("scale", _SCALES)
+    def test_add_sub_mul_div_vs_f64(self, scale):
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(_rand(rng, 2048, scale))
+        y = jnp.asarray(_rand(rng, 2048, scale))
+        X, Y = df32.pack(x), df32.pack(y)
+        assert _rel_err(df32.unpack(df32.mul(X, Y)), x * y) < _REL
+        assert _rel_err(df32.unpack(df32.div(X, Y)), x / y) < _REL
+        # Same-sign addition is perfectly conditioned — the clean probe
+        # of the additive bound.
+        xs, ys = jnp.abs(x), jnp.abs(y)
+        XS, YS = df32.pack(xs), df32.pack(ys)
+        assert _rel_err(df32.unpack(df32.add(XS, YS)), xs + ys) < _REL
+        assert _rel_err(df32.unpack(df32.sub(XS, df32.neg(YS))), xs + ys) < _REL
+
+    def test_pack_roundtrip_exact_to_2e49(self):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(_rand(rng, 4096, 1.0))
+        assert _rel_err(df32.unpack(df32.pack(x)), x) < 2.0**-48
+
+    def test_cross_magnitude_products(self):
+        # Mixed scales inside one op: hi/lo split must track the large
+        # component while preserving the small one's digits.
+        rng = np.random.default_rng(11)
+        x = jnp.asarray(_rand(rng, 1024, 1e12))
+        y = jnp.asarray(_rand(rng, 1024, 1e-12))
+        assert _rel_err(df32.mul64(x, y), x * y) < _REL
+
+    def test_under_jit(self):
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(_rand(rng, 512, 1.0))
+        y = jnp.asarray(_rand(rng, 512, 1.0))
+        f = jax.jit(
+            lambda a, b: df32.unpack(df32.div(df32.pack(a), df32.pack(b)))
+        )
+        assert _rel_err(f(x, y), x / y) < _REL
+
+
+class TestRenormalization:
+    def test_pair_invariant_after_ops(self):
+        # |lo| ≤ ulp(hi)/2 ⇒ |lo| ≤ 2⁻²³·|hi| — the renormalized-pair
+        # invariant every op re-establishes via fast_two_sum.
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(_rand(rng, 1024, 1.0))
+        y = jnp.asarray(_rand(rng, 1024, 1.0))
+        X, Y = df32.pack(x), df32.pack(y)
+        for hi, lo in (
+            df32.pack(x),
+            df32.add(X, Y),
+            df32.mul(X, Y),
+            df32.div(X, Y),
+            df32.renorm(Y[0], Y[1]),
+        ):
+            hi, lo = np.asarray(hi), np.asarray(lo)
+            assert np.all(np.abs(lo) <= 2.0**-23 * np.abs(hi) + 1e-45)
+
+    def test_two_sum_exact(self):
+        # The error-free transformation really is error-free: s + e
+        # reconstructs the f64 sum of the f32 inputs exactly.
+        rng = np.random.default_rng(2)
+        a = jnp.asarray(rng.standard_normal(1024), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(1024) * 1e-4, jnp.float32)
+        s, e = df32.two_sum(a, b)
+        exact = a.astype(jnp.float64) + b.astype(jnp.float64)
+        got = s.astype(jnp.float64) + e.astype(jnp.float64)
+        assert np.array_equal(np.asarray(got), np.asarray(exact))
+
+    def test_two_prod_exact(self):
+        rng = np.random.default_rng(4)
+        a = jnp.asarray(rng.standard_normal(1024), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(1024), jnp.float32)
+        p, e = df32.two_prod(a, b)
+        exact = a.astype(jnp.float64) * b.astype(jnp.float64)
+        got = p.astype(jnp.float64) + e.astype(jnp.float64)
+        assert np.array_equal(np.asarray(got), np.asarray(exact))
+
+
+class TestNonFinite:
+    def test_nan_propagates(self):
+        x = jnp.asarray([np.nan, 1.0, np.nan])
+        y = jnp.asarray([1.0, np.nan, 2.0])
+        for op in (df32.add, df32.sub, df32.mul, df32.div):
+            out = np.asarray(df32.unpack(op(df32.pack(x), df32.pack(y))))
+            assert not np.isfinite(out[0]) and not np.isfinite(out[1])
+
+    def test_inf_yields_nonfinite(self):
+        # inf arithmetic produces inf−inf = NaN inside the EFTs; the
+        # contract is only "non-finite in → non-finite out" (the solver's
+        # bad-step detection tests finiteness, nothing else).
+        x = jnp.asarray([np.inf, -np.inf, 1.0])
+        y = jnp.asarray([1.0, 2.0, np.inf])
+        for op in (df32.add, df32.mul, df32.div):
+            out = np.asarray(df32.unpack(op(df32.pack(x), df32.pack(y))))
+            assert not np.any(np.isfinite(out))
+
+    def test_finite_lanes_unpolluted(self):
+        # Elementwise: a non-finite lane never contaminates its
+        # neighbours (the masking design of the batched loop depends on
+        # per-member independence).
+        x = jnp.asarray([np.nan, 3.0])
+        y = jnp.asarray([1.0, 2.0])
+        out = np.asarray(df32.unpack(df32.mul(df32.pack(x), df32.pack(y))))
+        assert not np.isfinite(out[0]) and abs(out[1] - 6.0) < 1e-12
+
+
+class TestKKTChains:
+    """The f64-in/out chain helpers ipm/core.py calls under
+    elementwise="df32" match their native-f64 formulas to chain-level
+    bounds (≲1e-13; asserted at 1e-11 across adversarial IPM-like
+    spreads)."""
+
+    _CHAIN_REL = 1e-11
+
+    def _iterate(self, n=1536, seed=0):
+        # Late-IPM-like spreads: x/s spanning ~12 orders against w/z a
+        # few orders — the conditioning the scaling chain actually sees.
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(10.0 ** rng.uniform(-9, 3, n))
+        s = jnp.asarray(10.0 ** rng.uniform(-9, 3, n))
+        w = jnp.asarray(10.0 ** rng.uniform(-4, 2, n))
+        z = jnp.asarray(10.0 ** rng.uniform(-4, 2, n))
+        hub = jnp.asarray((rng.random(n) > 0.4).astype(np.float64))
+        return x, s, w, z, hub, rng
+
+    def test_scaling_d(self):
+        x, s, w, z, hub, _ = self._iterate()
+        ref = 1.0 / (s / x + hub * z / w + 1e-8)
+        got = df32.scaling_d(x, s, w, z, hub, 1e-8)
+        assert _rel_err(got, ref) < self._CHAIN_REL
+
+    def test_kkt_back_substitution(self):
+        x, s, w, z, hub, rng = self._iterate(seed=1)
+        n = x.shape[0]
+        r_d = jnp.asarray(_rand(rng, n, 1.0))
+        r_xs = jnp.asarray(_rand(rng, n, 1e-3))
+        r_wz = hub * jnp.asarray(_rand(rng, n, 1e-3))
+        r_u = hub * jnp.asarray(_rand(rng, n, 1e-2))
+        d = jnp.asarray(10.0 ** rng.uniform(-8, 8, n))
+        aty = jnp.asarray(_rand(rng, n, 1.0))
+
+        h_ref = r_d - r_xs / x + (r_wz - z * r_u) / w
+        h = df32.kkt_h(r_d, r_xs, x, r_wz, z, r_u, w)
+        assert _rel_err(h, h_ref) < self._CHAIN_REL
+
+        dx_ref = d * (aty - h_ref)
+        dx = df32.kkt_dx(d, aty, h)
+        assert _rel_err(dx, dx_ref) < self._CHAIN_REL
+
+        ds_ref = (r_xs - s * dx_ref) / x
+        assert _rel_err(df32.kkt_ds(r_xs, s, dx, x), ds_ref) < self._CHAIN_REL
+
+        dw_ref = r_u - dx_ref
+        dw = df32.sub64(r_u, dx)
+        # dw is a difference of near-equal magnitudes in places; compare
+        # against the direction scale, not the (possibly cancelled) dw.
+        scale = np.max(np.abs(np.asarray(dx_ref))) + 1.0
+        assert np.max(np.abs(np.asarray(dw - dw_ref))) < self._CHAIN_REL * scale
+
+        dz_ref = hub * (r_wz - z * dw_ref) / w
+        dz = df32.kkt_dz(hub, r_wz, z, dw, w)
+        err = np.max(np.abs(np.asarray(dz - dz_ref)))
+        assert err < 1e-9 * (np.max(np.abs(np.asarray(dz_ref))) + 1.0)
+
+    def test_step_params_routes_df32(self):
+        # The core seam: a StepParams with elementwise="df32" makes
+        # scaling_d numerically track the df32 chain, not native f64.
+        from distributedlpsolver_tpu.ipm import core
+        from distributedlpsolver_tpu.ipm.config import SolverConfig
+        from distributedlpsolver_tpu.ipm.state import IPMState
+
+        x, s, w, z, hub, _ = self._iterate(n=256, seed=2)
+        state = IPMState(x=x, y=jnp.zeros(4), s=s, w=w, z=z)
+        data = core.make_problem_data(
+            jnp, jnp.ones_like(x), jnp.ones(4),
+            jnp.where(hub > 0, 2.0 * x, jnp.inf), jnp.float64,
+        )
+        cfg = SolverConfig()
+        d_native = core.scaling_d(state, data, cfg.step_params())
+        d_df32 = core.scaling_d(
+            state, data, cfg.step_params(elementwise="df32")
+        )
+        expect = df32.scaling_d(x, s, w, z, data.hub, cfg.reg_primal)
+        assert np.array_equal(np.asarray(d_df32), np.asarray(expect))
+        assert _rel_err(d_df32, d_native) < self._CHAIN_REL

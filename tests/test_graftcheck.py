@@ -84,6 +84,20 @@ class TestRuleFamilies:
         rules, _ = _rules_hit("fx_dtype_bad.py", "serve/fx.py")
         assert rules == []
 
+    def test_df32_pack_narrowing_flagged_outside_sanctioned_module(self):
+        # The df32 pack idiom (f64 → hi/lo f32 split) is exactly the
+        # narrowing the rule exists to catch when it leaks out of the
+        # two-float module.
+        rules, findings = _rules_hit("fx_df32_bad.py", "ipm/fx.py")
+        assert rules == ["dtype-narrow"]
+        assert len(findings) == 2
+
+    def test_df32_module_sanctioned_for_narrowing(self):
+        # The identical idiom under ops/df32.py — the sanctioned
+        # mixed-precision schedule owner — is exempt, twin stays clean.
+        rules, _ = _rules_hit("fx_df32_clean.py", "ops/df32.py")
+        assert rules == []
+
     def test_locks_catches_seeded(self):
         rules, findings = _rules_hit("fx_locks_bad.py", "serve/fx.py")
         assert rules == ["guarded-by"]
