@@ -428,6 +428,86 @@ def _bench_serve(quick: bool) -> dict:
         f"schedule={row['schedule']} (phase iters {row['phase_iters']}), "
         f"fused_iters={row['fused_iters']}"
     )
+    row["warm_start"] = _bench_serve_warm(quick)
+    return row
+
+
+def _bench_serve_warm(quick: bool) -> dict:
+    """Warm-start & amortization sub-row: drive the seeded CORRELATED
+    stream (same models, perturbed b/c — models/generators.
+    correlated_request_stream) through one service with the fingerprint
+    cache on, after a cold leg that populates it. Reports median
+    iterations-per-request and p50/p99 latency warm-vs-cold, the
+    cache-hit ratio, safeguard rejections, and the zero-warm-recompile
+    check across the warm leg — the measurements the warm layer is
+    accepted on."""
+    from distributedlpsolver_tpu.backends.batched import bucket_cache_size
+    from distributedlpsolver_tpu.models.generators import (
+        correlated_request_stream,
+    )
+    from distributedlpsolver_tpu.obs.stats import percentile
+    from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+
+    n_cold = 24 if quick else 64
+    n_warm = 32 if quick else 128
+    with SolveService(ServiceConfig(batch=8, flush_s=0.02)) as svc:
+        futs = [
+            svc.submit(p)
+            for p in correlated_request_stream(n_cold, seed=31)
+        ]
+        svc.drain(timeout=1200)
+        cold_leg = [f.result(timeout=60) for f in futs]
+        cache0 = bucket_cache_size()
+        t0 = time.perf_counter()
+        futs = [
+            svc.submit(p)
+            for p in correlated_request_stream(
+                n_warm, seed=31, offset=n_cold
+            )
+        ]
+        svc.drain(timeout=1200)
+        rs = [f.result(timeout=60) for f in futs]
+        wall = time.perf_counter() - t0
+        warm_recompiles = bucket_cache_size() - cache0
+        stats = svc.stats()
+
+    warm_rs = [r for r in rs if r.warm == "warm"]
+    # Cold baseline over BOTH legs: at a 100% hit ratio the warm leg
+    # alone has no cold members left to compare against.
+    cold_rs = [r for r in cold_leg + rs if r.warm != "warm"]
+    row = {
+        "requests": n_warm,
+        "optimal": sum(r.status.value == "optimal" for r in rs),
+        "time_s": round(wall, 4),
+        "warm_requests": len(warm_rs),
+        "hit_ratio": round(len(warm_rs) / max(n_warm, 1), 4),
+        "rejected": sum(1 for r in rs if r.warm == "rejected"),
+        "iters_median_warm": percentile([r.iterations for r in warm_rs], 50),
+        "iters_median_cold": percentile([r.iterations for r in cold_rs], 50),
+        "latency_ms_p50_warm": round(
+            percentile([r.total_ms for r in warm_rs], 50), 3
+        ),
+        "latency_ms_p99_warm": round(
+            percentile([r.total_ms for r in warm_rs], 99), 3
+        ),
+        "latency_ms_p50_cold": round(
+            percentile([r.total_ms for r in cold_rs], 50), 3
+        ),
+        "latency_ms_p99_cold": round(
+            percentile([r.total_ms for r in cold_rs], 99), 3
+        ),
+        "warm_recompiles": int(warm_recompiles),
+        "warm_cache": stats["warm_cache"],
+    }
+    _log(
+        f"  serve warm-start: {row['warm_requests']}/{n_warm} warm "
+        f"(hit {row['hit_ratio']:.0%}, {row['rejected']} rejected), "
+        f"median iters {row['iters_median_cold']:.0f} cold -> "
+        f"{row['iters_median_warm']:.0f} warm, "
+        f"p50 {row['latency_ms_p50_cold']:.0f} -> "
+        f"{row['latency_ms_p50_warm']:.0f} ms, "
+        f"warm recompiles={warm_recompiles}"
+    )
     return row
 
 

@@ -163,6 +163,10 @@ JSONL_FIELDS = {
     "queue_depth",
     "schedule",
     "tol",
+    # warm-start & amortization layer: request records carry the
+    # "warm"/"rejected"/"cold" start label, batch events the number of
+    # warm-started slots (serve/service.py, serve/records.py)
+    "warm",
     # supervisor fault/resume events (supervisor/supervisor.py)
     "backend",
     "iteration",

@@ -66,6 +66,16 @@ class BatchedResult:
     # Iterations fused per while-loop trip of the device loop (the
     # serve telemetry's fused-iterations-per-dispatch figure).
     fused_iters: int = 1
+    # Full final iterates of the bucket path (the warm cache stores the
+    # complete (x, y, s, w, z) per member); None where not populated.
+    y: Optional[np.ndarray] = None
+    s: Optional[np.ndarray] = None
+    w: Optional[np.ndarray] = None
+    z: Optional[np.ndarray] = None
+    # Per-slot warm-start acceptance (bucket path): True where a warm
+    # iterate was offered AND survived the in-program safeguard; False
+    # for cold slots and safeguard fallbacks. None off the bucket path.
+    warm_used: Optional[np.ndarray] = None
 
     @property
     def n_optimal(self) -> int:
@@ -731,6 +741,114 @@ def _drive_compacting(
 # program per bucket shape, reused verbatim across service dispatches.
 
 
+def _warm_build_single(a, d, x, y, s, w, z, reg0, fdt):
+    """Traced twin of ipm.warm.interior_candidate for ONE bucket slot
+    (vmapped by :func:`_warm_select`): interior shift → primal
+    projection onto the new b (one AAᵀ solve — same-A delta-solve
+    refresh) → dual slack refresh on the new c → residual-aware
+    centrality lift. Policy constants come from ipm/warm.py — one
+    definition, two engines. Returns (candidate, merit, μ_w)."""
+    from distributedlpsolver_tpu.ipm import warm as warm_mod
+
+    dtype = a.dtype
+    floor = jnp.asarray(warm_mod.INTERIOR_FLOOR, dtype)
+    one = jnp.asarray(1.0, dtype)
+    tiny = jnp.asarray(1e-30, dtype)
+    ops = _make_ops(a, reg0, fdt, 0)
+    xm = jnp.maximum(jnp.mean(jnp.abs(x)), one)
+    sm = jnp.maximum(jnp.mean(jnp.abs(s)), one)
+    x1 = jnp.maximum(x, floor * xm)
+    # Primal projection: x += Aᵀ(AAᵀ)⁻¹(b − Ax) lands the candidate on
+    # the new feasible affine (the clip after re-opens a floor-sized
+    # residual at worst). A degenerate factorization NaNs the merit and
+    # the slot falls back to cold — the safeguard's job.
+    fac = ops.factorize(jnp.ones_like(x))
+    x1 = x1 + ops.rmatvec(ops.solve(fac, d.b - ops.matvec(x1)))
+    x1 = jnp.maximum(x1, floor * xm)
+    hub, u_f = d.hub, d.u_f
+    x1 = jnp.where(hub > 0, jnp.clip(x1, 0.01 * u_f, 0.99 * u_f), x1)
+    w1 = jnp.where(hub > 0, u_f - x1, jnp.ones_like(w))
+    # Dual refresh: s − z = c − Aᵀy exactly wherever the positive split
+    # allows, a floor-shift on both parts elsewhere.
+    s_hat = d.c - ops.rmatvec(y)
+    z1 = jnp.where(hub > 0, jnp.maximum(z, floor * sm), jnp.zeros_like(z))
+    s1 = jnp.where(hub > 0, s_hat + z1, jnp.maximum(s_hat, floor * sm))
+    deficit = jnp.where(
+        hub > 0, jnp.maximum(floor * sm - s1, 0.0), jnp.zeros_like(s1)
+    )
+    s1 = s1 + deficit
+    z1 = z1 + deficit
+    mu_w = (x1 @ s1 + (hub * w1) @ z1) / d.ncomp
+    pinf, dinf, *_ = core.residual_norms(
+        ops, d, IPMState(x=x1, y=y, s=s1, w=w1, z=z1)
+    )
+    merit = jnp.maximum(pinf, dinf)
+    # Residual-aware centrality lift (MERIT_MU_FLOOR): raise the SMALLER
+    # factor of any pair whose product trails the recentre target.
+    pobj = d.c @ x1
+    target = jnp.maximum(
+        jnp.asarray(warm_mod.CENTRALITY_BETA, dtype) * mu_w,
+        jnp.asarray(warm_mod.MERIT_MU_FLOOR, dtype)
+        * merit * (one + jnp.abs(pobj)) / d.ncomp,
+    )
+    lift = jnp.sqrt(jnp.clip(target / jnp.maximum(x1 * s1, tiny), 1.0, 1e16))
+    x2 = jnp.where(x1 <= s1, x1 * lift, x1)
+    s2 = jnp.where(s1 < x1, s1 * lift, s1)
+    liftw = jnp.sqrt(jnp.clip(target / jnp.maximum(w1 * z1, tiny), 1.0, 1e16))
+    w2 = jnp.where((hub > 0) & (w1 <= z1), w1 * liftw, w1)
+    z2 = jnp.where((hub > 0) & (z1 < w1), z1 * liftw, z1)
+    return IPMState(x=x2, y=y, s=s2, w=w2, z=z2), merit, mu_w
+
+
+def _warm_select(A, data, states_cold, warm_raw, warm_mask, fdt, reg0):
+    """Per-slot safeguarded warm-start selection: candidates built by
+    :func:`_warm_build_single`, each compared against the cold start's
+    initial residual merit AND complementarity (the refresh makes even
+    far-off priors nearly feasible; μ is what still tells them apart);
+    a slot takes the warm iterate only where the mask requests it and
+    both guards accept. Runs INSIDE the bucket programs — warm arrays
+    are ordinary traced inputs (zeros on cold dispatches), so one
+    compiled program serves any warm/cold mix with zero warm
+    recompiles. Returns (states0, warm_used)."""
+    from distributedlpsolver_tpu.ipm import warm as warm_mod
+
+    dtype = A.dtype
+    wx, wy, ws_, ww, wz = warm_raw
+    cand, merit_w, mu_w = jax.vmap(
+        lambda a, d, x, y, s, w, z: _warm_build_single(
+            a, d, x, y, s, w, z, reg0, fdt
+        )
+    )(A, data, wx, wy, ws_, ww, wz)
+
+    def cold_stats(a, d, st):
+        ops = _make_ops(a, jnp.asarray(0.0, dtype), fdt, 0)
+        pinf, dinf, _, _, _, _, mu = core.residual_norms(ops, d, st)
+        return jnp.maximum(pinf, dinf), mu
+
+    merit_c, mu_c = jax.vmap(cold_stats)(A, data, states_cold)
+    tiny = jnp.asarray(1e-12, dtype)
+    ok = (
+        warm_mask
+        & jnp.isfinite(merit_w)
+        & jnp.isfinite(mu_w)
+        & (
+            merit_w
+            <= jnp.asarray(warm_mod.WARM_ACCEPT_FACTOR, dtype)
+            * jnp.maximum(merit_c, tiny)
+        )
+        & (
+            mu_w
+            <= jnp.asarray(warm_mod.MU_ACCEPT_FACTOR, dtype)
+            * jnp.maximum(mu_c, tiny)
+        )
+    )
+    B = A.shape[0]
+    pick = lambda wv, cv: jnp.where(
+        ok.reshape((B,) + (1,) * (wv.ndim - 1)), wv, cv
+    )
+    return jax.tree_util.tree_map(pick, cand, states_cold), ok
+
+
 def _bucket_phase_carry(states, iters, B, reg0, dtype, active0, status=None):
     """Bucket phase-entry carry: :func:`_fresh_batch_carry` with the
     padding mask re-applied — padding slots are inactive and report a
@@ -758,7 +876,8 @@ def _bucket_phase_carry(states, iters, B, reg0, dtype, active0, status=None):
     static_argnames=("schedule", "factor_dtype", "stall_window", "fuse_iters"),
 )
 def _solve_bucket_jit(
-    A, data, active0, reg0, max_iter, max_refactor, reg_grow, schedule,
+    A, data, active0, warm_x, warm_y, warm_s, warm_w, warm_z, warm_mask,
+    reg0, max_iter, max_refactor, reg_grow, schedule,
     factor_dtype, stall_window, fuse_iters=1,
 ):
     # ``schedule`` is the static per-tolerance-tier precision ladder from
@@ -785,6 +904,13 @@ def _solve_bucket_jit(
     states0 = jax.vmap(
         lambda a, d: _single_start(a, d, reg0, start_params, fdt)
     )(A, data)
+    # Warm slots override the cold start where the in-program safeguard
+    # accepts (cold dispatches pass zero warm arrays + an all-false mask
+    # — same shapes, same program, zero warm recompiles).
+    states0, warm_used = _warm_select(
+        A, data, states0, (warm_x, warm_y, warm_s, warm_w, warm_z),
+        warm_mask, fdt, reg0,
+    )
     need_f32 = any(e == "f32" for e, _ in schedule)
     # Loop-invariant precast copy: f32 phases factor AND assemble from it
     # on the MXU instead of in emulated f64 (dense._cholesky_ops).
@@ -824,15 +950,27 @@ def _solve_bucket_jit(
         return pinf, dinf, rel_gap, pobj
 
     pinf, dinf, rel_gap, pobj = jax.vmap(final_norms)(A, data, states)
-    return states, status, iters, pinf, dinf, rel_gap, pobj, jnp.stack(phase_its)
+    return (states, status, iters, pinf, dinf, rel_gap, pobj,
+            jnp.stack(phase_its), warm_used)
 
 
 @functools.partial(jax.jit, static_argnames=("params", "factor_dtype"))
-def _bucket_start_jit(A, data, reg0, params, factor_dtype):
+def _bucket_start_jit(
+    A, data, warm_x, warm_y, warm_s, warm_w, warm_z, warm_mask, reg0,
+    params, factor_dtype,
+):
     """Starting point of the SEGMENTED bucket drive (own cache so
-    :func:`bucket_cache_size` accounts every bucket-path program)."""
+    :func:`bucket_cache_size` accounts every bucket-path program), with
+    the same safeguarded per-slot warm override as the fused program.
+    Returns (states0, warm_used)."""
     fdt = jnp.dtype(factor_dtype)
-    return jax.vmap(lambda a, d: _single_start(a, d, reg0, params, fdt))(A, data)
+    states0 = jax.vmap(lambda a, d: _single_start(a, d, reg0, params, fdt))(
+        A, data
+    )
+    return _warm_select(
+        A, data, states0, (warm_x, warm_y, warm_s, warm_w, warm_z),
+        warm_mask, fdt, reg0,
+    )
 
 
 @functools.partial(
@@ -883,7 +1021,9 @@ def _bucket_norms_jit(A, data, states, factor_dtype):
     return jax.vmap(final_norms)(A, data, states)
 
 
-def _solve_bucket_segmented(A, data, active0, cfg, schedule, fname, seg, fuse):
+def _solve_bucket_segmented(
+    A, data, active0, cfg, schedule, fname, seg, fuse, warm_raw, warm_mask
+):
     """Host-segmented bucket drive (TPU watchdog guard, same design as
     _solve_batched_segmented): each device dispatch is one bounded
     :func:`_bucket_segment_jit` continuation with the carry DONATED —
@@ -902,7 +1042,9 @@ def _solve_bucket_segmented(A, data, active0, cfg, schedule, fname, seg, fuse):
     rg = jnp.asarray(cfg.reg_grow, dtype)
     need_f32 = any(e == "f32" for e, _ in schedule)
     A32 = A.astype(jnp.float32) if need_f32 else None
-    states0 = _bucket_start_jit(A, data, reg0, schedule[-1][1], fname)
+    states0, warm_used = _bucket_start_jit(
+        A, data, *warm_raw, warm_mask, reg0, schedule[-1][1], fname
+    )
     carry = _bucket_phase_carry(
         states0, jnp.zeros(B, jnp.int32), B, reg0, dtype, active0
     )
@@ -935,7 +1077,7 @@ def _solve_bucket_segmented(A, data, active0, cfg, schedule, fname, seg, fuse):
     states, _, _, _, _, status, iters, _, _ = carry
     status = jnp.where(status == _RUNNING, _MAXITER, status)
     pinf, dinf, rel_gap, pobj = _bucket_norms_jit(A, data, states, fname)
-    return states, status, iters, pinf, dinf, rel_gap, pobj, phase_its
+    return states, status, iters, pinf, dinf, rel_gap, pobj, phase_its, warm_used
 
 
 def bucket_cache_size() -> int:
@@ -994,7 +1136,16 @@ def bucket_donation_report(
         jnp.dtype(cfg.factor_dtype_resolved()).name, 0, _RUNNING, None, 1,
     )
     try:
-        ma = lowered.compile().memory_analysis()
+        # Force a REAL compile: an executable deserialized from the
+        # persistent compilation cache (the package enables one by
+        # default) reports zero alias/temp figures, which would read as
+        # "donation silently copied" when the donation is fine.
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            ma = lowered.compile().memory_analysis()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
     except Exception:
         return None
     if ma is None:
@@ -1057,12 +1208,61 @@ def place_bucket(
     return BatchedLP(c=c, A=A, b=b, name=batch.name), act
 
 
+def place_warm(
+    warm: Optional[IPMState],
+    warm_mask,
+    shape,
+    config: Optional[SolverConfig] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    batch_axis: str = "batch",
+):
+    """Host→device transfer of a bucket's warm-start lanes — the warm
+    half of :func:`place_bucket`, run by the serve pack stage. ``warm``
+    is an IPMState of (B, n)/(B, m) host arrays (None = cold dispatch:
+    zeros), ``warm_mask`` the (B,) offered-slots mask; ``shape`` is the
+    bucket's (B, m, n). The lanes are placed with the SAME batch-axis
+    sharding as the bucket data, so a warm dispatch reuses the exact
+    compiled program a cold/warm-up dispatch built (the warm arrays are
+    ordinary traced inputs, never part of the cache key)."""
+    cfg = config or SolverConfig()
+    dtype = jnp.dtype(cfg.dtype)
+    B, m, n = shape
+    if warm is None:
+        wx = np.zeros((B, n), dtype=dtype)
+        wy = np.zeros((B, m), dtype=dtype)
+        ws_ = np.zeros((B, n), dtype=dtype)
+        ww = np.zeros((B, n), dtype=dtype)
+        wz = np.zeros((B, n), dtype=dtype)
+        wm = np.zeros(B, dtype=bool)
+    else:
+        wx = np.asarray(warm.x, dtype=dtype)
+        wy = np.asarray(warm.y, dtype=dtype)
+        ws_ = np.asarray(warm.s, dtype=dtype)
+        ww = np.asarray(warm.w, dtype=dtype)
+        wz = np.asarray(warm.z, dtype=dtype)
+        wm = np.asarray(warm_mask, dtype=bool)
+    if wm.shape != (B,):
+        raise ValueError(f"warm mask shape {wm.shape} != ({B},)")
+    if mesh is not None:
+        sh = lambda nd: mesh_lib.batch_sharding(mesh, nd, batch_axis)
+        wx, ws_, ww, wz = (jax.device_put(v, sh(2)) for v in (wx, ws_, ww, wz))
+        wy = jax.device_put(wy, sh(2))
+        wm = jax.device_put(wm, sh(1))
+    else:
+        wx, wy, ws_, ww, wz, wm = (
+            jax.device_put(v) for v in (wx, wy, ws_, ww, wz, wm)
+        )
+    return IPMState(x=wx, y=wy, s=ws_, w=ww, z=wz), wm
+
+
 def solve_bucket(
     batch: BatchedLP,
     active,
     config: Optional[SolverConfig] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
     batch_axis: str = "batch",
+    warm: Optional[IPMState] = None,
+    warm_mask=None,
     **config_overrides,
 ) -> BatchedResult:
     """Solve one pre-padded serving bucket: ``batch`` is (B, m, n) arrays
@@ -1089,6 +1289,15 @@ def solve_bucket(
     recompiles (:func:`bucket_cache_size`). On TPU the drive is
     host-segmented (watchdog guard) with the carry donated per segment;
     results are identical either way.
+
+    ``warm``/``warm_mask`` offer per-slot warm-start iterates (an
+    IPMState of (B, n)/(B, m) arrays, see :func:`place_warm`): offered
+    slots start from the shifted-and-recentred prior iterate when the
+    in-program safeguard accepts it; cold slots (and safeguard
+    fallbacks) run Mehrotra's start — one dispatch freely mixes both,
+    and ``BatchedResult.warm_used`` reports the per-slot outcome. The
+    warm lanes are ordinary traced inputs (zeros when omitted), so
+    offering them never compiles a new program.
     """
     cfg = config or SolverConfig()
     if config_overrides:
@@ -1120,23 +1329,46 @@ def solve_bucket(
     data = jax.vmap(
         lambda cc, bb, uu: core.make_problem_data(jnp, cc, bb, uu, dtype)
     )(c, b, u)
+    # Warm lanes ALWAYS enter the program (zeros + all-false mask on a
+    # cold dispatch) so warm-up, cold, warm, and mixed dispatches share
+    # one executable — the zero-warm-recompile invariant extends to the
+    # warm path by construction.
+    if (
+        warm is not None
+        and isinstance(warm.x, jax.Array)
+        and warm.x.dtype == dtype
+    ):
+        warm_states, wm = warm, warm_mask  # pre-placed by place_warm
+        if not isinstance(wm, jax.Array):
+            wm = jnp.asarray(np.asarray(wm, dtype=bool))
+    else:
+        warm_states, wm = place_warm(
+            warm, warm_mask, (Bsz, A.shape[1], n), cfg,
+            mesh=mesh, batch_axis=batch_axis,
+        )
     setup_time = time.perf_counter() - t0
 
     t1 = time.perf_counter()
     cache0 = bucket_cache_size()
     seg_cfg = cfg.segment_iters
+    warm_raw = (
+        warm_states.x, warm_states.y, warm_states.s, warm_states.w,
+        warm_states.z,
+    )
     if core.use_segments(seg_cfg, platform):
         (states, status, iters, pinf, dinf, rel_gap, pobj,
-         phase_its) = _solve_bucket_segmented(
+         phase_its, warm_used) = _solve_bucket_segmented(
             A, data, active, cfg, schedule, fname,
-            seg_cfg if seg_cfg else 8, fuse,
+            seg_cfg if seg_cfg else 8, fuse, warm_raw, wm,
         )
     else:
         (states, status, iters, pinf, dinf, rel_gap, pobj,
-         phase_its) = _solve_bucket_jit(
+         phase_its, warm_used) = _solve_bucket_jit(
             A,
             data,
             active,
+            *warm_raw,
+            wm,
             jnp.asarray(cfg.reg_dual, dtype),
             jnp.asarray(cfg.max_iter, jnp.int32),
             jnp.asarray(cfg.max_refactor, jnp.int32),
@@ -1183,6 +1415,11 @@ def solve_bucket(
         setup_time=setup_time,
         phase_report=phase_report,
         fused_iters=fuse,
+        y=np.asarray(states.y, dtype=np.float64),
+        s=np.asarray(states.s, dtype=np.float64),
+        w=np.asarray(states.w, dtype=np.float64),
+        z=np.asarray(states.z, dtype=np.float64),
+        warm_used=np.asarray(warm_used),
     )
 
 

@@ -508,8 +508,10 @@ def _endgame_step_mxu(A, data, state, Linv_s, reg, diagM, params, refine=2,
     direction-level primal closure exactly as in the PCG phases —
     pure-jax, so unlike the host endgame the whole step stays ONE device
     program (no eager per-op tunnel hops, no host round trips at all).
-    KKT-level refinement stays off for program size (same constraint as
-    _endgame_step); the solve-level sweeps own accuracy recovery."""
+    KKT-level refinement runs params.kkt_refine rounds (auto 1 via
+    SolverConfig.endgame_kkt_refine — the panel solves made the rounds
+    cheap; ROUND5_NOTES lever 1); the solve-level sweeps own the
+    factor-rounding recovery either way."""
     from distributedlpsolver_tpu.ops.chol_mxu import panel_cho_solve
 
     d_scale = core.scaling_d(state, data, params)
@@ -549,6 +551,29 @@ def _endgame_step_mxu(A, data, state, Linv_s, reg, diagM, params, refine=2,
     return core.mehrotra_step(ops, data, params, state)
 
 
+def _endgame_step_params(cfg, host_mode: bool = False):
+    """StepParams of the endgame's split-dispatch Mehrotra step — ONE
+    definition of the endgame's KKT-refinement policy (ROUND5_NOTES
+    lever 1, test-pinned).
+
+    Device/mxu modes run ``cfg.endgame_kkt_refine`` KKT-level rounds
+    (auto: 1 — the old hardwired 0 was a host-era program-size
+    constraint; the round-5 panel factorization made each refinement's
+    solves cheap panel substitutions, and one round recovers the
+    cancellation digits the regularized back-substitution loses right
+    where the terminal μ-stall cycle burns iterations). Host mode caps
+    at ``min(cfg.kkt_refine, 1)`` regardless: each eager round is a
+    full host solve + device residual pair against a direction the
+    host solve already operator-refined internally."""
+    if host_mode:
+        refine = min(cfg.kkt_refine, 1)
+    else:
+        refine = (
+            1 if cfg.endgame_kkt_refine is None else cfg.endgame_kkt_refine
+        )
+    return cfg.replace(kkt_refine=refine).step_params(mcc=cfg.endgame_mcc)
+
+
 @functools.partial(jax.jit, static_argnames=("params", "refine"))
 def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=1):
     """One Mehrotra step with the factorization INJECTED (computed by the
@@ -566,9 +591,10 @@ def _endgame_step(A, data, state, Ls, reg, diagM, params, refine=1):
     see the ×10 retry ladder in _endgame_loop), with the refinement
     sweep (matrix-free exact f64 residual of the regularized system)
     recovering full solve quality against factor rounding. KKT-level
-    refinement is OFF (params arrives with kkt_refine=0); program size
-    is a hard constraint — the remote compiler's response drops after
-    ~55 minutes."""
+    refinement runs params.kkt_refine rounds (SolverConfig.
+    endgame_kkt_refine, auto 1 — restored by ROUND5_NOTES lever 1; set
+    it to 0 where program size binds, e.g. a compiler whose response
+    drops mid-compile)."""
     d_scale = core.scaling_d(state, data, params)
 
     def factorize(d):
@@ -1538,13 +1564,11 @@ class DenseJaxBackend(SolverBackend):
         import time as _time
 
         cfg = self._cfg
-        # kkt_refine=0 in the endgame step: its solves carry their own
-        # M-level refinement (see _endgame_step), and the KKT-refinement
-        # solve sites would ~3× the emulated-f64 program — whose compile
-        # must stay under the tunnel's ~55-minute response drop.
-        params = cfg.replace(kkt_refine=0).step_params(
-            mcc=cfg.endgame_mcc
-        )
+        # Endgame KKT-refinement policy: cfg.endgame_kkt_refine rounds
+        # (auto 1 — ROUND5_NOTES lever 1; the solves are cheap panel
+        # substitutions now, the old hardwired 0 was a host-era
+        # program-size constraint). See _endgame_step_params.
+        params = _endgame_step_params(cfg)
         trace = core.seg_trace_enabled()
         buf = np.asarray(buf)[:it0] if it0 else np.zeros((0, core.N_STAT))
         rows = []
@@ -1619,15 +1643,11 @@ class DenseJaxBackend(SolverBackend):
         project = None
         restore = None
         if host_mode:
-            # Eager steps carry no program-size limit — restore one round
-            # of KKT-level refinement (the device endgame had to run 0).
-            # Capped at 1 even if cfg asks for more: each eager round is a
-            # full host solve + device residual pair against a direction
-            # already operator-refined inside solve() — see the
-            # endgame_host note in ipm/config.py.
-            params = cfg.replace(kkt_refine=min(cfg.kkt_refine, 1)).step_params(
-                mcc=cfg.endgame_mcc
-            )
+            # Eager steps carry no program-size limit but each KKT round
+            # is a full host solve + device residual pair — capped at 1
+            # regardless of the endgame knob (see _endgame_step_params
+            # and the endgame_host note in ipm/config.py).
+            params = _endgame_step_params(cfg, host_mode=True)
             # The AAᵀ factor powers the DIRECTION-level primal closure
             # (restore → ops.primal_project): every Newton dx is made
             # exactly primal-feasible, so pinf decays as (1−α) per

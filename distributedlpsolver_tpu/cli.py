@@ -321,6 +321,8 @@ def cmd_serve(args) -> int:
         default_deadline_s=args.deadline_s or None,
         log_jsonl=args.log_jsonl,
         mesh_devices=args.mesh_devices,
+        warm_start=not args.no_warm_start,
+        warm_cache_entries=args.warm_cache_entries,
     )
     out = sys.stdout if args.out == "-" else open(args.out, "w")
     n_failed = 0
@@ -526,6 +528,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--buckets", default=None,
         help="explicit bucket ladder JSON (the `autotune` output) "
         "instead of auto power-of-two buckets",
+    )
+    ap_srv.add_argument(
+        "--no-warm-start", action="store_true",
+        help="disable the warm-start & amortization layer (fingerprint "
+        "cache + safeguarded warm-started IPM for correlated requests; "
+        "README 'Warm-start & amortization')",
+    )
+    ap_srv.add_argument(
+        "--warm-cache-entries", type=int, default=512,
+        help="bounded LRU capacity of the problem-fingerprint warm cache",
     )
     _add_solver_flags(ap_srv)
     ap_srv.set_defaults(fn=cmd_serve, quiet=True)

@@ -9,6 +9,7 @@ from distributedlpsolver_tpu.ipm.state import (
     StepStats,
 )
 from distributedlpsolver_tpu.ipm.driver import SolveHooks, solve
+from distributedlpsolver_tpu.ipm.warm import WarmStart
 
 __all__ = [
     "FaultKind",
@@ -20,5 +21,6 @@ __all__ = [
     "SolverConfig",
     "Status",
     "StepStats",
+    "WarmStart",
     "solve",
 ]

@@ -78,6 +78,21 @@ class SolverConfig:
     # block_angular._solve_segmented).
     pcg_handoff_tol: float = 1e-6
     kkt_refine: int = 2  # KKT-level refinement rounds per Newton solve
+    # KKT-refinement rounds of the dense ENDGAME step (ROUND5_NOTES
+    # lever 1). The old hardwired kkt_refine=0 was a host-era
+    # program-size constraint — each refinement round added a full eager
+    # host solve + device residual pair and ~3×'d the emulated-f64
+    # program whose compile had to stay under the tunnel's response
+    # drop. The round-5 endgame's solves are cheap panel substitutions
+    # (ops/chol_mxu.py), so one round is restored by default: it
+    # recovers the cancellation digits the regularized normal-equations
+    # back-substitution loses, exactly where the terminal μ-stall cycle
+    # burns iterations. None = auto (1); 0 restores the legacy
+    # no-refinement endgame; host-factor endgame steps still cap at 1
+    # (see endgame_host below). CPU equivalence is test-pinned; the TPU
+    # iteration-count measurement is deferred to the next accelerator
+    # round.
+    endgame_kkt_refine: Optional[int] = None
     # Endgame factorization placement (dense huge-m finish). On hardware
     # whose f64 is emulated (TPU), the endgame's Cholesky breaks down
     # (NaN) orders of magnitude above real-f64 breakdown — measured at

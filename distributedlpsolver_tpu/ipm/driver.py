@@ -69,8 +69,9 @@ def solve(
     problem: Union[LPProblem, InteriorForm],
     backend: Union[str, "SolverBackend"] = "tpu",
     config: Optional[SolverConfig] = None,
-    warm_start: Optional[IPMState] = None,
+    warm_start=None,
     hooks: Optional[SolveHooks] = None,
+    warm_cache=None,
     **config_overrides,
 ) -> IPMResult:
     """Solve an LP to the configured duality-gap tolerance.
@@ -79,20 +80,46 @@ def solve(
     :func:`to_interior_form`; solution is recovered in the original space)
     or an :class:`InteriorForm` directly. ``backend`` is a registry name
     (``--backend=`` in the CLI, BASELINE.json:5) or an instance.
+
+    ``warm_start`` accepts a raw :class:`IPMState` (trusted verbatim —
+    the checkpoint-resume contract) or an :class:`ipm.warm.WarmStart`
+    (safeguarded: shifted into the strict interior, recentred, and
+    DROPPED for the cold start when its initial residuals regress — see
+    ipm/warm.py). ``warm_cache`` is an optional
+    :class:`serve.warmcache.WarmCache`: the solve looks up the problem's
+    structural fingerprint for a cached scaling and prior iterate
+    (delta-solve amortization — presolve is skipped on this path, since
+    cached iterates live in the unreduced space), and stores its own
+    scaling + final iterate back on an OPTIMAL finish.
     """
     from distributedlpsolver_tpu.backends.base import get_backend
+    from distributedlpsolver_tpu.ipm import warm as warm_mod
 
     cfg = config or SolverConfig()
     if config_overrides:
         cfg = cfg.replace(**config_overrides)
 
     original: Optional[LPProblem] = problem if isinstance(problem, LPProblem) else None
+    cache_fp = None
+    cache_entry = None
+    if warm_cache is not None and original is not None:
+        from distributedlpsolver_tpu.utils.fingerprint import (
+            structural_fingerprint,
+        )
+
+        # Model identity of the RAW problem; the entry's shape guard
+        # runs against the interior form below (cached iterates live in
+        # interior space, whose dims differ for general-form inputs).
+        cache_fp = structural_fingerprint(
+            original.A, original.m, original.n, original.lb, original.ub
+        )
     presolve_info = None
     if (
         cfg.presolve
         and original is not None
         and original.block_structure is None  # reductions break the hint
         and warm_start is None  # warm starts are in the unreduced space
+        and cache_fp is None  # cached iterates/scalings are too
     ):
         from distributedlpsolver_tpu.models.presolve import presolve as _presolve
 
@@ -102,13 +129,41 @@ def solve(
         inf = to_interior_form(reduced)
     else:
         inf = to_interior_form(problem) if isinstance(problem, LPProblem) else problem
+    if cache_fp is not None:
+        cache_entry = warm_cache.lookup(cache_fp, inf.m, inf.n)
+        if (
+            warm_start is None
+            and cache_entry is not None
+            and cache_entry.state is not None
+        ):
+            warm_start = warm_mod.WarmStart(cache_entry.state, source="cache")
+    if (
+        cache_entry is not None
+        and cache_entry.structure is not None
+        and inf.block_structure is None
+    ):
+        # Structure detection amortized across the stream: the hint a
+        # prior same-structure solve recorded routes this one straight
+        # to the block backend without re-detecting.
+        inf.block_structure = cache_entry.structure
 
     scaling = None
     inf_solve = inf
     if cfg.scale:
-        from distributedlpsolver_tpu.models.scaling import equilibrate
+        if (
+            cache_entry is not None
+            and cache_entry.scaling is not None
+            and cache_entry.scaled_A is not None
+        ):
+            # Delta-solve amortization: Ruiz factors depend only on A,
+            # so a same-structure request reuses the cached (Dr, Dc) and
+            # pre-scaled A — only the new b/c/u are rescaled here.
+            scaling = cache_entry.scaling
+            inf_solve = _rescale_interior(inf, scaling, cache_entry.scaled_A)
+        else:
+            from distributedlpsolver_tpu.models.scaling import equilibrate
 
-        inf_solve, scaling = equilibrate(inf)
+            inf_solve, scaling = equilibrate(inf)
 
     be = get_backend(backend) if isinstance(backend, str) else backend
     logger = IterLogger(
@@ -128,7 +183,13 @@ def solve(
         if warm_start is None
         else None
     )
-    if warm_start is not None:
+    warm_label = "cold"
+    if isinstance(warm_start, warm_mod.WarmStart):
+        state, warm_label = _init_warm_start(
+            be, warm_start, inf, inf_solve, scaling, to_solver_space
+        )
+        start_iter = 0
+    elif warm_start is not None:
         state, start_iter = to_solver_space(warm_start), 0
     elif (
         resumed is not None
@@ -145,6 +206,22 @@ def solve(
     else:
         state, start_iter = be.starting_point(), 0
     setup_time = time.perf_counter() - t_setup0
+
+    on_host_state = None
+    if warm_cache is not None and cache_fp is not None:
+        def on_host_state(final_status, host_state):
+            if final_status is not Status.OPTIMAL:
+                return
+            warm_cache.store(
+                cache_fp,
+                m=inf.m,
+                n=inf.n,
+                state=host_state,
+                scaling=scaling,
+                scaled_A=inf_solve.A if scaling is not None else None,
+                structure=inf.block_structure,
+                tol=cfg.tol,
+            )
 
     use_fused = cfg.fused_loop
     if use_fused is None:
@@ -165,6 +242,7 @@ def solve(
                 be, state, status, history, last, solve_time, setup_time,
                 inf, original, backend, start_iter, scaling=scaling,
                 presolve_info=presolve_info, extra_iters=fused_iters,
+                warm_label=warm_label, on_host_state=on_host_state,
             )
 
     status = Status.ITERATION_LIMIT
@@ -265,7 +343,67 @@ def solve(
         be, state, status, history, last, solve_time, setup_time,
         inf, original, backend, start_iter, extra_iters=it - start_iter,
         scaling=scaling, presolve_info=presolve_info,
+        warm_label=warm_label, on_host_state=on_host_state,
     )
+
+
+def _rescale_interior(inf: InteriorForm, scaling, scaled_A) -> InteriorForm:
+    """Apply a cached Ruiz scaling (same A by fingerprint contract) to a
+    new interior form: the pre-scaled A is reused as-is, only the
+    instance vectors b/c/u are rescaled — the delta-solve path's answer
+    to re-running the equilibration sweeps per request."""
+    import dataclasses as _dc
+
+    import numpy as _np
+
+    return _dc.replace(
+        inf,
+        c=inf.c * scaling.dc,
+        A=scaled_A,
+        b=inf.b * scaling.dr,
+        u=_np.where(_np.isfinite(inf.u), inf.u / scaling.dc, _np.inf),
+    )
+
+
+def _init_warm_start(be, ws, inf, inf_solve, scaling, to_solver_space):
+    """Safeguarded warm-start initialization: shift-and-recentre the
+    prior iterate (ipm/warm.py), then accept it only when its initial
+    residual merit does not regress past the Mehrotra cold start's —
+    the fallback keeps an adversarial prior from costing more than the
+    warm start could save. Returns (device_state, "warm"|"rejected")."""
+    from distributedlpsolver_tpu.ipm import warm as warm_mod
+
+    cold = be.starting_point()
+    try:
+        cand = warm_mod.interior_candidate(ws.state, inf)
+        cand_scaled = scaling.scale_state(cand) if scaling else cand
+        cold_host = be.to_host(cold)
+        merit_w = warm_mod.residual_merit(inf_solve, cand_scaled)
+        merit_c = warm_mod.residual_merit(inf_solve, cold_host)
+        mu_w = warm_mod.state_mu(cand_scaled, inf_solve.u)
+        mu_c = warm_mod.state_mu(cold_host, inf_solve.u)
+        accept = (
+            np.isfinite(merit_w)
+            and np.isfinite(mu_w)
+            and merit_w
+            <= warm_mod.WARM_ACCEPT_FACTOR * max(merit_c, 1e-12)
+            # μ guard: the primal/dual refresh makes even a far-off
+            # prior nearly feasible — complementarity is what still
+            # tells it apart from a useful start.
+            and mu_w <= warm_mod.MU_ACCEPT_FACTOR * max(mu_c, 1e-12)
+        )
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:  # malformed prior (shape drift): cold start
+        accept = False
+    if accept:
+        return be.from_host(cand_scaled), "warm"
+    obs_metrics.get_registry().counter(
+        "warm_start_rejected_total",
+        help="safeguard fallbacks: warm starts whose initial residuals "
+        "regressed past the cold start's",
+    ).inc()
+    return cold, "rejected"
 
 
 def _step_once(be, state):
@@ -334,13 +472,22 @@ def _try_fused(be, state, cfg: SolverConfig, logger: IterLogger):
 def _finalize(
     be, state, status, history, last, solve_time, setup_time,
     inf, original, backend, start_iter, extra_iters=None, scaling=None,
-    presolve_info=None,
+    presolve_info=None, warm_label="cold", on_host_state=None,
 ):
     n_iters = extra_iters if extra_iters is not None else len(history)
-    obs_metrics.get_registry().counter(
+    _reg = obs_metrics.get_registry()
+    _reg.counter(
         "ipm_solves_total", labels={"status": status.value},
         help="finished IPM solves by terminal status",
     ).inc()
+    # Warm-vs-cold attribution: iterations per solve, split by how the
+    # solve started (a safeguard-rejected warm start counts as cold — it
+    # ran the cold trajectory).
+    _reg.histogram(
+        "ipm_iterations", buckets=obs_metrics.ITER_BUCKETS,
+        labels={"start": "warm" if warm_label == "warm" else "cold"},
+        help="IPM iterations per finished solve, by start kind",
+    ).observe(n_iters)
     # One X span per solve on the calling thread's trace lane (reported
     # after the fact: the span covers the just-finished solve loop).
     obs_trace.get_tracer().complete(
@@ -354,6 +501,11 @@ def _finalize(
     host = be.to_host(state)
     if scaling is not None:
         host = scaling.unscale_state(host)
+    if on_host_state is not None:
+        try:  # warm-cache store must never sink the solve
+            on_host_state(status, host)
+        except Exception:
+            pass
     certificate = None
     if status in (
         Status.PRIMAL_INFEASIBLE,
@@ -418,6 +570,7 @@ def _finalize(
         y=y,
         s=s,
         certificate=certificate,
+        warm=warm_label,
     )
 
 

@@ -154,6 +154,11 @@ class IPMResult:
     # Faults survived en route to this result (supervised solves only —
     # supervisor/supervisor.py appends one FaultRecord per recovery).
     faults: List["FaultRecord"] = dataclasses.field(default_factory=list)
+    # How the solve started: "cold" (Mehrotra start / checkpoint resume),
+    # "warm" (a safeguarded WarmStart was accepted), or "rejected" (a
+    # WarmStart was offered but its initial residuals regressed past the
+    # safeguard and the solve fell back to the cold start). See ipm/warm.
+    warm: str = "cold"
 
     @property
     def iters_per_sec(self) -> float:

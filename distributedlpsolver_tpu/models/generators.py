@@ -207,6 +207,59 @@ def random_request_stream(
         yield random_dense_lp(m, n, seed=int(rng.integers(2**31 - 1)))
 
 
+def correlated_request_stream(
+    n_requests: int,
+    shapes=((8, 24), (12, 32)),
+    n_models: int = 4,
+    jitter: float = 0.01,
+    cost_jitter: Optional[float] = None,
+    seed: int = 0,
+    offset: int = 0,
+):
+    """Correlated serve traffic: a few base MODELS re-solved with
+    perturbed b/c — the workload the warm-start & amortization layer
+    exists for (near-duplicate requests, parameterized streams; same A,
+    new b/c, so same-model requests share one structural fingerprint).
+
+    Each of the ``n_models`` base models fixes (A, x0, y0, s0) on a
+    shape drawn from ``shapes``; each request picks a model uniformly
+    and re-derives ``b = A·(x0·(1+jitter·g))`` and
+    ``c = Aᵀ·y0 + s0·(1+cost_jitter·g)`` from jittered witnesses —
+    every instance stays feasible+bounded by construction (the
+    :func:`random_dense_lp` argument), and the perturbation never
+    touches A or the bounds pattern. Fully seeded: the same seed yields
+    the identical stream, models and jitters included; ``offset`` skips
+    the first draws of that stream, so a follow-on wave continues the
+    SAME models with fresh perturbations (the warm-vs-cold probe's
+    steady-state leg).
+    """
+    if cost_jitter is None:
+        cost_jitter = jitter
+    models = []
+    for i in range(n_models):
+        m, n = shapes[i % len(shapes)]
+        mr = np.random.default_rng((seed, 7919, i))
+        A = mr.standard_normal((m, n))
+        x0 = mr.uniform(0.5, 2.0, size=n)
+        y0 = mr.standard_normal(m)
+        s0 = mr.uniform(0.5, 2.0, size=n)
+        models.append((i, A, x0, y0, s0))
+    rng = np.random.default_rng((seed, 104729))
+    for k in range(offset + n_requests):
+        i, A, x0, y0, s0 = models[int(rng.integers(n_models))]
+        m, n = A.shape
+        xk = x0 * (1.0 + jitter * rng.standard_normal(n))
+        sk = np.maximum(s0 * (1.0 + cost_jitter * rng.standard_normal(n)), 0.05)
+        if k < offset:
+            continue
+        b = A @ xk
+        c = A.T @ y0 + sk
+        yield LPProblem(
+            c=c, A=A, rlb=b, rub=b, lb=np.zeros(n), ub=np.full(n, _INF),
+            name=f"corr_m{i}_{m}x{n}_r{k}",
+        )
+
+
 def block_angular_lp(
     num_blocks: int,
     block_m: int,

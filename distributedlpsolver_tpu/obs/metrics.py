@@ -44,6 +44,12 @@ SECONDS_BUCKETS = (
 )
 # Fractions in [0, 1] (padding waste, overlap ratio).
 RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+# IPM iteration counts per solve/request (the warm-vs-cold split rides
+# an {start="warm"|"cold"} label on this histogram).
+ITER_BUCKETS = (
+    1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
+    96.0, 128.0, 200.0,
+)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -90,10 +90,34 @@ def build_report(
     for r in requests:
         s = r.get("status", "?")
         by_status[s] = by_status.get(s, 0) + 1
+    # Warm-vs-cold split (the amortization layer's headline columns):
+    # median iterations-per-request and p50/p99 latency by start kind.
+    # Legacy records carry no "warm" field and count as cold.
+    by_warm: Dict[str, int] = {}
+    warm_iters: List[float] = []
+    cold_iters: List[float] = []
+    warm_lat: List[float] = []
+    cold_lat: List[float] = []
+    for r in requests:
+        wl = r.get("warm") or "cold"
+        by_warm[wl] = by_warm.get(wl, 0) + 1
+        (warm_iters if wl == "warm" else cold_iters).append(
+            float(r.get("iterations", 0))
+        )
+        (warm_lat if wl == "warm" else cold_lat).append(
+            float(r.get("total_ms", 0.0))
+        )
     report["requests"] = {
         "count": len(requests),
         "by_status": by_status,
         "solo_retries": sum(1 for r in requests if r.get("retried_solo")),
+        "warm": {
+            "by_start": by_warm,
+            "iterations_warm": summarize(warm_iters, quantiles=(50, 99)),
+            "iterations_cold": summarize(cold_iters, quantiles=(50, 99)),
+            "latency_ms_warm": summarize(warm_lat, quantiles=(50, 99)),
+            "latency_ms_cold": summarize(cold_lat, quantiles=(50, 99)),
+        },
         "phases": {
             ph: summarize([r.get(ph, 0.0) for r in requests])
             for ph in _REQUEST_PHASES
@@ -259,6 +283,26 @@ def render(report: dict) -> str:
         )
         out.append("per-phase latency (ms):")
         out.extend(_fmt_phase_table(req["phases"]))
+        wm = req.get("warm")
+        if wm and wm["by_start"].get("warm"):
+            out.append(
+                "warm-vs-cold ("
+                + ", ".join(
+                    f"{k}={v}" for k, v in sorted(wm["by_start"].items())
+                )
+                + "):"
+            )
+            out.append(
+                f"  {'start':<12} {'count':>6} {'iters_p50':>10} "
+                f"{'lat_p50':>10} {'lat_p99':>10}"
+            )
+            for kind in ("warm", "cold"):
+                it_s = wm[f"iterations_{kind}"]
+                lat_s = wm[f"latency_ms_{kind}"]
+                out.append(
+                    f"  {kind:<12} {it_s['count']:>6} {it_s['p50']:>10.1f} "
+                    f"{lat_s['p50']:>10.3f} {lat_s['p99']:>10.3f}"
+                )
 
     pb = report["padding_by_bucket"]
     if pb:

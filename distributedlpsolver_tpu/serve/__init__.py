@@ -37,6 +37,7 @@ from distributedlpsolver_tpu.serve.service import (
     SolveService,
     standard_form,
 )
+from distributedlpsolver_tpu.serve.warmcache import WarmCache, WarmEntry
 
 __all__ = [
     "AutotuneConfig",
@@ -52,6 +53,8 @@ __all__ = [
     "ServiceConfig",
     "ServiceOverloaded",
     "SolveService",
+    "WarmCache",
+    "WarmEntry",
     "latency_summary",
     "pad_standard_form",
     "padding_waste",

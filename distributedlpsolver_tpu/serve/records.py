@@ -55,6 +55,10 @@ class RequestResult:
     # batch's device solve (nonzero = the pipeline actually overlapped).
     pack_ms: float = 0.0
     overlap_ms: float = 0.0
+    # Warm-start outcome: "warm" (a cached prior iterate seeded the
+    # solve), "rejected" (a cache hit was offered but the in-program
+    # safeguard fell back to the cold start), "cold" otherwise.
+    warm: str = "cold"
 
     def record(self) -> dict:
         """The JSONL record for this request (x is elided — solutions go
@@ -82,6 +86,7 @@ class RequestResult:
             "dispatch": self.dispatch_index,
             "slot": self.slot,
             "retried_solo": self.retried_solo,
+            "warm": self.warm,
             "faults": [f.asdict() for f in self.faults],
         }
 
@@ -107,9 +112,32 @@ def latency_summary(results: List[RequestResult]) -> dict:
     by_status: dict = {}
     for r in results:
         by_status[r.status.value] = by_status.get(r.status.value, 0) + 1
+    # Warm-vs-cold attribution: iterations-per-request and latency,
+    # split by start kind (the amortization layer's headline figures).
+    warm_rs = [r for r in done if r.warm == "warm"]
+    cold_rs = [r for r in done if r.warm != "warm"]
+    warm_split = {
+        "requests": len(warm_rs),
+        "rejected": sum(1 for r in results if r.warm == "rejected"),
+        "iters_p50_warm": _percentile([r.iterations for r in warm_rs], 50),
+        "iters_p50_cold": _percentile([r.iterations for r in cold_rs], 50),
+        "latency_ms_p50_warm": round(
+            _percentile([r.total_ms for r in warm_rs], 50), 3
+        ),
+        "latency_ms_p99_warm": round(
+            _percentile([r.total_ms for r in warm_rs], 99), 3
+        ),
+        "latency_ms_p50_cold": round(
+            _percentile([r.total_ms for r in cold_rs], 50), 3
+        ),
+        "latency_ms_p99_cold": round(
+            _percentile([r.total_ms for r in cold_rs], 99), 3
+        ),
+    }
     return {
         "requests": len(results),
         "status_breakdown": by_status,
+        "warm": warm_split,
         "latency_ms_p50": round(_percentile(totals, 50), 3),
         "latency_ms_p95": round(_percentile(totals, 95), 3),
         "latency_ms_p99": round(_percentile(totals, 99), 3),

@@ -47,6 +47,9 @@ class PendingRequest:
     t_submit: float
     deadline: Optional[float] = None  # absolute perf_counter() time
     problem: object = None  # general-form LPProblem (solo path only)
+    # Structural fingerprint (utils/fingerprint.structural_fingerprint):
+    # the warm-cache key computed at submit; None = warm start disabled.
+    fp: Optional[str] = None
 
     @property
     def m(self) -> int:

@@ -154,6 +154,13 @@ class ServiceConfig:
     # threads, one lane per thread, instant markers for faults /
     # reshards / ladder swaps. None = inherit the module-default tracer.
     trace_path: Optional[str] = None
+    # Warm-start & amortization layer (serve/warmcache.py): cache each
+    # structural fingerprint's last OPTIMAL iterate and seed
+    # same-structure requests from it (safeguarded in-program; warm and
+    # cold members mix freely in one batch with zero warm recompiles).
+    warm_start: bool = True
+    # Bounded LRU capacity of the fingerprint cache.
+    warm_cache_entries: int = 512
 
 
 def standard_form(problem: LPProblem):
@@ -188,6 +195,17 @@ class _Packed:
     waste: float
     pack_ms: float
     mesh: object = None  # the mesh snapshot this bucket was placed on
+    # Warm-start lanes (backends/batched.place_warm output): prior
+    # iterates per slot + offered mask; None = warm start disabled.
+    warm: object = None  # IPMState of placed (B, ·) arrays
+    warm_mask: object = None  # (B,) device bool mask of offered slots
+    warm_hits: object = None  # host list: cache hit per live slot
+    # Host-side lane arrays kept for the solve stage's LATE lookup: the
+    # pack stage runs pipeline_depth batches ahead of the demux that
+    # stores entries, so a slot that missed at pack may hit by dispatch
+    # time (back-to-back duplicates); the solve stage fills it in and
+    # re-places. IPMState of (B, ·) numpy arrays.
+    warm_host: object = None
 
 
 @dataclasses.dataclass
@@ -286,6 +304,23 @@ class SolveService:
             "serve_fused_iters",
             help="IPM iterations fused per device while-loop trip",
         )
+        # Warm-start & amortization layer: bounded LRU of prior iterates
+        # keyed on structural fingerprints (serve/warmcache.py); the
+        # cache's hit/miss counters land on this same registry.
+        if self.config.warm_start:
+            from distributedlpsolver_tpu.serve.warmcache import WarmCache
+
+            self._warm_cache: Optional[object] = WarmCache(
+                self.config.warm_cache_entries, metrics=m
+            )
+        else:
+            self._warm_cache = None
+        self._m_warm_rejected = m.counter(
+            "warm_start_rejected_total",
+            help="safeguard fallbacks: offered warm starts rejected for "
+            "the cold start",
+        )
+        self._m_iters_by_start: dict = {}  # start label -> histogram
         self._mesh = self._build_mesh(self.config.mesh_devices)  # guarded-by: _lock
         n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
         self.scheduler = Scheduler(  # guarded-by: _lock
@@ -450,6 +485,18 @@ class SolveService:
         compiles its own bucket program once, then shares it.
         """
         sf = standard_form(problem)
+        fp = None
+        if self._warm_cache is not None:
+            from distributedlpsolver_tpu.utils.fingerprint import (
+                structural_fingerprint,
+            )
+
+            # Structural identity on the SUBMIT thread (a hash over A's
+            # bytes — microseconds at request shapes): correlated
+            # requests (same A, new b/c) land on one cache key.
+            fp = structural_fingerprint(
+                problem.A, problem.m, problem.n, problem.lb, problem.ub
+            )
         now = time.perf_counter()
         if deadline is None:
             deadline = self.config.default_deadline_s
@@ -464,6 +511,7 @@ class SolveService:
             t_submit=now,
             deadline=None if deadline is None else now + deadline,
             problem=None if sf else problem,
+            fp=fp,
         )
         with self._wake:
             if self._stopping:
@@ -582,7 +630,11 @@ class SolveService:
         shape, stack, and transfer to the device(s) — sharded over the
         serving mesh's batch axis when one is configured. Runs in the
         pack thread, concurrently with the previous dispatch's solve."""
-        from distributedlpsolver_tpu.backends.batched import place_bucket
+        from distributedlpsolver_tpu.backends.batched import (
+            place_bucket,
+            place_warm,
+        )
+        from distributedlpsolver_tpu.ipm.state import IPMState
         from distributedlpsolver_tpu.models.generators import BatchedLP
 
         spec, tol = key
@@ -598,13 +650,18 @@ class SolveService:
         for k in range(len(live), B):  # inactive slots: well-posed copies
             A[k], b[k], c[k] = A[0], b[0], c[0]
         batch = BatchedLP(c=c, A=A, b=b, name=f"bucket_{spec.m}x{spec.n}")
+        warm_states, warm_mask, warm_hits = self._build_warm_lanes(spec, live)
         # Snapshot: a reshard mid-pipeline only affects later packs; this
         # bucket solves on the mesh it was placed on.
         with self._lock:
             mesh = self._mesh
-        placed, act = place_bucket(
-            batch, active, self.solver_config.replace(tol=tol), mesh=mesh
-        )
+        cfg = self.solver_config.replace(tol=tol)
+        placed, act = place_bucket(batch, active, cfg, mesh=mesh)
+        warm_placed = mask_placed = None
+        if warm_states is not None:
+            warm_placed, mask_placed = place_warm(
+                warm_states, warm_mask, (B, spec.m, spec.n), cfg, mesh=mesh
+            )
         pack_ms = (time.perf_counter() - t0) * 1e3
         return _Packed(
             batch=placed,
@@ -612,6 +669,87 @@ class SolveService:
             waste=padding_waste(sum(p.m * p.n for p in live), spec),
             pack_ms=pack_ms,
             mesh=mesh,
+            warm=warm_placed,
+            warm_mask=mask_placed,
+            warm_hits=warm_hits,
+            warm_host=warm_states,
+        )
+
+    def _build_warm_lanes(self, spec, live: List[PendingRequest]):
+        """Warm lanes for one bucket: look each member's fingerprint up
+        in the cache and pad its prior iterate onto the bucket shape.
+        The pad block's fill (x=1, y=0, s=1) is EXACTLY feasible for the
+        padding scheme's trivial 1x1 sub-LPs, so a warm slot's padded
+        iterate is as interior as its real block. Cache misses leave the
+        slot cold — one dispatch freely mixes both. Returns
+        (host IPMState, mask, hits) or (None, None, None) when the warm
+        layer is disabled."""
+        from distributedlpsolver_tpu.ipm.state import IPMState
+
+        if self._warm_cache is None:
+            return None, None, None
+        B = spec.batch
+        wx = np.ones((B, spec.n))
+        wy = np.zeros((B, spec.m))
+        ws_ = np.ones((B, spec.n))
+        ww = np.ones((B, spec.n))
+        wz = np.zeros((B, spec.n))
+        wm = np.zeros(B, dtype=bool)
+        hits = []
+        for k, p in enumerate(live):
+            entry = self._warm_cache.lookup(p.fp, p.m, p.n) if p.fp else None
+            if entry is not None and entry.state is not None:
+                st = entry.state
+                wx[k, : p.n] = st.x
+                wy[k, : p.m] = st.y
+                ws_[k, : p.n] = st.s
+                ww[k, : p.n] = st.w
+                wz[k, : p.n] = st.z
+                wm[k] = True
+            hits.append(bool(wm[k]))
+        return IPMState(x=wx, y=wy, s=ws_, w=ww, z=wz), wm, hits
+
+    def _late_warm_lookup(self, spec, tol, live, packed, mesh) -> None:
+        """Solve-stage re-lookup for slots that missed the cache at pack
+        time: the pack stage runs pipeline_depth batches AHEAD of the
+        demux that stores entries, so back-to-back same-fingerprint
+        requests would otherwise never warm. Only previously-missed
+        slots are looked up again; a new hit patches the retained host
+        lanes and re-places them (small arrays — a few µs of transfer
+        before the device dispatch)."""
+        from distributedlpsolver_tpu.backends.batched import place_warm
+
+        if (
+            self._warm_cache is None
+            or packed.warm_host is None
+            or packed.warm_hits is None
+        ):
+            return
+        hits = packed.warm_hits
+        if all(h or not p.fp for p, h in zip(live, hits)):
+            return
+        st = packed.warm_host
+        new_hit = False
+        for k, p in enumerate(live):
+            if hits[k] or not p.fp:
+                continue
+            entry = self._warm_cache.lookup(p.fp, p.m, p.n)
+            if entry is not None and entry.state is not None:
+                e = entry.state
+                st.x[k, : p.n] = e.x
+                st.y[k, : p.m] = e.y
+                st.s[k, : p.n] = e.s
+                st.w[k, : p.n] = e.w
+                st.z[k, : p.n] = e.z
+                hits[k] = True
+                new_hit = True
+        if not new_hit:
+            return
+        wm = np.zeros(spec.batch, dtype=bool)
+        wm[: len(hits)] = hits
+        packed.warm, packed.warm_mask = place_warm(
+            st, wm, (spec.batch, spec.m, spec.n),
+            self.solver_config.replace(tol=tol), mesh=mesh,
         )
 
     def _overlap_ms(self, t1: float, t2: float) -> float:
@@ -714,6 +852,7 @@ class SolveService:
         batch, active, mesh = packed.batch, packed.active, packed.mesh
         cfg = self.solver_config.replace(tol=tol)
         waste = packed.waste
+        self._late_warm_lookup(spec, tol, live, packed, mesh)
         with self._lock:
             seq = self._dispatch_seq
             self._dispatch_seq += 1
@@ -760,7 +899,10 @@ class SolveService:
                         self._compiles += new_programs
 
                 def _solve():
-                    return solve_bucket(batch, active, cfg, mesh=mesh)
+                    return solve_bucket(
+                        batch, active, cfg, mesh=mesh,
+                        warm=packed.warm, warm_mask=packed.warm_mask,
+                    )
 
                 res = run_with_deadline(
                     _solve, self.config.batch_timeout_s, seq
@@ -829,6 +971,11 @@ class SolveService:
             f"{r['engine']}@{r['tol']:g}" for r in sched_rows
         ) or None
         fused_k = res.fused_iters if res is not None else None
+        n_warm = (
+            int(np.sum(res.warm_used[: len(live)]))
+            if res is not None and res.warm_used is not None
+            else 0
+        )
         for r in sched_rows:
             ctr = self._m_phase_iters.get(r["engine"])
             if ctr is None:
@@ -864,6 +1011,7 @@ class SolveService:
                     "overlap_ms": round(overlap_ms, 3),
                     "schedule": schedule_str,
                     "fused_iters": fused_k,
+                    "warm": n_warm,
                     "mesh_devices": (
                         int(mesh.devices.size) if mesh is not None else 1
                     ),
@@ -884,6 +1032,7 @@ class SolveService:
                 "overlap_ms": round(overlap_ms, 3),
                 "schedule": schedule_str,
                 "fused_iters": fused_k,
+                "warm": n_warm,
                 "mesh_devices": (
                     int(mesh.devices.size) if mesh is not None else 1
                 ),
@@ -902,8 +1051,28 @@ class SolveService:
             return
 
         solve_ms = res.solve_time * 1e3
+        hits = packed.warm_hits or []
         for k, p in enumerate(live):
             status = res.status[k]
+            # Warm-start outcome per member: offered (cache hit at pack)
+            # × accepted (the in-program safeguard's verdict).
+            offered = bool(hits[k]) if k < len(hits) else False
+            used = (
+                bool(res.warm_used[k]) if res.warm_used is not None else False
+            )
+            warm_label = "warm" if used else ("rejected" if offered else "cold")
+            if offered and not used:
+                self._m_warm_rejected.inc()
+            start = "warm" if used else "cold"
+            hist = self._m_iters_by_start.get(start)
+            if hist is None:
+                hist = self.metrics.histogram(
+                    "ipm_iterations", buckets=obs_metrics.ITER_BUCKETS,
+                    labels={"start": start},
+                    help="IPM iterations per finished solve, by start kind",
+                )
+                self._m_iters_by_start[start] = hist
+            hist.observe(int(res.iterations[k]))
             if status is not Status.OPTIMAL and self.config.solo_recovery:
                 member_fault = FaultRecord(
                     FaultKind.NUMERICAL,
@@ -916,6 +1085,23 @@ class SolveService:
                     p, key, t_dispatch, faults + [member_fault], retried=True
                 )
                 continue
+            if p.fp and self._warm_cache is not None and res.y is not None:
+                # Amortize: this member's full iterate (real slice only —
+                # pads are re-synthesized at pack time) seeds the next
+                # same-fingerprint request.
+                from distributedlpsolver_tpu.ipm.state import IPMState
+
+                self._warm_cache.store(
+                    p.fp, m=p.m, n=p.n,
+                    state=IPMState(
+                        x=res.x[k, : p.n].copy(),
+                        y=res.y[k, : p.m].copy(),
+                        s=res.s[k, : p.n].copy(),
+                        w=res.w[k, : p.n].copy(),
+                        z=res.z[k, : p.n].copy(),
+                    ),
+                    tol=tol,
+                )
             x_real = res.x[k, : p.n]
             done = time.perf_counter()
             self._finish(
@@ -950,6 +1136,7 @@ class SolveService:
                     n=p.n,
                     pack_ms=packed.pack_ms,
                     overlap_ms=overlap_ms,
+                    warm=warm_label,
                 ),
             )
 
@@ -991,9 +1178,13 @@ class SolveService:
                     backend=self.config.solo_backend,
                     config=cfg,
                     supervisor=SupervisorConfig(backoff_base=0.01),
+                    warm_cache=self._warm_cache,
                 )
             else:
-                r = solve(problem, backend=self.config.solo_backend, config=cfg)
+                r = solve(
+                    problem, backend=self.config.solo_backend, config=cfg,
+                    warm_cache=self._warm_cache,
+                )
             status, faults = r.status, faults + list(r.faults)
         except (KeyboardInterrupt, SystemExit):
             raise
@@ -1033,6 +1224,7 @@ class SolveService:
                 t_done=done,
                 m=p.m,
                 n=p.n,
+                warm=r.warm if r is not None else "cold",
             ),
         )
 
@@ -1353,6 +1545,11 @@ class SolveService:
             "occupancy": occupancy,
             "dispatches": dispatches,
             "programs_compiled": compiles,
+            "warm_cache": (
+                self._warm_cache.stats()
+                if self._warm_cache is not None
+                else None
+            ),
             "mesh_devices": self.mesh_devices,
             "pack_ms_total": round(pack_total, 3),
             "overlap_ms_total": round(overlap_total, 3),

@@ -290,6 +290,7 @@ def supervised_solve(
     config: Optional[SolverConfig] = None,
     supervisor: Optional[SupervisorConfig] = None,
     warm_start=None,
+    warm_cache=None,
     **config_overrides,
 ) -> IPMResult:
     """Solve under the supervisor; same contract as ``ipm.solve`` plus
@@ -298,6 +299,12 @@ def supervised_solve(
     recovery ladder and retry budget are exhausted. Terminal non-OPTIMAL
     statuses that are *answers* (infeasible, unbounded, iteration limit)
     return as-is — only faults trigger recovery.
+
+    ``warm_start``/``warm_cache`` thread straight through to
+    ``ipm.solve`` (ipm/warm.py): the first attempt may start from a
+    safeguarded prior iterate; retries always resume via the rollback
+    checkpoint instead (a warm start implicated in a numerical fault
+    must not be re-offered).
     """
     from distributedlpsolver_tpu.backends.base import get_backend
 
@@ -383,6 +390,7 @@ def supervised_solve(
                     config=attempt_cfg,
                     warm_start=warm_start,
                     hooks=hooks,
+                    warm_cache=warm_cache,
                 )
                 if result.status is not Status.NUMERICAL_ERROR:
                     result.faults = faults
@@ -429,6 +437,7 @@ def supervised_solve(
             faults.append(fault)
             pending = fault
             warm_start = None  # retries resume via the rollback checkpoint
+            warm_cache = None  # and never re-offer a fault-implicated warm start
 
             if len(faults) > sup.max_retries:
                 fault.action = "give_up"

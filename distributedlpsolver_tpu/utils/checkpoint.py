@@ -28,7 +28,6 @@ shape fields) checkpoints still load.
 
 from __future__ import annotations
 
-import hashlib
 import os
 import tempfile
 import warnings
@@ -38,6 +37,12 @@ import numpy as np
 
 from distributedlpsolver_tpu.ipm.state import IPMState
 
+# One fingerprint definition for the whole repo (utils/fingerprint.py):
+# checkpoints and the warm cache must agree on what "same problem" means.
+from distributedlpsolver_tpu.utils.fingerprint import (  # noqa: F401
+    problem_fingerprint,
+)
+
 CKPT_FORMAT_VERSION = 3
 
 
@@ -45,16 +50,6 @@ class CheckpointMismatch(RuntimeError):
     """Checkpoint belongs to a different problem (fingerprint conflict),
     is internally inconsistent (v3 shape fields vs stored arrays), or was
     written by a newer, unreadable format version."""
-
-
-def problem_fingerprint(inf) -> str:
-    """Stable identity of an interior-form problem: (m, n) plus a SHA-256
-    over the c and b bytes (f64-normalized so dtype does not perturb it)."""
-    h = hashlib.sha256()
-    h.update(f"{int(inf.m)}x{int(inf.n)}".encode())
-    for v in (inf.c, inf.b):
-        h.update(np.ascontiguousarray(np.asarray(v, dtype=np.float64)).tobytes())
-    return h.hexdigest()[:16]
 
 
 def save_state(

@@ -327,9 +327,11 @@ class TestServiceReconciliation:
             == stats["dispatches"]
         )
         # per-phase percentiles agree with the service's own summary
-        # (same shared implementation, same data)
+        # (same shared implementation, same data; abs tolerance covers
+        # the record()-side round(…, 3) against stats' raw floats — an
+        # interpolated even-count p50 can differ by up to 5e-4 ms)
         assert rep["requests"]["phases"]["total_ms"]["p50"] \
-            == pytest.approx(stats["latency_ms_p50"], rel=1e-6)
+            == pytest.approx(stats["latency_ms_p50"], rel=1e-6, abs=1e-3)
 
         # the summary event embeds the metrics snapshot (self-describing
         # stream), and its counters reconcile as well
