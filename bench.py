@@ -23,6 +23,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -429,6 +430,114 @@ def _bench_serve(quick: bool) -> dict:
         f"fused_iters={row['fused_iters']}"
     )
     row["warm_start"] = _bench_serve_warm(quick)
+    return row
+
+
+def _bench_serve_http(quick: bool, inproc_row: Optional[dict] = None) -> dict:
+    """HTTP-path serving row: the same steady-state request stream as
+    the in-process serve row, but submitted over the network plane
+    (POST /v1/solve against a SolveHTTPServer on localhost) from
+    concurrent client threads — so the network overhead (HTTP parse,
+    JSON encode, socket round-trip, handler-thread dispatch) is
+    attributed as the delta against the in-process row's figures."""
+    import json as _json
+    import threading as _threading
+    import urllib.request as _urlreq
+
+    from distributedlpsolver_tpu.backends.batched import bucket_cache_size
+    from distributedlpsolver_tpu.models.generators import random_request_stream
+    from distributedlpsolver_tpu.net import NetConfig, SolveHTTPServer
+    from distributedlpsolver_tpu.obs.stats import percentile
+    from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+
+    n = 48 if quick else 200
+    with SolveService(ServiceConfig(batch=8, flush_s=0.02)) as svc:
+        server = SolveHTTPServer(svc, NetConfig()).start()
+        # Cold wave in-process: warm every bucket program so the HTTP
+        # wave measures the network path, not XLA.
+        futs = [svc.submit(p) for p in random_request_stream(n, seed=21)]
+        svc.drain(timeout=1200)
+        for f in futs:
+            f.result(timeout=60)
+        cache0 = bucket_cache_size()
+
+        problems = list(random_request_stream(n, seed=22))
+        lat: list = []
+        codes: list = []
+        lock = _threading.Lock()
+
+        def client(idx0, step):
+            for i in range(idx0, n, step):
+                p = problems[i]
+                body = _json.dumps(
+                    {
+                        "problem": {
+                            "c": p.c.tolist(),
+                            "A": p.A.tolist(),
+                            "b": p.rlb.tolist(),
+                        },
+                        "include_x": False,
+                    }
+                ).encode()
+                req = _urlreq.Request(
+                    server.url + "/v1/solve", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                t0 = time.perf_counter()
+                try:
+                    with _urlreq.urlopen(req, timeout=120) as r:
+                        out = _json.loads(r.read())
+                    code = 200 if out.get("status") == "optimal" else -1
+                except Exception:
+                    code = -2
+                with lock:
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                    codes.append(code)
+
+        n_clients = 8
+        t0 = time.perf_counter()
+        threads = [
+            _threading.Thread(target=client, args=(i, n_clients))
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1200)
+        wall = time.perf_counter() - t0
+        warm_recompiles = bucket_cache_size() - cache0
+        server.shutdown()
+    row = {
+        "backend": "serve-http(localhost HTTP front-end)",
+        "requests": n,
+        "optimal": sum(c == 200 for c in codes),
+        "clients": n_clients,
+        "time_s": round(wall, 4),
+        "rps": round(n / max(wall, 1e-9), 2),
+        "latency_ms_p50": round(percentile(lat, 50), 3),
+        "latency_ms_p99": round(percentile(lat, 99), 3),
+        "warm_recompiles": int(warm_recompiles),
+        "tol": 1e-8,
+    }
+    if inproc_row:
+        # Network overhead, attributed: the HTTP row against the
+        # in-process row it rode next to.
+        row["inproc_rps"] = inproc_row["rps"]
+        row["http_overhead_ms_p50"] = round(
+            row["latency_ms_p50"] - inproc_row["latency_ms_p50"], 3
+        )
+    _log(
+        f"  serve-http: {n} requests at {row['rps']} rps over "
+        f"{n_clients} clients, p50={row['latency_ms_p50']:.0f}ms "
+        f"p99={row['latency_ms_p99']:.0f}ms, warm recompiles="
+        f"{warm_recompiles}"
+        + (
+            f", in-process rps={row['inproc_rps']} "
+            f"(http p50 overhead {row['http_overhead_ms_p50']:+.1f}ms)"
+            if inproc_row
+            else ""
+        )
+    )
     return row
 
 
@@ -943,6 +1052,11 @@ def main() -> int:
     ap.add_argument("--serve", action="store_true",
                     help="serving-throughput row only (rps, p50/p99, "
                     "padding waste, warm recompiles) as the stdout JSON line")
+    ap.add_argument("--serve-http", action="store_true",
+                    help="serving rows incl. the HTTP network plane: the "
+                    "in-process row plus a localhost POST /v1/solve row, "
+                    "so network overhead is attributed (README 'Network "
+                    "serving')")
     # "tpu" (the north-star backend name, BASELINE.json:5) — the dense
     # two-phase path, which measures fastest on the headline config
     # (0.72 s vs 0.90 s via the Schur backend, whose per-iteration flop
@@ -997,11 +1111,15 @@ def main() -> int:
 
     _obs_enable()
 
-    if args.serve:
+    if args.serve or args.serve_http:
         row = _bench_serve(args.quick)
         row["platform"] = args.platform
         row["metrics"] = _obs_row(args.platform)
         print(json.dumps(row))
+        if args.serve_http:
+            http_row = _bench_serve_http(args.quick, inproc_row=row)
+            http_row["platform"] = args.platform
+            print(json.dumps(http_row))
         return 0  # serve tier is its own run; no headline solve after
 
     if args.scale:

@@ -26,6 +26,22 @@ HOT_SCOPES = {
         "solve",
         "_step_once",
     },
+    # Network serving plane thread bodies: the router's poll loop and
+    # forward path run concurrently with every backend's pipeline, and
+    # the HTTP front-end's handler/health threads must never touch a
+    # device value (all device work stays on the service's pipeline
+    # threads — a sync here would serialize handler threads behind it).
+    "net/router.py": {
+        "Router._poll_loop",
+        "Router.poll_once",
+        "Router._record_probe",
+        "Router.forward",
+    },
+    "net/server.py": {
+        "SolveHTTPServer.health",
+        "_Handler.do_POST",
+        "_Handler.do_GET",
+    },
 }
 
 # -- jit-donate (rules_jit) --------------------------------------------------
@@ -96,6 +112,14 @@ JSONL_EVENT_TYPES = {
     "service",
     "warmup",
     "warmup_error",
+    # Network serving plane (net/): one record per HTTP request on a
+    # front-end, per routed forward on the router, and per backend
+    # rotation change (ejection on failed health / forward, re-admission
+    # on recovery).
+    "http_request",
+    "route",
+    "backend_ejected",
+    "backend_readmitted",
 }
 
 # Every field a stamped JSONL record may carry, across all streams: the
@@ -167,6 +191,22 @@ JSONL_FIELDS = {
     # "warm"/"rejected"/"cold" start label, batch events the number of
     # warm-started slots (serve/service.py, serve/records.py)
     "warm",
+    # network serving plane (net/): http_request records (method/path/
+    # code/ms), admission-verdict reject records (tenant/priority/
+    # reason/retry_after_s), router route records (backend/padding/
+    # retried) and rotation events (fails), and the summary event's
+    # per-tenant admission table
+    "admission",
+    "code",
+    "fails",
+    "method",
+    "ms",
+    "path",
+    "priority",
+    "reason",
+    "retried",
+    "retry_after_s",
+    "tenant",
     # supervisor fault/resume events (supervisor/supervisor.py)
     "backend",
     "iteration",

@@ -1139,13 +1139,37 @@ def bucket_donation_report(
         # Force a REAL compile: an executable deserialized from the
         # persistent compilation cache (the package enables one by
         # default) reports zero alias/temp figures, which would read as
-        # "donation silently copied" when the donation is fine.
+        # "donation silently copied" when the donation is fine. Neither
+        # the enable flag nor unsetting the cache dir is enough on this
+        # jax version once the cache backend singleton has initialized
+        # (observed: a populated .tpulp_xla_cache still served the
+        # deserialized executable under enable=False) — the singleton
+        # must be RESET so the compile re-resolves the (now disabled)
+        # config, and reset again afterwards so later compiles re-init
+        # with the restored dir.
         prev = jax.config.jax_enable_compilation_cache
+        prev_dir = jax.config.jax_compilation_cache_dir
+        try:
+            from jax._src import compilation_cache as _cc
+        except ImportError:  # private API moved: degrade to flag-only
+            _cc = None
+
+        def _reset_cc():
+            if _cc is not None:
+                try:
+                    _cc.reset_cache()
+                except Exception:
+                    pass
+
         jax.config.update("jax_enable_compilation_cache", False)
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_cc()
         try:
             ma = lowered.compile().memory_analysis()
         finally:
             jax.config.update("jax_enable_compilation_cache", prev)
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            _reset_cc()
     except Exception:
         return None
     if ma is None:

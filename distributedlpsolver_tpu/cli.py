@@ -5,12 +5,16 @@ selection via ``--backend=``, BASELINE.json:5), loads the problem, runs
 the solver, and reports iterations/gap/wall-clock (the published metric
 surface, BASELINE.json:2). Subcommands:
 
-    solve      solve an MPS file (or a generated problem) to tolerance
-    serve      async batching solve service (JSONL/MPS requests in)
-    autotune   refine a serve bucket ladder from telemetry JSONL
-    check      graftcheck static-analysis suite (the tier-1 CI gate)
-    backends   list registered SolverBackend names
-    generate   write a generated benchmark problem to MPS
+    solve       solve an MPS file (or a generated problem) to tolerance
+    serve       async batching solve service (JSONL/MPS requests in)
+    serve-http  HTTP front-end over the solve service (POST /v1/solve,
+                /metrics, /healthz, /statusz; README "Network serving")
+    route       router tier over serve-http backends (shape/load-aware
+                routing, health-checked failover)
+    autotune    refine a serve bucket ladder from telemetry JSONL
+    check       graftcheck static-analysis suite (the tier-1 CI gate)
+    backends    list registered SolverBackend names
+    generate    write a generated benchmark problem to MPS
 
 Run as ``python -m distributedlpsolver_tpu.cli ...``.
 """
@@ -293,27 +297,57 @@ def _iter_request_specs(args):
             fh.close()
 
 
-def cmd_serve(args) -> int:
-    """Serve loop: read LP requests, multiplex them through the async
-    batching SolveService, write one JSONL result record per request."""
-    import time
-
-    from distributedlpsolver_tpu.io.mps import read_mps
-    from distributedlpsolver_tpu.models.generators import random_dense_lp
-    from distributedlpsolver_tpu.serve import (
-        ServiceConfig,
-        ServiceOverloaded,
-        SolveService,
-        ladder_from_json,
+def _admission_from(args):
+    """AdmissionConfig from ``--quotas`` (inline JSON or ``@file``):
+    ``{"tenants": {"acme": {"rate": 10, "burst": 20, "weight": 2}},
+    "default": {...}, "fair_start": 0.5}``. None when the flag is
+    absent — the classic depth-only admission."""
+    spec = getattr(args, "quotas", None)
+    if not spec:
+        return None
+    from distributedlpsolver_tpu.net.admission import (
+        AdmissionConfig,
+        TenantQuota,
     )
 
-    _apply_jax_cache(args)
-    finalize_obs = _obs_setup(args)
+    if spec.startswith("@"):
+        with open(spec[1:]) as fh:
+            spec = fh.read()
+    cfg = json.loads(spec)
+
+    def _quota(d: dict) -> TenantQuota:
+        return TenantQuota(
+            rate=float(d.get("rate", float("inf"))),
+            burst=float(d.get("burst", float("inf"))),
+            weight=float(d.get("weight", 1.0)),
+        )
+
+    kwargs = {
+        "quotas": {
+            t: _quota(q) for t, q in (cfg.get("tenants") or {}).items()
+        },
+    }
+    if "default" in cfg:
+        kwargs["default_quota"] = _quota(cfg["default"])
+    if "fair_start" in cfg:
+        kwargs["fair_start"] = float(cfg["fair_start"])
+    if "priority_flush_scale" in cfg:
+        kwargs["priority_flush_scale"] = {
+            k: float(v) for k, v in cfg["priority_flush_scale"].items()
+        }
+    return AdmissionConfig(**kwargs)
+
+
+def _service_config_from(args) -> "ServiceConfig":
+    """The ServiceConfig both ``serve`` and ``serve-http`` build from
+    the shared serving flags."""
+    from distributedlpsolver_tpu.serve import ServiceConfig, ladder_from_json
+
     buckets = None
     if args.buckets:
         with open(args.buckets) as fh:
             buckets = ladder_from_json(fh.read())
-    svc_cfg = ServiceConfig(
+    return ServiceConfig(
         buckets=buckets,
         batch=args.batch,
         flush_s=args.flush_ms / 1e3,
@@ -323,9 +357,29 @@ def cmd_serve(args) -> int:
         mesh_devices=args.mesh_devices,
         warm_start=not args.no_warm_start,
         warm_cache_entries=args.warm_cache_entries,
+        admission=_admission_from(args),
     )
+
+
+def cmd_serve(args) -> int:
+    """Serve loop: read LP requests, multiplex them through the async
+    batching SolveService, write one JSONL result record per request."""
+    import time
+
+    from distributedlpsolver_tpu.io.mps import read_mps
+    from distributedlpsolver_tpu.models.generators import random_dense_lp
+    from distributedlpsolver_tpu.serve import (
+        ServiceOverloaded,
+        SolveService,
+    )
+
+    _apply_jax_cache(args)
+    finalize_obs = _obs_setup(args)
+    svc_cfg = _service_config_from(args)
     out = sys.stdout if args.out == "-" else open(args.out, "w")
     n_failed = 0
+    backoffs = 0
+    backoff_s = 0.0
     try:
         with SolveService(svc_cfg, solver_config=_config_from(args).replace(
             verbose=False
@@ -346,13 +400,20 @@ def cmd_serve(args) -> int:
                             deadline=spec.get("deadline_s"),
                             tol=spec.get("tol"),
                             name=str(spec.get("id", problem.name)),
+                            tenant=str(spec.get("tenant", "default")),
+                            priority=str(spec.get("priority", "normal")),
                         )
                         break
-                    except ServiceOverloaded:
+                    except ServiceOverloaded as e:
                         # Backpressure: the reader outran the solver.
-                        # Block until the queue drains a little instead
-                        # of crashing mid-stream.
-                        time.sleep(svc_cfg.flush_s)
+                        # The admission verdict says exactly how long a
+                        # retry is pointless for THIS tenant (token
+                        # refill / drain window) — sleep that, not a
+                        # blind flush tick.
+                        wait = max(e.retry_after_s, 1e-3)
+                        backoffs += 1
+                        backoff_s += wait
+                        time.sleep(wait)
                 submitted.append(fut)
             svc.drain()
             from distributedlpsolver_tpu.utils.logging import stamp_record
@@ -364,12 +425,120 @@ def cmd_serve(args) -> int:
                 # as every IterLogger stream (cli report merges both).
                 out.write(json.dumps(stamp_record(r.record())) + "\n")
             out.flush()
-            print(json.dumps(svc.stats()), file=sys.stderr)
+            # The summary surfaces rejects: the service stats carry the
+            # per-tenant admission table (admitted / rejected-by-reason),
+            # and the client-side backoff loop reports how often (and
+            # how long) submission was shed back onto it.
+            summary = {
+                **svc.stats(),
+                "submit_backoffs": backoffs,
+                "submit_backoff_s": round(backoff_s, 3),
+            }
+            print(json.dumps(summary), file=sys.stderr)
     finally:
         if out is not sys.stdout:
             out.close()
         finalize_obs()
     return 2 if n_failed else 0
+
+
+def cmd_serve_http(args) -> int:
+    """HTTP front-end: bind a SolveHTTPServer over one SolveService and
+    serve until interrupted (README "Network serving")."""
+    from distributedlpsolver_tpu.net import NetConfig, SolveHTTPServer
+    from distributedlpsolver_tpu.obs import metrics as obs_metrics
+    from distributedlpsolver_tpu.serve import SolveService
+
+    _apply_jax_cache(args)
+    finalize_obs = _obs_setup(args)
+    svc_cfg = _service_config_from(args)
+    net_cfg = NetConfig(
+        host=args.host,
+        port=args.port,
+        max_wait_s=args.max_wait_s,
+        wedge_s=args.wedge_s,
+        log_jsonl=args.net_log_jsonl,
+    )
+    # A serving process ADVERTISES /metrics, so it always gets a live
+    # registry — the zero-cost NULL default is for the in-process
+    # library path, not a front-end whose scrape surface would
+    # otherwise be permanently empty. --metrics-path (via _obs_setup)
+    # installed a process-wide registry already; reuse it so the
+    # shutdown snapshot and the scrape agree.
+    reg = obs_metrics.get_registry()
+    if not reg.enabled:
+        reg = obs_metrics.MetricsRegistry()
+    try:
+        with SolveService(
+            svc_cfg,
+            solver_config=_config_from(args).replace(verbose=False),
+            metrics=reg,
+        ) as svc:
+            server = SolveHTTPServer(svc, net_cfg).start()
+            print(
+                f"serving on {server.url} "
+                f"(POST /v1/solve; GET /metrics /healthz /statusz)",
+                file=sys.stderr,
+            )
+            try:
+                import threading
+
+                threading.Event().wait()  # serve until SIGINT
+            except KeyboardInterrupt:
+                print("shutting down", file=sys.stderr)
+            finally:
+                server.shutdown()
+    finally:
+        finalize_obs()
+    return 0
+
+
+def cmd_route(args) -> int:
+    """Router tier: health-checked, shape/load-aware routing over
+    serve-http backends (README "Network serving")."""
+    from distributedlpsolver_tpu.net.router import (
+        Router,
+        RouterConfig,
+        RouterHTTPServer,
+    )
+    from distributedlpsolver_tpu.obs import metrics as obs_metrics
+
+    finalize_obs = _obs_setup(args)
+    # Same as serve-http: a router process advertises /metrics, so it
+    # always runs with a live registry.
+    reg = obs_metrics.get_registry()
+    if not reg.enabled:
+        reg = obs_metrics.MetricsRegistry()
+    router = Router(
+        args.backend,
+        RouterConfig(
+            poll_s=args.poll_s,
+            eject_after=args.eject_after,
+            log_jsonl=args.log_jsonl,
+        ),
+        metrics=reg,
+    )
+    try:
+        router.start()
+        server = RouterHTTPServer(router, host=args.host, port=args.port)
+        server.start()
+        print(
+            f"routing on {server.url} over {len(args.backend)} backends "
+            f"({router.healthy_count()} healthy)",
+            file=sys.stderr,
+        )
+        try:
+            import threading
+
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            print("shutting down", file=sys.stderr)
+        finally:
+            server.shutdown()
+    finally:
+        router.shutdown()
+        finalize_obs()
+    return 0
 
 
 def cmd_autotune(args) -> int:
@@ -493,6 +662,51 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_solver_flags(ap_solve)
     ap_solve.set_defaults(fn=cmd_solve)
 
+    def _add_serving_flags(p) -> None:
+        p.add_argument("--batch", type=int, default=16, help="bucket slots")
+        p.add_argument(
+            "--flush-ms", type=float, default=50.0,
+            help="oldest-request age that launches a part-full bucket "
+            "(priority classes shade this per request when --quotas "
+            "enables the SLO-aware admission layer)",
+        )
+        p.add_argument(
+            "--queue-depth", type=int, default=1024,
+            help="admission-control bound on total queued requests "
+            "(the global backstop beneath per-tenant quotas)",
+        )
+        p.add_argument(
+            "--deadline-s", type=float, default=0.0,
+            help="default per-request deadline (0 = none)",
+        )
+        p.add_argument(
+            "--mesh-devices", type=int, default=0,
+            help="shard each bucket dispatch's batch axis over this many "
+            "local devices (0/1 = unsharded, -1 = all local devices)",
+        )
+        p.add_argument(
+            "--buckets", default=None,
+            help="explicit bucket ladder JSON (the `autotune` output) "
+            "instead of auto power-of-two buckets",
+        )
+        p.add_argument(
+            "--no-warm-start", action="store_true",
+            help="disable the warm-start & amortization layer (fingerprint "
+            "cache + safeguarded warm-started IPM for correlated requests; "
+            "README 'Warm-start & amortization')",
+        )
+        p.add_argument(
+            "--warm-cache-entries", type=int, default=512,
+            help="bounded LRU capacity of the problem-fingerprint warm cache",
+        )
+        p.add_argument(
+            "--quotas", default=None,
+            help="SLO-aware admission policy, inline JSON or @file: "
+            '{"tenants": {"acme": {"rate": 10, "burst": 20, '
+            '"weight": 2}}, "default": {...}, "fair_start": 0.5} '
+            "(README 'Network serving')",
+        )
+
     ap_srv = sub.add_parser(
         "serve",
         help="async batching solve service: JSONL/MPS requests in, "
@@ -506,41 +720,66 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--dir", help="directory of *.mps requests and/or *.jsonl spec files"
     )
     ap_srv.add_argument("--out", default="-", help="result JSONL path ('-' = stdout)")
-    ap_srv.add_argument("--batch", type=int, default=16, help="bucket slots")
-    ap_srv.add_argument(
-        "--flush-ms", type=float, default=50.0,
-        help="oldest-request age that launches a part-full bucket",
-    )
-    ap_srv.add_argument(
-        "--queue-depth", type=int, default=1024,
-        help="admission-control bound on total queued requests",
-    )
-    ap_srv.add_argument(
-        "--deadline-s", type=float, default=0.0,
-        help="default per-request deadline (0 = none)",
-    )
-    ap_srv.add_argument(
-        "--mesh-devices", type=int, default=0,
-        help="shard each bucket dispatch's batch axis over this many "
-        "local devices (0/1 = unsharded, -1 = all local devices)",
-    )
-    ap_srv.add_argument(
-        "--buckets", default=None,
-        help="explicit bucket ladder JSON (the `autotune` output) "
-        "instead of auto power-of-two buckets",
-    )
-    ap_srv.add_argument(
-        "--no-warm-start", action="store_true",
-        help="disable the warm-start & amortization layer (fingerprint "
-        "cache + safeguarded warm-started IPM for correlated requests; "
-        "README 'Warm-start & amortization')",
-    )
-    ap_srv.add_argument(
-        "--warm-cache-entries", type=int, default=512,
-        help="bounded LRU capacity of the problem-fingerprint warm cache",
-    )
+    _add_serving_flags(ap_srv)
     _add_solver_flags(ap_srv)
     ap_srv.set_defaults(fn=cmd_serve, quiet=True)
+
+    ap_http = sub.add_parser(
+        "serve-http",
+        help="HTTP front-end over the solve service: POST /v1/solve, "
+        "GET /metrics /healthz /statusz (README 'Network serving')",
+    )
+    ap_http.add_argument("--host", default="127.0.0.1")
+    ap_http.add_argument(
+        "--port", type=int, default=8080,
+        help="bind port (0 = OS-assigned ephemeral)",
+    )
+    ap_http.add_argument(
+        "--max-wait-s", type=float, default=300.0,
+        help="sync-POST wait bound for requests without a deadline",
+    )
+    ap_http.add_argument(
+        "--wedge-s", type=float, default=30.0,
+        help="queued depth with zero dispatch progress for this long "
+        "flips /healthz unhealthy",
+    )
+    ap_http.add_argument(
+        "--net-log-jsonl", default=None,
+        help="http_request JSONL event stream (stamped schema)",
+    )
+    _add_serving_flags(ap_http)
+    _add_solver_flags(ap_http)
+    ap_http.set_defaults(fn=cmd_serve_http, quiet=True)
+
+    ap_rt = sub.add_parser(
+        "route",
+        help="router tier over serve-http backends: shape/load-aware "
+        "routing, health-checked failover (README 'Network serving')",
+    )
+    ap_rt.add_argument(
+        "--backend", action="append", required=True,
+        help="backend base URL (repeatable), e.g. http://10.0.0.2:8080",
+    )
+    ap_rt.add_argument("--host", default="127.0.0.1")
+    ap_rt.add_argument(
+        "--port", type=int, default=8079,
+        help="bind port (0 = OS-assigned ephemeral)",
+    )
+    ap_rt.add_argument(
+        "--poll-s", type=float, default=1.0,
+        help="backend health/status poll cadence",
+    )
+    ap_rt.add_argument(
+        "--eject-after", type=int, default=2,
+        help="consecutive failed health probes before ejection",
+    )
+    ap_rt.add_argument(
+        "--log-jsonl", default=None,
+        help="route/ejection JSONL event stream (stamped schema)",
+    )
+    ap_rt.add_argument("--metrics-path", default=None, help=argparse.SUPPRESS)
+    ap_rt.add_argument("--trace-path", default=None, help=argparse.SUPPRESS)
+    ap_rt.set_defaults(fn=cmd_route)
 
     ap_at = sub.add_parser(
         "autotune",

@@ -1,0 +1,54 @@
+"""Network serving plane over :class:`~distributedlpsolver_tpu.serve.
+SolveService` (README "Network serving").
+
+Three layers, all stdlib-only (``http.server`` + ``json`` — no new
+dependencies):
+
+- **Front-end** (:mod:`net.server`, :mod:`net.protocol`): an HTTP
+  surface — ``POST /v1/solve`` (sync or async-poll), ``GET
+  /v1/solve/{id}``, ``GET /metrics`` (Prometheus text off the obs
+  registry), ``GET /healthz`` (device probes + pipeline liveness), and
+  ``GET /statusz`` — bridging request bodies onto ``SolveService.submit``
+  futures.
+- **SLO-aware admission** (:mod:`net.admission`): per-tenant token-bucket
+  quotas, weighted-fair admission under contention, and priority classes
+  that shade the scheduler's flush window; verdicts ride
+  :class:`~distributedlpsolver_tpu.serve.ServiceOverloaded` out to the
+  429 path.
+- **Router tier** (:mod:`net.router`): a front process holding a live
+  backend registry — shape-aware routing onto each backend's advertised
+  bucket ladder, load-aware tie-breaking from polled ``/statusz``,
+  health-checked failover with retry-once semantics.
+"""
+
+from distributedlpsolver_tpu.net.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    TenantQuota,
+    Verdict,
+)
+from distributedlpsolver_tpu.net.protocol import (
+    ProtocolError,
+    SolveRequest,
+    parse_solve_request,
+    peek_route_hint,
+    result_payload,
+)
+from distributedlpsolver_tpu.net.router import Router, RouterConfig
+from distributedlpsolver_tpu.net.server import NetConfig, SolveHTTPServer
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "NetConfig",
+    "ProtocolError",
+    "Router",
+    "RouterConfig",
+    "SolveHTTPServer",
+    "SolveRequest",
+    "TenantQuota",
+    "Verdict",
+    "parse_solve_request",
+    "peek_route_hint",
+    "result_payload",
+]

@@ -1,0 +1,277 @@
+"""SLO-aware admission control: per-tenant token buckets, weighted-fair
+shares, and priority classes.
+
+This replaces the service's one global ``max_queue_depth`` knob as the
+*policy* layer (the depth bound itself survives as the last-resort
+backstop in the scheduler). Three verdict axes, checked in order:
+
+1. **Token-bucket quota** — each tenant refills ``rate`` tokens/sec up
+   to ``burst``; a submit with an empty bucket is rejected
+   ``reason="quota"`` with ``retry_after_s`` set to exactly when the
+   next token lands. This bounds a tenant's *sustained* rate no matter
+   how idle the service is.
+2. **Weighted-fair share** — under contention (total in-system requests
+   past ``fair_start`` of the depth bound) a tenant holding more than
+   ``weight / Σ active weights`` of the depth bound is rejected
+   ``reason="fair"``. An aggressive tenant saturates only its share;
+   the 429s it gets are the backpressure that keeps a tight-SLO
+   tenant's queue wait flat (the starvation test pins this).
+3. The scheduler's global depth bound stays underneath, rejecting
+   ``reason="depth"``.
+
+Priority classes don't gate admission; they shade *urgency*: each class
+maps to a ``flush_scale`` multiplier on the scheduler's flush window
+(high = flush sooner at more padding waste, batch = wait longer for
+fuller buckets), and the scheduler's earliest-deadline-first pop orders
+slots within the bucket. Rejections are counted per (reason, tenant) on
+the obs registry (``net_admission_rejects_total``).
+
+Thread-safety: the controller has its own lock and never calls out of
+module scope while holding it; the service calls it from the submit
+thread and the finish paths concurrently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Mapping, Optional
+
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+
+_INF = float("inf")
+
+# Priority classes and their flush-window shading. "high" flushes a
+# part-full bucket 4x sooner (snappier tails, more padding waste);
+# "batch" waits 4x longer for batch-mates (throughput over latency).
+DEFAULT_PRIORITY_FLUSH_SCALE: Mapping[str, float] = {
+    "high": 0.25,
+    "normal": 1.0,
+    "batch": 4.0,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """One tenant's admission envelope. The defaults are unmetered: a
+    tenant without an explicit quota is bounded only by fairness and
+    the global depth backstop."""
+
+    rate: float = _INF  # sustained submits/sec the token bucket refills
+    burst: float = _INF  # bucket capacity (instantaneous burst headroom)
+    weight: float = 1.0  # weighted-fair share under contention
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+    """Policy table for :class:`AdmissionController`."""
+
+    # Per-tenant quotas; tenants not listed get ``default_quota``.
+    quotas: Mapping[str, TenantQuota] = dataclasses.field(
+        default_factory=dict
+    )
+    default_quota: TenantQuota = TenantQuota()
+    # Fraction of the service's max_queue_depth past which weighted-fair
+    # admission engages (below it, any admitted tenant may burst freely
+    # — fairness only matters under contention).
+    fair_start: float = 0.5
+    # Priority class -> flush_scale multiplier; unknown classes fall
+    # back to 1.0 (plain flush_s).
+    priority_flush_scale: Mapping[str, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_PRIORITY_FLUSH_SCALE)
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One admission decision, in the same vocabulary
+    :class:`~distributedlpsolver_tpu.serve.ServiceOverloaded` carries."""
+
+    admitted: bool
+    reason: str = ""  # "", "quota", "fair" ("depth" comes from the scheduler)
+    retry_after_s: float = 0.0
+    tenant: str = "default"
+    detail: str = ""
+
+
+class _TenantState:
+    """Mutable per-tenant accounting (token bucket + in-system count)."""
+
+    __slots__ = ("tokens", "t_refill", "in_system", "admitted", "rejected")
+
+    def __init__(self, burst: float):
+        self.tokens = burst
+        self.t_refill: Optional[float] = None
+        self.in_system = 0  # admitted - finished (queued + in flight)
+        self.admitted = 0
+        self.rejected: Dict[str, int] = {}
+
+
+class AdmissionController:
+    """Stateful admission policy over a set of tenants.
+
+    The service calls :meth:`admit` on the submit path (before the
+    scheduler's depth check), :meth:`on_admitted` once the request holds
+    a queue slot, and :meth:`on_finished` when its result resolves —
+    ``in_system`` is the tenant's live footprint the fair-share check
+    meters."""
+
+    def __init__(
+        self,
+        config: Optional[AdmissionConfig] = None,
+        max_depth: int = 1024,
+        flush_s: float = 0.05,
+        metrics: Optional[obs_metrics.MetricsRegistry] = None,
+        clock=time.perf_counter,
+    ):
+        self.config = config or AdmissionConfig()
+        self.max_depth = max_depth
+        # The fair-share reject's retry hint: one flush window is the
+        # natural drain granularity of the batching dispatcher.
+        self.flush_s = flush_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantState] = {}  # guarded-by: _lock
+        m = metrics if metrics is not None else obs_metrics.get_registry()
+        self._metrics = m
+        self._m_rejects: Dict[tuple, object] = {}  # guarded-by: _lock
+        self._m_in_system = m.gauge(
+            "net_admission_in_system",
+            help="admitted-but-unfinished requests across all tenants",
+        )
+
+    def quota_for(self, tenant: str) -> TenantQuota:
+        return self.config.quotas.get(tenant, self.config.default_quota)
+
+    def flush_scale(self, priority: str) -> float:
+        return float(self.config.priority_flush_scale.get(priority, 1.0))
+
+    def _state(self, tenant: str) -> _TenantState:  # holds: _lock
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = _TenantState(self.quota_for(tenant).burst)
+            self._tenants[tenant] = st
+        return st
+
+    def _refill(self, st: _TenantState, q: TenantQuota, now: float) -> None:
+        # holds: _lock
+        if q.rate == _INF or q.burst == _INF:
+            st.tokens = _INF
+            return
+        if st.t_refill is None:
+            st.t_refill = now
+            st.tokens = min(st.tokens, q.burst)
+            return
+        st.tokens = min(q.burst, st.tokens + (now - st.t_refill) * q.rate)
+        st.t_refill = now
+
+    def _reject(
+        self, st: _TenantState, tenant: str, reason: str,
+        retry_after_s: float, detail: str,
+    ) -> Verdict:  # holds: _lock
+        st.rejected[reason] = st.rejected.get(reason, 0) + 1
+        ctr = self._m_rejects.get((reason, tenant))
+        if ctr is None:
+            ctr = self._metrics.counter(
+                "net_admission_rejects_total",
+                labels={"reason": reason, "tenant": tenant},
+                help="admission rejections by verdict reason and tenant",
+            )
+            self._m_rejects[(reason, tenant)] = ctr
+        ctr.inc()
+        return Verdict(
+            admitted=False, reason=reason,
+            retry_after_s=round(retry_after_s, 6), tenant=tenant,
+            detail=detail,
+        )
+
+    def admit(
+        self, tenant: str, priority: str = "normal",
+        now: Optional[float] = None,
+    ) -> Verdict:
+        """Decide one submit. Does NOT yet count the request as
+        in-system — the service confirms with :meth:`on_admitted` after
+        the scheduler's depth check also passes (a depth rejection must
+        not leak a token-bucket token... it already spent one; that
+        asymmetry is deliberate: a submit that reached the depth wall
+        still consumed the tenant's rate budget, which is what keeps a
+        depth-storming tenant from turning 429s into a free retry
+        loop)."""
+        now = self._clock() if now is None else now
+        q = self.quota_for(tenant)
+        with self._lock:
+            st = self._state(tenant)
+            self._refill(st, q, now)
+            if st.tokens < 1.0:
+                wait = (1.0 - st.tokens) / q.rate if q.rate > 0 else _INF
+                return self._reject(
+                    st, tenant, "quota", wait,
+                    f"token bucket empty (rate={q.rate:g}/s, "
+                    f"burst={q.burst:g})",
+                )
+            # Weighted-fair share, metered only under contention. The
+            # share denominator counts every CONFIGURED tenant plus any
+            # unconfigured one with live work: a configured tenant's
+            # share is reserved even while it is idle (the flood must
+            # not fill the house before the tight-SLO tenant's first
+            # request arrives), but an unconfigured tenant only weighs
+            # in while it actually holds slots.
+            total = sum(t.in_system for t in self._tenants.values())
+            if total >= self.config.fair_start * self.max_depth:
+                active = set(self.config.quotas)
+                active.add(tenant)
+                active.update(
+                    name
+                    for name, t in self._tenants.items()
+                    if t.in_system > 0
+                )
+                wsum = sum(
+                    self.quota_for(name).weight for name in active
+                ) or 1.0
+                share = q.weight / wsum
+                cap = max(1.0, share * self.max_depth)
+                if st.in_system + 1 > cap:
+                    return self._reject(
+                        st, tenant, "fair", self.flush_s,
+                        f"{st.in_system} in system > fair share "
+                        f"{cap:.0f} of {self.max_depth} "
+                        f"(weight {q.weight:g}/{wsum:g})",
+                    )
+            st.tokens -= 1.0
+            st.admitted += 1
+        return Verdict(admitted=True, tenant=tenant)
+
+    def on_admitted(self, tenant: str) -> None:
+        with self._lock:
+            self._state(tenant).in_system += 1
+            self._m_in_system.set(
+                sum(t.in_system for t in self._tenants.values())
+            )
+
+    def on_finished(self, tenant: str) -> None:
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None and st.in_system > 0:
+                st.in_system -= 1
+            self._m_in_system.set(
+                sum(t.in_system for t in self._tenants.values())
+            )
+
+    def stats(self) -> dict:
+        """Per-tenant admission accounting for ``/statusz`` and the
+        service summary event."""
+        with self._lock:
+            out = {}
+            for name, st in sorted(self._tenants.items()):
+                q = self.quota_for(name)
+                out[name] = {
+                    "admitted": st.admitted,
+                    "rejected": dict(st.rejected),
+                    "in_system": st.in_system,
+                    "tokens": (
+                        None if st.tokens == _INF else round(st.tokens, 3)
+                    ),
+                    "weight": q.weight,
+                }
+            return out

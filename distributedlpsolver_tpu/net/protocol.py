@@ -1,0 +1,216 @@
+"""Wire protocol of the HTTP serving plane: request parsing and
+response encoding (stdlib ``json`` only).
+
+``POST /v1/solve`` accepts either
+
+- a JSON body (``Content-Type: application/json``) with the problem
+  inline — ``{"problem": {"c": [...], "A": [[...]], "b": [...]}}``
+  (standard form min cᵀx, Ax=b, x≥0), a generated instance
+  ``{"m": 8, "n": 24, "seed": 3}`` (the load-test surface — the same
+  feasible+bounded generator the JSONL debug loop uses), or an MPS
+  document inline as ``{"mps_text": "..."}`` — plus the request fields
+  ``tol``, ``deadline_ms``, ``tenant``, ``priority``, ``async``,
+  ``id``; or
+- a raw MPS text body (any other content type), with the same request
+  fields taken from the query string
+  (``/v1/solve?tenant=acme&deadline_ms=500``).
+
+Responses are JSON; :func:`result_payload` maps a
+:class:`~distributedlpsolver_tpu.serve.RequestResult` onto the response
+body and its HTTP status code (terminal verdicts are 200 — the solver's
+verdict rides the ``status`` field; deadline expiry is 504; an
+exhausted recovery ladder is 500).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import urllib.parse
+from typing import Optional, Tuple
+
+import numpy as np
+
+from distributedlpsolver_tpu.ipm.state import Status
+from distributedlpsolver_tpu.models.problem import LPProblem
+
+
+class ProtocolError(ValueError):
+    """Malformed request body/fields — the HTTP 400 path."""
+
+
+@dataclasses.dataclass
+class SolveRequest:
+    """One parsed ``POST /v1/solve`` request."""
+
+    problem: LPProblem
+    tol: Optional[float] = None
+    deadline_s: Optional[float] = None
+    tenant: str = "default"
+    priority: str = "normal"
+    want_async: bool = False
+    name: Optional[str] = None
+    include_x: bool = True
+
+
+def _problem_from_spec(spec: dict) -> LPProblem:
+    if "mps_text" in spec:
+        from distributedlpsolver_tpu.io.mps import read_mps_string
+
+        try:
+            return read_mps_string(str(spec["mps_text"]))
+        except Exception as e:
+            raise ProtocolError(f"bad MPS body: {type(e).__name__}: {e}")
+    if "problem" in spec:
+        p = spec["problem"]
+        try:
+            c = np.asarray(p["c"], dtype=np.float64)
+            A = np.asarray(p["A"], dtype=np.float64)
+            b = np.asarray(p["b"], dtype=np.float64)
+        except (KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad inline problem: {e}")
+        if A.ndim != 2 or c.shape != (A.shape[1],) or b.shape != (A.shape[0],):
+            raise ProtocolError(
+                f"inline problem shapes disagree: A{list(A.shape)}, "
+                f"c[{c.size}], b[{b.size}]"
+            )
+        m, n = A.shape
+        return LPProblem(
+            c=c, A=A, rlb=b, rub=b, lb=np.zeros(n),
+            ub=np.full(n, np.inf), name=str(spec.get("id", f"http_{m}x{n}")),
+        )
+    if "m" in spec and "n" in spec:
+        from distributedlpsolver_tpu.models.generators import random_dense_lp
+
+        return random_dense_lp(
+            int(spec["m"]), int(spec["n"]), seed=int(spec.get("seed", 0))
+        )
+    raise ProtocolError(
+        "request needs one of: 'problem' (inline c/A/b), 'mps_text', "
+        "or generated 'm'/'n'/'seed'"
+    )
+
+
+def _fields_from(spec: dict, req: SolveRequest) -> SolveRequest:
+    if spec.get("tol") is not None:
+        req.tol = float(spec["tol"])
+    if spec.get("deadline_ms") is not None:
+        req.deadline_s = float(spec["deadline_ms"]) / 1e3
+    if spec.get("tenant") is not None:
+        req.tenant = str(spec["tenant"])
+    if spec.get("priority") is not None:
+        req.priority = str(spec["priority"])
+    a = spec.get("async")
+    req.want_async = a in (True, 1, "1", "true", "yes")
+    if spec.get("id") is not None:
+        req.name = str(spec["id"])
+    x = spec.get("include_x")
+    if x is not None:
+        req.include_x = x in (True, 1, "1", "true", "yes")
+    return req
+
+
+def parse_solve_request(
+    body: bytes, content_type: str = "application/json", query: str = ""
+) -> SolveRequest:
+    """Parse one ``POST /v1/solve`` body (+ query string) into a
+    :class:`SolveRequest`. Raises :class:`ProtocolError` on anything
+    malformed — the handler's 400 path."""
+    qfields = {
+        k: v[0] for k, v in urllib.parse.parse_qs(query or "").items()
+    }
+    if "json" in (content_type or "").lower():
+        try:
+            spec = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ProtocolError(f"bad JSON body: {e}")
+        if not isinstance(spec, dict):
+            raise ProtocolError("JSON body must be an object")
+        spec = {**qfields, **spec}  # inline fields win over the query
+        req = SolveRequest(problem=_problem_from_spec(spec))
+        return _fields_from(spec, req)
+    # Raw MPS body; request fields ride the query string.
+    try:
+        text = body.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise ProtocolError(f"MPS body is not UTF-8: {e}")
+    if not text.strip():
+        raise ProtocolError("empty request body")
+    req = SolveRequest(problem=_problem_from_spec({"mps_text": text}))
+    return _fields_from(qfields, req)
+
+
+def peek_route_hint(
+    body: bytes, content_type: str = "application/json", query: str = ""
+) -> Optional[Tuple[int, int, float]]:
+    """Cheap (m, n, tol) extraction for the router's shape-aware pick —
+    reads the JSON envelope without materializing the problem (and
+    without importing numpy work): explicit ``m``/``n``, or the inline
+    problem's array lengths. Returns None when the shape isn't visible
+    (raw MPS body without query hints) — the router then routes on load
+    alone."""
+    qfields = {
+        k: v[0] for k, v in urllib.parse.parse_qs(query or "").items()
+    }
+    spec: dict = dict(qfields)
+    if "json" in (content_type or "").lower():
+        try:
+            parsed = json.loads(body.decode("utf-8"))
+            if isinstance(parsed, dict):
+                spec.update(parsed)
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return None
+    try:
+        tol = float(spec.get("tol", 1e-8))
+        if "m" in spec and "n" in spec:
+            return int(spec["m"]), int(spec["n"]), tol
+        p = spec.get("problem")
+        if isinstance(p, dict) and "b" in p and "c" in p:
+            return len(p["b"]), len(p["c"]), tol
+    except (TypeError, ValueError):
+        return None
+    return None
+
+
+# RequestResult.status -> HTTP code. Terminal solver verdicts are 200
+# (the verdict is data, not transport failure); a queued-past-deadline
+# request is the gateway-timeout class; an exhausted recovery ladder is
+# the server-error class.
+_STATUS_HTTP = {
+    Status.TIMEOUT: 504,
+    Status.FAILED: 500,
+}
+
+
+def result_payload(result, include_x: bool = True) -> Tuple[int, dict]:
+    """(http_code, response_body) for one finished request."""
+    code = _STATUS_HTTP.get(result.status, 200)
+    body = {
+        "id": result.request_id,
+        "name": result.name,
+        "status": result.status.value,
+        "objective": None
+        if result.objective != result.objective  # NaN -> null
+        else float(result.objective),
+        "iterations": int(result.iterations),
+        "rel_gap": float(result.rel_gap),
+        "pinf": float(result.pinf),
+        "dinf": float(result.dinf),
+        "bucket": list(result.bucket) if result.bucket else None,
+        "m": int(result.m),
+        "n": int(result.n),
+        "tenant": result.tenant,
+        "priority": result.priority,
+        "warm": result.warm,
+        "queue_ms": round(result.queue_ms, 3),
+        "solve_ms": round(result.solve_ms, 3),
+        "total_ms": round(result.total_ms, 3),
+        "faults": [f.asdict() for f in result.faults],
+    }
+    if include_x and result.x is not None:
+        body["x"] = [float(v) for v in result.x]
+    return code, body
+
+
+def error_payload(code: int, error: str, **extra) -> Tuple[int, dict]:
+    return code, {"error": error, **extra}

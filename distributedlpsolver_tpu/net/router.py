@@ -1,0 +1,611 @@
+"""Router tier: one front process over N backend serving processes
+(README "Network serving").
+
+The router holds a live registry of backend base URLs. A poll thread
+health-checks each backend (``GET /healthz``) and refreshes its
+``/statusz`` snapshot — the advertised bucket ladder and queue depth
+that drive routing:
+
+- **shape-aware pick**: a request whose (m, n) is visible (JSON
+  envelope or query hints — :func:`net.protocol.peek_route_hint`) is
+  scored against each backend's advertised ladder: the padding
+  fraction the tightest fitting bucket would waste on it. A backend
+  already serving that shape wastes less than one that would open a
+  fresh pow2 bucket (and a fresh compile).
+- **load-aware tie-break**: equal padding scores break on polled queue
+  depth + live HTTP inflight, then round-robin.
+- **health-checked failover**: ``eject_after`` consecutive failed
+  probes (or one failed forward — a dead socket is better evidence
+  than a stale 200) ejects a backend from rotation; the poll thread
+  keeps probing ejected backends and re-admits on recovery. Forwards
+  that die on a transport error or 502/503/504 are retried ONCE on the
+  next-best backend — retry-once keeps a dead backend's in-flight
+  requests alive without letting a poisoned request storm every
+  backend.
+
+Everything is stdlib: ``urllib.request`` for forwarding,
+``http.server`` for the front. Async-poll ids are backend-local, so
+``GET /v1/solve/{id}`` consults the router's bounded id → backend map
+remembered from each 202 response.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from distributedlpsolver_tpu.net import protocol
+from distributedlpsolver_tpu.net.server import PlaneHTTPServer
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.utils.logging import IterLogger
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral
+    # Backend poll cadence (healthz + statusz refresh).
+    poll_s: float = 1.0
+    # Consecutive failed health probes before a backend is ejected.
+    eject_after: int = 2
+    # Timeouts: health/status probes are fast-path; forwards must
+    # outlive a backend's own solve wait.
+    probe_timeout_s: float = 2.0
+    forward_timeout_s: float = 300.0
+    # Bounded async id -> backend map (oldest evicted past the cap).
+    async_map_cap: int = 4096
+    # route/eject JSONL event stream (stamped schema); None = off.
+    log_jsonl: Optional[str] = None
+
+
+@dataclasses.dataclass
+class BackendState:
+    """One backend's live registry entry (all fields guarded by the
+    router lock; the poll thread writes, handler threads read)."""
+
+    url: str
+    healthy: bool = False
+    ejected: bool = False
+    fails: int = 0
+    probes: int = 0
+    queue_depth: int = 0
+    inflight: int = 0
+    buckets: List[Tuple[int, int, int]] = dataclasses.field(
+        default_factory=list
+    )
+    last_poll: float = 0.0
+    forwards: int = 0
+    # When the backend was last ejected (perf_counter). A health probe
+    # that STARTED before this moment is stale evidence — a poll in
+    # flight across a crash reads the old process's last 200 and must
+    # not bounce the dead backend back into rotation.
+    ejected_at: float = 0.0
+    # Forwards this router currently has in flight toward the backend —
+    # the LIVE half of the load signal. Polled queue_depth/inflight are
+    # up to poll_s stale, and a stale snapshot makes every pick in a
+    # poll window herd onto the same "least loaded" backend; the live
+    # count moves with each forward and spreads them.
+    live: int = 0
+
+
+class Router:
+    """Backend registry + routing policy + poll loop (no HTTP surface
+    of its own — :class:`RouterHTTPServer` puts one in front)."""
+
+    def __init__(
+        self,
+        backends: List[str],
+        config: Optional[RouterConfig] = None,
+        metrics: Optional[obs_metrics.MetricsRegistry] = None,
+    ):
+        if not backends:
+            raise ValueError("router needs at least one backend URL")
+        self.config = config or RouterConfig()
+        self.metrics = (
+            metrics if metrics is not None else obs_metrics.get_registry()
+        )
+        self._lock = threading.Lock()
+        self._backends: Dict[str, BackendState] = OrderedDict(  # guarded-by: _lock
+            (u.rstrip("/"), BackendState(url=u.rstrip("/"))) for u in backends
+        )
+        self._rr = 0  # round-robin tie-break cursor; guarded-by: _lock
+        self._failovers = 0  # guarded-by: _lock
+        self._async_map: OrderedDict = OrderedDict()  # id -> url; guarded-by: _lock
+        self._logger = IterLogger(
+            verbose=False, jsonl_path=self.config.log_jsonl
+        )
+        m = self.metrics
+        self._m_healthy: Dict[str, object] = {}  # guarded-by: _lock
+        self._m_routed: Dict[str, object] = {}  # guarded-by: _lock
+        self._m_failovers = m.counter(
+            "router_failovers_total",
+            help="forwards retried on another backend after a failure",
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Router":
+        if self._thread is None:
+            self.poll_once()  # synchronous first sweep: route() works now
+            self._thread = threading.Thread(
+                target=self._poll_loop, daemon=True, name="dlps-router-poll"
+            )
+            self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._logger.close()
+
+    # -- polling ---------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.config.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # the poll thread must survive anything
+                pass
+
+    def _fetch_json(self, url: str) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(
+                url, timeout=self.config.probe_timeout_s
+            ) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            # A well-formed error response (healthz 503) still carries
+            # a JSON body worth reading; transport-level errors don't.
+            try:
+                return json.loads(e.read().decode("utf-8"))
+            except Exception:
+                return None
+        except (urllib.error.URLError, socket.timeout, OSError, ValueError):
+            return None
+
+    def poll_once(self) -> None:
+        """One sweep: probe every backend's /healthz (ejected ones
+        included — that is the re-admission path) and refresh /statusz
+        for the healthy ones."""
+        with self._lock:
+            urls = list(self._backends)
+        for url in urls:
+            t_start = time.perf_counter()
+            h = self._fetch_json(url + "/healthz")
+            ok = bool(h) and h.get("status") == "ok"
+            stz = self._fetch_json(url + "/statusz") if ok else None
+            self._record_probe(url, ok, stz, t_start)
+
+    def _gauge_for(self, url: str):  # holds: _lock
+        g = self._m_healthy.get(url)
+        if g is None:
+            g = self.metrics.gauge(
+                "router_backend_healthy",
+                labels={"backend": url},
+                help="1 = in rotation, 0 = ejected/unhealthy",
+            )
+            self._m_healthy[url] = g
+        return g
+
+    def _record_probe(
+        self, url: str, ok: bool, statusz: Optional[dict],
+        t_start: float = 0.0,
+    ) -> None:
+        ejected = readmitted = False
+        with self._lock:
+            st = self._backends.get(url)
+            if st is None:
+                return
+            st.probes += 1
+            st.last_poll = time.perf_counter()
+            if ok:
+                if st.ejected and t_start <= st.ejected_at:
+                    # Stale success: the probe began before the
+                    # ejection landed (poll racing a crash/forward
+                    # failure). Keep the ejection; a probe started
+                    # AFTER it is the real recovery signal.
+                    return
+                st.fails = 0
+                if st.ejected:
+                    st.ejected = False
+                    readmitted = True
+                st.healthy = True
+                if statusz:
+                    stats = statusz.get("stats") or {}
+                    st.queue_depth = int(stats.get("queue_depth", 0) or 0)
+                    net = statusz.get("net") or {}
+                    st.inflight = int(net.get("inflight", 0) or 0)
+                    st.buckets = [
+                        tuple(b) for b in (stats.get("buckets") or [])
+                    ]
+            else:
+                st.fails += 1
+                st.healthy = False
+                if not st.ejected and st.fails >= self.config.eject_after:
+                    st.ejected = True
+                    st.ejected_at = time.perf_counter()
+                    ejected = True
+            fails = st.fails
+            self._gauge_for(url).set(1.0 if ok else 0.0)
+        if ejected:
+            self._logger.event(
+                {"event": "backend_ejected", "backend": url, "fails": fails}
+            )
+        if readmitted:
+            self._logger.event(
+                {"event": "backend_readmitted", "backend": url}
+            )
+
+    def _note_forward_failure(self, url: str) -> None:
+        """A forward died on ``url``: a dead socket is better evidence
+        than the last 200 probe, so eject immediately — the poll thread
+        re-admits when /healthz recovers."""
+        with self._lock:
+            st = self._backends.get(url)
+            if st is None:
+                return
+            st.fails += 1
+            st.healthy = False
+            already = st.ejected
+            st.ejected = True
+            st.ejected_at = time.perf_counter()
+            fails = st.fails
+            self._gauge_for(url).set(0.0)
+        if not already:
+            self._logger.event(
+                {"event": "backend_ejected", "backend": url, "fails": fails}
+            )
+
+    # -- routing ---------------------------------------------------------
+
+    @staticmethod
+    def _padding_score(
+        m: int, n: int, buckets: List[Tuple[int, int, int]]
+    ) -> float:
+        """Fraction of the tightest fitting advertised bucket this shape
+        would waste (0 = exact fit). No advertised fit = 1.0: the
+        backend would open (and compile) a fresh bucket."""
+        best = 1.0
+        for bm, bn, _bb in buckets:
+            if bm >= m and bn >= n:
+                waste = 1.0 - (m * n) / float(bm * bn)
+                best = min(best, waste)
+        return best
+
+    def pick(
+        self,
+        hint: Optional[Tuple[int, int, float]] = None,
+        exclude: Tuple[str, ...] = (),
+    ) -> Optional[str]:
+        """The best in-rotation backend for one request: min padding
+        score (when the shape is visible), then min load, then
+        round-robin. None = nothing routable."""
+        with self._lock:
+            in_rotation = [
+                st
+                for st in self._backends.values()
+                if st.healthy and not st.ejected and st.url not in exclude
+            ]
+            if not in_rotation:
+                return None
+            self._rr += 1
+            rr = self._rr
+            scored = []
+            for i, st in enumerate(in_rotation):
+                pad = (
+                    self._padding_score(hint[0], hint[1], st.buckets)
+                    if hint
+                    else 0.0
+                )
+                load = st.queue_depth + st.inflight + st.live
+                scored.append(
+                    (round(pad, 4), load, (i + rr) % len(in_rotation), st.url)
+                )
+            scored.sort()
+            url = scored[0][3]
+            self._backends[url].forwards += 1
+            self._backends[url].live += 1
+            ctr = self._m_routed.get(url)
+            if ctr is None:
+                ctr = self.metrics.counter(
+                    "router_routed_total",
+                    labels={"backend": url},
+                    help="requests routed to this backend",
+                )
+                self._m_routed[url] = ctr
+        ctr.inc()
+        return url
+
+    # -- forwarding ------------------------------------------------------
+
+    def _release(self, url: str) -> None:
+        with self._lock:
+            st = self._backends.get(url)
+            if st is not None and st.live > 0:
+                st.live -= 1
+
+    def _forward_once(
+        self, url: str, path: str, body: bytes, content_type: str,
+        method: str,
+    ) -> Tuple[int, bytes]:
+        req = urllib.request.Request(
+            url + path,
+            data=body if method == "POST" else None,
+            headers={"Content-Type": content_type} if body else {},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.config.forward_timeout_s
+            ) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def forward(
+        self, path: str, body: bytes, content_type: str, method: str = "POST"
+    ) -> Tuple[int, bytes, Optional[str]]:
+        """Route + forward one request with retry-once failover. Returns
+        (code, body, backend) — backend None means no backend was
+        routable (the 503 path). Transport errors and gateway-class
+        responses (502/503/504) from the first backend eject it and
+        retry exactly once elsewhere."""
+        hint = (
+            protocol.peek_route_hint(
+                body, content_type, urlsplit(path).query
+            )
+            if method == "POST"
+            else None
+        )
+        route_path = urlsplit(path).path
+        tried: Tuple[str, ...] = ()
+        for attempt in range(2):
+            url = self.pick(hint, exclude=tried)
+            if url is None:
+                return 503, b"", None
+            t0 = time.perf_counter()
+            try:
+                code, payload = self._forward_once(
+                    url, path, body, content_type, method
+                )
+                transport_dead = False
+            except (urllib.error.URLError, socket.timeout, OSError):
+                code, payload = 502, b""
+                transport_dead = True
+            finally:
+                self._release(url)
+            self._logger.event(
+                {
+                    "event": "route",
+                    "backend": url,
+                    "path": route_path,
+                    "code": code,
+                    "m": hint[0] if hint else None,
+                    "n": hint[1] if hint else None,
+                    "tol": hint[2] if hint else None,
+                    "ms": round((time.perf_counter() - t0) * 1e3, 3),
+                    "retried": attempt > 0,
+                }
+            )
+            if transport_dead or code in (502, 503, 504):
+                self._note_forward_failure(url)
+                if attempt == 0:
+                    tried = (url,)
+                    with self._lock:
+                        self._failovers += 1
+                    self._m_failovers.inc()
+                    continue
+            return code, payload, url
+        return code, payload, url  # second attempt's outcome, whatever it was
+
+    # -- async id mapping ------------------------------------------------
+
+    def remember_async(self, rid: str, url: str) -> None:
+        with self._lock:
+            self._async_map[rid] = url
+            while len(self._async_map) > self.config.async_map_cap:
+                self._async_map.popitem(last=False)
+
+    def backend_for_async(self, rid: str) -> Optional[str]:
+        with self._lock:
+            return self._async_map.get(rid)
+
+    # -- introspection ---------------------------------------------------
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(
+                1
+                for st in self._backends.values()
+                if st.healthy and not st.ejected
+            )
+
+    def statusz(self) -> dict:
+        now = time.perf_counter()
+        with self._lock:
+            return {
+                "failovers": self._failovers,
+                "backends": [
+                    {
+                        "url": st.url,
+                        "healthy": st.healthy,
+                        "ejected": st.ejected,
+                        "fails": st.fails,
+                        "probes": st.probes,
+                        "queue_depth": st.queue_depth,
+                        "inflight": st.inflight,
+                        "live": st.live,
+                        "buckets": [list(b) for b in st.buckets],
+                        "forwards": st.forwards,
+                        "last_poll_age_s": (
+                            round(now - st.last_poll, 3)
+                            if st.last_poll
+                            else None
+                        ),
+                    }
+                    for st in self._backends.values()
+                ],
+            }
+
+
+class RouterHTTPServer:
+    """HTTP front for a :class:`Router`: forwards ``/v1/solve`` (+async
+    polls), serves its own ``/metrics``, ``/healthz`` (healthy iff ≥1
+    backend is in rotation), and ``/statusz`` (the backend table)."""
+
+    def __init__(
+        self,
+        router: Router,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics: Optional[obs_metrics.MetricsRegistry] = None,
+    ):
+        self.router = router
+        self.metrics = metrics if metrics is not None else router.metrics
+        self._httpd = PlaneHTTPServer((host, port), _RouterHandler)
+        self._httpd.front = self
+        self._host = host
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "RouterHTTPServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                daemon=True,
+                name=f"dlps-router-{self.port}",
+            )
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "RouterHTTPServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._httpd.server_close()
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send(
+            code, json.dumps(payload).encode("utf-8"), "application/json"
+        )
+
+    def do_POST(self) -> None:  # noqa: N802
+        front = self.server.front
+        parts = urlsplit(self.path)
+        try:
+            if parts.path != "/v1/solve":
+                self._send_json(404, {"error": f"no such route {parts.path}"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            content_type = self.headers.get(
+                "Content-Type", "application/json"
+            )
+            code, payload, backend = front.router.forward(
+                self.path, body, content_type, method="POST"
+            )
+            if backend is None:
+                self._send_json(
+                    503, {"error": "no healthy backend in rotation"}
+                )
+                return
+            # Remember 202 async ids so later polls route to the same
+            # backend (ids are backend-local).
+            if code == 202:
+                try:
+                    rid = json.loads(payload.decode("utf-8")).get("id")
+                    if rid:
+                        front.router.remember_async(str(rid), backend)
+                except (ValueError, UnicodeDecodeError):
+                    pass
+            self._send(code, payload, "application/json")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_GET(self) -> None:  # noqa: N802
+        front = self.server.front
+        parts = urlsplit(self.path)
+        path = parts.path
+        try:
+            if path == "/metrics":
+                self._send(
+                    200,
+                    front.metrics.to_prometheus_text().encode("utf-8"),
+                    "text/plain; version=0.0.4",
+                )
+            elif path == "/healthz":
+                n = front.router.healthy_count()
+                ok = n > 0
+                self._send_json(
+                    200 if ok else 503,
+                    {
+                        "status": "ok" if ok else "unhealthy",
+                        "healthy_backends": n,
+                    },
+                )
+            elif path == "/statusz":
+                self._send_json(200, front.router.statusz())
+            elif path.startswith("/v1/solve/"):
+                rid = path.rsplit("/", 1)[1]
+                url = front.router.backend_for_async(rid)
+                if url is None:
+                    self._send_json(
+                        404, {"error": f"unknown async id {rid!r}"}
+                    )
+                    return
+                try:
+                    code, payload = front.router._forward_once(
+                        url, path, b"", "application/json", "GET"
+                    )
+                except (urllib.error.URLError, socket.timeout, OSError):
+                    self._send_json(
+                        502, {"error": f"backend {url} unreachable"}
+                    )
+                    return
+                self._send(code, payload, "application/json")
+            else:
+                self._send_json(404, {"error": f"no such route {path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass

@@ -1,0 +1,445 @@
+"""HTTP front-end over :class:`~distributedlpsolver_tpu.serve.
+SolveService` — stdlib ``http.server`` only (README "Network serving").
+
+Endpoints:
+
+- ``POST /v1/solve`` — JSON problem or raw MPS body
+  (:mod:`net.protocol`); blocks on the service future and returns the
+  result (solver verdicts are 200, queued-past-deadline 504, exhausted
+  recovery 500). ``"async": true`` returns ``202`` +
+  ``{"id": ..., "href": "/v1/solve/<id>"}`` instead. Admission
+  rejections map to ``429`` with a ``Retry-After`` header carrying the
+  structured verdict's wait hint.
+- ``GET /v1/solve/{id}`` — async poll: 200 done, 202 pending, 404
+  unknown/expired (the store is a bounded LRU — collected results
+  evict oldest-first past ``async_results_cap``).
+- ``GET /metrics`` — Prometheus text off the obs registry.
+- ``GET /healthz`` — 200/503 from three signals: per-device health
+  probes (parallel/runtime.py — the supervisor's own probe, so an
+  injected device loss flips this surface too), dispatcher pipeline
+  liveness (all three threads running), and a wedge detector (queue
+  depth > 0 with the dispatch count frozen past ``wedge_s``).
+- ``GET /statusz`` — ``SolveService.stats()`` + the front-end's own
+  request counters; the router tier's shape/load feed.
+
+Each request lands one ``http_request`` JSONL event (stamped schema)
+and counts into ``net_requests_total{code,tenant}`` / the
+``net_inflight`` gauge. The handler threads (ThreadingHTTPServer: one
+per connection) only parse, submit, and block on futures — all device
+work stays on the service's pipeline threads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import urlsplit
+
+from distributedlpsolver_tpu.net import protocol
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.serve.scheduler import ServiceOverloaded
+from distributedlpsolver_tpu.utils.logging import IterLogger
+
+
+class PlaneHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer tuned for the serving plane: handler threads
+    are daemons (a stuck client must not block interpreter exit), and
+    the listen backlog is sized for bursty many-client load — the
+    socketserver default of 5 resets connections under exactly the
+    flood the admission layer exists to absorb."""
+
+    daemon_threads = True
+    request_queue_size = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class NetConfig:
+    """Tunables of one HTTP front-end."""
+
+    host: str = "127.0.0.1"
+    # 0 = ephemeral (the OS picks; tests and the probe read .port back).
+    port: int = 0
+    # Sync-POST wait bound when the request carries no deadline: a
+    # client that asked for no deadline still must not pin a handler
+    # thread forever if the service wedges.
+    max_wait_s: float = 300.0
+    # Grace past a request's own deadline before the handler gives up
+    # on the future (the service resolves TIMEOUT at pop time, which
+    # can lag the deadline by a flush window).
+    deadline_grace_s: float = 10.0
+    # Bounded async-result store (oldest evicted past the cap).
+    async_results_cap: int = 1024
+    # healthz probe results are cached this long (device pings are
+    # cheap but not free; the router polls every backend).
+    healthz_cache_s: float = 0.5
+    # Per-device health-probe deadline (parallel/runtime.probe_device).
+    probe_deadline_s: float = 2.0
+    # Queue depth > 0 with zero dispatch progress for this long = the
+    # pipeline is wedged and healthz goes unhealthy.
+    wedge_s: float = 30.0
+    # http_request JSONL event stream (stamped schema); None = off.
+    log_jsonl: Optional[str] = None
+
+
+class SolveHTTPServer:
+    """One HTTP front-end bound to one :class:`SolveService`.
+
+    ``start()`` binds and serves on a daemon thread; ``shutdown()``
+    stops accepting and closes the socket (the service itself is NOT
+    shut down — callers own its lifecycle, and the router probe kills
+    front-ends while their services drain)."""
+
+    def __init__(
+        self,
+        service,
+        config: Optional[NetConfig] = None,
+        metrics: Optional[obs_metrics.MetricsRegistry] = None,
+    ):
+        self.service = service
+        self.config = config or NetConfig()
+        # Default to the service's registry so one scrape of /metrics
+        # shows the whole backend (serve_* and net_* families together).
+        self.metrics = metrics if metrics is not None else service.metrics
+        m = self.metrics
+        self._m_by_code: Dict[tuple, object] = {}  # guarded-by: _lock
+        self._m_inflight = m.gauge(
+            "net_inflight", help="HTTP requests currently being handled"
+        )
+        self._m_http_ms = m.histogram(
+            "net_request_ms", help="HTTP request wall time (handler span)"
+        )
+        self._logger = IterLogger(
+            verbose=False, jsonl_path=self.config.log_jsonl
+        )
+        self._lock = threading.Lock()
+        self._requests_total = 0  # guarded-by: _lock
+        self._by_code: Dict[int, int] = {}  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
+        # Async-poll store: id -> (future, include_x, t_created).
+        self._async: OrderedDict = OrderedDict()  # guarded-by: _lock
+        self._async_seq = 0  # guarded-by: _lock
+        # healthz cache + wedge-detector pulse.
+        self._health: Optional[Tuple[bool, dict]] = None  # guarded-by: _health_lock
+        self._health_t = 0.0  # guarded-by: _health_lock
+        self._progress = (-1, 0.0)  # guarded-by: _health_lock
+        self._health_lock = threading.Lock()
+        self._t_start = time.perf_counter()
+        self._httpd = PlaneHTTPServer(
+            (self.config.host, self.config.port), _Handler
+        )
+        self._httpd.front = self
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.config.host}:{self.port}"
+
+    def start(self) -> "SolveHTTPServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                daemon=True,
+                name=f"dlps-http-{self.port}",
+            )
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "SolveHTTPServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._httpd.server_close()
+        self._logger.close()
+
+    # -- bookkeeping the handler threads call ----------------------------
+
+    def _enter_request(self) -> float:
+        with self._lock:
+            self._inflight += 1
+            self._m_inflight.set(self._inflight)
+        return time.perf_counter()
+
+    def _exit_request(
+        self, t0: float, method: str, path: str, code: int,
+        tenant: str, request_id,
+    ) -> None:
+        ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._inflight -= 1
+            self._requests_total += 1
+            self._by_code[code] = self._by_code.get(code, 0) + 1
+            self._m_inflight.set(self._inflight)
+            ctr = self._m_by_code.get((code, tenant))
+            if ctr is None:
+                ctr = self.metrics.counter(
+                    "net_requests_total",
+                    labels={"code": str(code), "tenant": tenant},
+                    help="HTTP requests by response code and tenant",
+                )
+                self._m_by_code[(code, tenant)] = ctr
+        ctr.inc()
+        self._m_http_ms.observe(ms)
+        self._logger.event(
+            {
+                "event": "http_request",
+                "method": method,
+                "path": path,
+                "code": code,
+                "tenant": tenant,
+                "id": request_id,
+                "ms": round(ms, 3),
+            }
+        )
+
+    def _register_async(self, fut, include_x: bool) -> str:
+        with self._lock:
+            self._async_seq += 1
+            rid = f"a{self._async_seq}"
+            self._async[rid] = (fut, include_x, time.perf_counter())
+            while len(self._async) > self.config.async_results_cap:
+                self._async.popitem(last=False)
+        return rid
+
+    def _lookup_async(self, rid: str):
+        with self._lock:
+            return self._async.get(rid)
+
+    # -- health ----------------------------------------------------------
+
+    def health(self) -> Tuple[bool, dict]:
+        """(healthy, payload) from device probes + pipeline liveness +
+        the wedge detector; cached ``healthz_cache_s``."""
+        now = time.perf_counter()
+        with self._health_lock:
+            if (
+                self._health is not None
+                and now - self._health_t < self.config.healthz_cache_s
+            ):
+                return self._health
+        # Probe OUTSIDE the lock: a slow device ping must not serialize
+        # concurrent healthz handlers behind it.
+        from distributedlpsolver_tpu.parallel.runtime import probe_devices
+
+        healthy_devs, unhealthy_devs = probe_devices(
+            deadline=self.config.probe_deadline_s
+        )
+        pipeline = self.service.pipeline_alive()
+        dispatches, depth = self.service.progress()
+        with self._health_lock:
+            last_d, last_t = self._progress
+            if depth == 0 or dispatches != last_d:
+                self._progress = (dispatches, now)
+                wedged = False
+            else:
+                wedged = now - last_t > self.config.wedge_s
+            ok = pipeline and not wedged and not unhealthy_devs
+            payload = {
+                "status": "ok" if ok else "unhealthy",
+                "devices_healthy": len(healthy_devs),
+                "devices_unhealthy": [
+                    int(getattr(d, "id", -1)) for d in unhealthy_devs
+                ],
+                "pipeline_alive": pipeline,
+                "wedged": wedged,
+                "queue_depth": depth,
+            }
+            self._health = (ok, payload)
+            self._health_t = now
+            return self._health
+
+    def statusz(self) -> dict:
+        stats = self.service.stats()
+        with self._lock:
+            net = {
+                "requests_total": self._requests_total,
+                "by_code": {str(k): v for k, v in self._by_code.items()},
+                "inflight": self._inflight,
+                "async_pending": len(self._async),
+            }
+        return {
+            "uptime_s": round(time.perf_counter() - self._t_start, 3),
+            "net": net,
+            "stats": stats,
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Per-connection handler; all state lives on ``server.front``."""
+
+    protocol_version = "HTTP/1.1"
+    # http.server's default request line log goes to stderr per request
+    # — a 200-rps load test must not pay (or emit) that.
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _send_json(
+        self, code: int, payload: dict, headers: Optional[dict] = None
+    ) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- POST /v1/solve --------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server convention)
+        front = self.server.front
+        parts = urlsplit(self.path)
+        t0 = front._enter_request()
+        code, tenant, rid = 500, "default", None
+        try:
+            if parts.path != "/v1/solve":
+                code = 404
+                self._send_json(code, {"error": f"no such route {parts.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                req = protocol.parse_solve_request(
+                    body,
+                    self.headers.get("Content-Type", "application/json"),
+                    parts.query,
+                )
+            except protocol.ProtocolError as e:
+                code = 400
+                self._send_json(code, {"error": str(e)})
+                return
+            tenant = req.tenant
+            try:
+                fut = front.service.submit(
+                    req.problem,
+                    deadline=req.deadline_s,
+                    tol=req.tol,
+                    name=req.name,
+                    tenant=req.tenant,
+                    priority=req.priority,
+                )
+            except ServiceOverloaded as e:
+                code = 429
+                retry = max(e.retry_after_s, 0.001)
+                self._send_json(
+                    code,
+                    {
+                        "error": str(e),
+                        "reason": e.reason,
+                        "retry_after_s": retry,
+                        "tenant": e.tenant,
+                    },
+                    headers={"Retry-After": f"{retry:.3f}"},
+                )
+                return
+            except RuntimeError as e:  # service shut down
+                code = 503
+                self._send_json(code, {"error": str(e)})
+                return
+            if req.want_async:
+                handle = front._register_async(fut, req.include_x)
+                rid = handle
+                code = 202
+                self._send_json(
+                    code, {"id": handle, "href": f"/v1/solve/{handle}"}
+                )
+                return
+            wait = (
+                req.deadline_s + front.config.deadline_grace_s
+                if req.deadline_s is not None
+                else front.config.max_wait_s
+            )
+            try:
+                result = fut.result(timeout=wait)
+            except FutureTimeout:
+                code = 504
+                self._send_json(
+                    code, {"error": f"no result within {wait:.1f}s"}
+                )
+                return
+            rid = result.request_id
+            code, payload = protocol.result_payload(result, req.include_x)
+            self._send_json(code, payload)
+        except (BrokenPipeError, ConnectionResetError):
+            code = 499  # client went away mid-response; counted, not raised
+        finally:
+            front._exit_request(t0, "POST", parts.path, code, tenant, rid)
+
+    # -- GETs ------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        front = self.server.front
+        parts = urlsplit(self.path)
+        path = parts.path
+        t0 = front._enter_request()
+        code, rid = 500, None
+        try:
+            if path == "/metrics":
+                code = 200
+                self._send_text(
+                    code,
+                    front.metrics.to_prometheus_text(),
+                    "text/plain; version=0.0.4",
+                )
+            elif path == "/healthz":
+                ok, payload = front.health()
+                code = 200 if ok else 503
+                self._send_json(code, payload)
+            elif path == "/statusz":
+                code = 200
+                self._send_json(code, front.statusz())
+            elif path.startswith("/v1/solve/"):
+                rid = path.rsplit("/", 1)[1]
+                entry = front._lookup_async(rid)
+                if entry is None:
+                    code = 404
+                    self._send_json(
+                        code, {"error": f"unknown or expired id {rid!r}"}
+                    )
+                else:
+                    fut, include_x, _ = entry
+                    if not fut.done():
+                        code = 202
+                        self._send_json(
+                            code, {"id": rid, "status": "pending"}
+                        )
+                    else:
+                        code, payload = protocol.result_payload(
+                            fut.result(), include_x
+                        )
+                        self._send_json(code, payload)
+            else:
+                code = 404
+                self._send_json(code, {"error": f"no such route {path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            code = 499
+        finally:
+            front._exit_request(t0, "GET", path, code, "default", rid)

@@ -8,6 +8,11 @@ Public surface: :class:`SolveService` (submit → Future), configured by
 :func:`autotune_ladder` refines the bucket ladder from observed
 shape/padding telemetry (swap it in live with
 ``SolveService.apply_ladder``).
+
+The network plane over this service — HTTP front-end, SLO-aware
+per-tenant admission (``ServiceConfig.admission`` +
+``submit(tenant=, priority=)``), and the router tier — lives in
+:mod:`distributedlpsolver_tpu.net` (README "Network serving").
 """
 
 from distributedlpsolver_tpu.serve.autotune import (

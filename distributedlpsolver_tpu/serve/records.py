@@ -59,6 +59,11 @@ class RequestResult:
     # solve), "rejected" (a cache hit was offered but the in-program
     # safeguard fell back to the cold start), "cold" otherwise.
     warm: str = "cold"
+    # SLO-aware serving plane (net/): the submitting tenant and its
+    # priority class — the keys the per-tenant queue-wait attribution
+    # (and the starvation probe) split on.
+    tenant: str = "default"
+    priority: str = "normal"
 
     def record(self) -> dict:
         """The JSONL record for this request (x is elided — solutions go
@@ -87,6 +92,8 @@ class RequestResult:
             "slot": self.slot,
             "retried_solo": self.retried_solo,
             "warm": self.warm,
+            "tenant": self.tenant,
+            "priority": self.priority,
             "faults": [f.asdict() for f in self.faults],
         }
 

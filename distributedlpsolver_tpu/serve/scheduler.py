@@ -2,23 +2,35 @@
 control, and deadline accounting.
 
 Flush policy (continuous batching): a bucket launches when it holds a
-full batch, or when its oldest request's age exceeds ``flush_s`` — the
-knob that trades padding waste (early flushes dispatch part-full
-buckets) against tail latency (late flushes make the first request wait
-for batch-mates). Deadlines are checked at pop time: a request whose
-deadline passed while queued is split out of the batch and returned
-TIMEOUT without ever occupying a slot — an expired request can never
-poison its batch-mates' dispatch.
+full batch, or when a member's age exceeds its *effective* flush window
+``flush_s * flush_scale`` — the knob that trades padding waste (early
+flushes dispatch part-full buckets) against tail latency (late flushes
+make the first request wait for batch-mates). ``flush_scale`` is the
+priority shading the SLO-aware admission layer (net/admission.py)
+assigns per request: a high-priority request shrinks its bucket's
+flush window, a batch-priority request stretches it.
 
-Admission control is a single bounded depth across all buckets: submit
-past ``max_depth`` raises :class:`ServiceOverloaded` (backpressure is the
-caller's signal to shed load; queueing unboundedly just converts overload
-into timeout storms).
+Slot assignment inside one bucket is earliest-deadline-first: ``pop``
+orders the queue by absolute deadline (deadline-less requests sort
+last, FIFO among themselves), so a tight-SLO request never waits behind
+loose ones that happened to arrive earlier. Deadlines are checked at
+pop time: a request whose deadline passed while queued is split out of
+the batch and returned TIMEOUT without ever occupying a slot — an
+expired request can never poison its batch-mates' dispatch.
+
+Admission control layers: the scheduler keeps the global bounded depth
+across all buckets (submit past ``max_depth`` raises
+:class:`ServiceOverloaded` — backpressure is the caller's signal to
+shed load; queueing unboundedly just converts overload into timeout
+storms), and the service consults the per-tenant token-bucket /
+weighted-fair :class:`~distributedlpsolver_tpu.net.admission.
+AdmissionController` before the depth check when one is configured.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -30,7 +42,28 @@ from distributedlpsolver_tpu.serve.buckets import BucketSpec, BucketTable
 
 
 class ServiceOverloaded(RuntimeError):
-    """Admission control rejected a submit: queue depth at its bound."""
+    """Admission control rejected a submit.
+
+    Carries the structured verdict so callers (the HTTP front-end's 429
+    path, the CLI's backoff loop) can act on it instead of blind
+    retrying: ``reason`` is ``"depth"`` (global queue bound),
+    ``"quota"`` (the tenant's token bucket is empty) or ``"fair"`` (the
+    tenant is past its weighted fair share under contention);
+    ``retry_after_s`` is the earliest time a retry can plausibly
+    succeed (the HTTP Retry-After header value).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        reason: str = "depth",
+        retry_after_s: float = 0.0,
+        tenant: str = "default",
+    ):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
 
 
 @dataclasses.dataclass
@@ -50,6 +83,12 @@ class PendingRequest:
     # Structural fingerprint (utils/fingerprint.structural_fingerprint):
     # the warm-cache key computed at submit; None = warm start disabled.
     fp: Optional[str] = None
+    # SLO-aware serving plane (net/): who submitted this request and in
+    # which priority class; flush_scale is the priority's shading of the
+    # bucket flush window (1.0 = the plain flush_s).
+    tenant: str = "default"
+    priority: str = "normal"
+    flush_scale: float = 1.0
 
     @property
     def m(self) -> int:
@@ -109,7 +148,13 @@ class Scheduler:
             self._m_rejects.inc()
             raise ServiceOverloaded(
                 f"queue depth {self._depth} at max_queue_depth="
-                f"{self.max_depth}; shed load or raise the bound"
+                f"{self.max_depth}; shed load or raise the bound",
+                reason="depth",
+                # One flush window is the natural drain granularity: by
+                # then at least one bucket has dispatched (or nothing is
+                # moving and the caller should back off harder anyway).
+                retry_after_s=self.flush_s,
+                tenant=p.tenant,
             )
         if p.A is None:  # general form: solo pseudo-bucket (batch of 1)
             key = (BucketSpec(p.m, p.n, 1), p.tol)
@@ -121,18 +166,20 @@ class Scheduler:
         return key
 
     def ready(self, now: float) -> List[QueueKey]:
-        """Keys whose bucket should launch now: full, aged past flush_s,
-        or holding a request whose deadline already passed (so TIMEOUTs
-        are returned promptly, not at the next natural flush)."""
+        """Keys whose bucket should launch now: full, holding a member
+        aged past its effective flush window (``flush_s`` shaded by the
+        member's priority ``flush_scale``), or holding a request whose
+        deadline already passed (so TIMEOUTs are returned promptly, not
+        at the next natural flush)."""
         out = []
         for key, q in self._queues.items():
             if not q:
                 continue
             spec = key[0]
-            if (
-                len(q) >= spec.batch
-                or now - q[0].t_submit >= self.flush_s
-                or any(p.deadline is not None and now >= p.deadline for p in q)
+            if len(q) >= spec.batch or any(
+                now - p.t_submit >= self.flush_s * p.flush_scale
+                or (p.deadline is not None and now >= p.deadline)
+                for p in q
             ):
                 out.append(key)
         return out
@@ -143,13 +190,11 @@ class Scheduler:
         submit)."""
         t = None
         for key, q in self._queues.items():
-            if not q:
-                continue
-            cand = q[0].t_submit + self.flush_s
             for p in q:
+                cand = p.t_submit + self.flush_s * p.flush_scale
                 if p.deadline is not None:
                     cand = min(cand, p.deadline)
-            t = cand if t is None else min(t, cand)
+                t = cand if t is None else min(t, cand)
         if t is None:
             return None
         return max(0.0, t - now)
@@ -171,17 +216,38 @@ class Scheduler:
         self, key: QueueKey, now: float
     ) -> Tuple[List[PendingRequest], List[PendingRequest]]:
         """Take up to one batch off ``key``'s queue, splitting out
-        deadline-expired requests: returns (live, expired)."""
+        deadline-expired requests: returns (live, expired).
+
+        Slot assignment is earliest-deadline-first: the whole queue is
+        ordered by (absolute deadline, arrival) and the batch takes the
+        head, so a tight-SLO request admitted after a loose-SLO flood
+        still rides the next dispatch. Deadline-less requests sort last
+        and stay FIFO among themselves (the sort is stable), so the
+        no-deadline workload keeps its arrival order exactly. Every
+        already-expired request is split out immediately — not just
+        those that would have made this batch — so TIMEOUT verdicts
+        never queue behind live work."""
         q = self._queues.get(key)
         live: List[PendingRequest] = []
         expired: List[PendingRequest] = []
+        if not q:
+            return live, expired
         spec = key[0]
-        while q and len(live) < spec.batch:
+        pending: List[PendingRequest] = []
+        while q:
             p = q.popleft()
-            self._depth -= 1
             if p.deadline is not None and now >= p.deadline:
                 expired.append(p)
             else:
-                live.append(p)
+                pending.append(p)
+        pending.sort(
+            key=lambda p: (
+                p.deadline if p.deadline is not None else math.inf,
+                p.t_submit,
+            )
+        )
+        live = pending[: spec.batch]
+        q.extend(pending[spec.batch :])
+        self._depth -= len(live) + len(expired)
         self._m_depth.set(self._depth)
         return live, expired

@@ -161,6 +161,11 @@ class ServiceConfig:
     warm_start: bool = True
     # Bounded LRU capacity of the fingerprint cache.
     warm_cache_entries: int = 512
+    # SLO-aware admission (net/admission.AdmissionConfig): per-tenant
+    # token-bucket quotas + weighted-fair shares + priority flush
+    # shading, layered ABOVE max_queue_depth (which stays as the global
+    # backstop). None = the classic depth-only admission.
+    admission: Optional[object] = None
 
 
 def standard_form(problem: LPProblem):
@@ -321,6 +326,22 @@ class SolveService:
             "the cold start",
         )
         self._m_iters_by_start: dict = {}  # start label -> histogram
+        # SLO-aware admission (net/admission.py): token-bucket quotas +
+        # weighted-fair shares consulted on the submit path BEFORE the
+        # scheduler's depth backstop; priorities shade flush windows.
+        if self.config.admission is not None:
+            from distributedlpsolver_tpu.net.admission import (
+                AdmissionController,
+            )
+
+            self._admission: Optional[object] = AdmissionController(
+                self.config.admission,
+                max_depth=self.config.max_queue_depth,
+                flush_s=self.config.flush_s,
+                metrics=m,
+            )
+        else:
+            self._admission = None
         self._mesh = self._build_mesh(self.config.mesh_devices)  # guarded-by: _lock
         n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
         self.scheduler = Scheduler(  # guarded-by: _lock
@@ -475,6 +496,8 @@ class SolveService:
         deadline: Optional[float] = None,
         tol: Optional[float] = None,
         name: Optional[str] = None,
+        tenant: str = "default",
+        priority: str = "normal",
     ) -> Future:
         """Enqueue one LP; the Future resolves to a RequestResult.
 
@@ -483,6 +506,13 @@ class SolveService:
         batch — expiry is checked before a slot is assigned). ``tol``
         defaults to the service solver config's tolerance; a novel tol
         compiles its own bucket program once, then shares it.
+
+        ``tenant``/``priority`` feed the SLO-aware admission layer when
+        one is configured (``ServiceConfig.admission``): quota and
+        fair-share rejections raise :class:`ServiceOverloaded` with the
+        structured verdict (reason + retry_after_s), the priority class
+        shades the request's flush window, and deadlines order slot
+        assignment (EDF) inside its bucket queue.
         """
         sf = standard_form(problem)
         fp = None
@@ -512,29 +542,37 @@ class SolveService:
             deadline=None if deadline is None else now + deadline,
             problem=None if sf else problem,
             fp=fp,
+            tenant=tenant,
+            priority=priority,
+            flush_scale=(
+                self._admission.flush_scale(priority)
+                if self._admission is not None
+                else 1.0
+            ),
         )
         with self._wake:
             if self._stopping:
                 raise RuntimeError("SolveService is shut down")
             p.request_id = self._next_id
             self._next_id += 1
+            if self._admission is not None:
+                v = self._admission.admit(tenant, priority, now)
+                if not v.admitted:
+                    self._log_reject(p, v.reason, v.retry_after_s)
+                    raise ServiceOverloaded(
+                        f"admission rejected tenant {tenant!r}: "
+                        f"{v.reason} — {v.detail}",
+                        reason=v.reason,
+                        retry_after_s=v.retry_after_s,
+                        tenant=tenant,
+                    )
             try:
                 key = self.scheduler.add(p)
-            except ServiceOverloaded:
-                self.tracer.instant(
-                    "serve.reject",
-                    args={"id": p.request_id, "name": p.name},
-                    cat="serve",
-                )
-                self._logger.event(
-                    {
-                        "event": "reject",
-                        "id": p.request_id,
-                        "name": p.name,
-                        "queue_depth": self.scheduler.depth(),
-                    }
-                )
+            except ServiceOverloaded as e:
+                self._log_reject(p, e.reason, e.retry_after_s)
                 raise
+            if self._admission is not None:
+                self._admission.on_admitted(tenant)
             # Request track opens on the submit thread; the nested queue
             # span (and later pack/solve) begin/end on whichever pipeline
             # thread handles them — same (cat, id) keeps the track
@@ -550,6 +588,30 @@ class SolveService:
             self.tracer.async_begin("queue", p.request_id)
             self._wake.notify_all()
         return p.future
+
+    def _log_reject(
+        self, p: PendingRequest, reason: str, retry_after_s: float
+    ) -> None:  # holds: _lock
+        """One reject record per shed request: the verdict reason and
+        wait hint ride the event so overload post-mortems can tell a
+        quota-limited tenant from a depth wall."""
+        self.tracer.instant(
+            "serve.reject",
+            args={"id": p.request_id, "name": p.name, "reason": reason},
+            cat="serve",
+        )
+        self._logger.event(
+            {
+                "event": "reject",
+                "id": p.request_id,
+                "name": p.name,
+                "tenant": p.tenant,
+                "priority": p.priority,
+                "reason": reason,
+                "retry_after_s": round(retry_after_s, 6),
+                "queue_depth": self.scheduler.depth(),
+            }
+        )
 
     # -- pipeline stage 1: scheduler -------------------------------------
 
@@ -1277,6 +1339,15 @@ class SolveService:
             )
 
     def _finish(self, p: PendingRequest, result: RequestResult) -> None:
+        # Tenant/priority attribution is stamped here — the one funnel
+        # every result path (bucket, solo, timeout, fail) flows through
+        # — so the record, the future's result, and the admission
+        # accounting can never disagree on whose request this was.
+        result = dataclasses.replace(
+            result, tenant=p.tenant, priority=p.priority
+        )
+        if self._admission is not None:
+            self._admission.on_finished(p.tenant)
         with self._lock:
             # Stats only need the scalar fields; retaining every x would
             # grow a long-running service's memory without bound.
@@ -1509,6 +1580,23 @@ class SolveService:
 
     # -- introspection ---------------------------------------------------
 
+    def pipeline_alive(self) -> bool:
+        """True iff all three dispatcher pipeline threads are running —
+        the HTTP front-end's ``/healthz`` dispatcher-liveness check. A
+        service that was cleanly shut down (threads joined and nulled)
+        reports False; so does one whose thread died to an uncaught
+        error (which _run/_run_solve guard against, but the health
+        surface must not take that on faith)."""
+        threads = (self._thread, self._pack_thread, self._solve_thread)
+        return all(t is not None and t.is_alive() for t in threads)
+
+    def progress(self) -> tuple:
+        """(dispatch count, queue depth) — a cheap pulse for the HTTP
+        front-end's wedge detector: depth > 0 with the dispatch count
+        frozen across a window means the pipeline stopped consuming."""
+        with self._lock:
+            return self._dispatch_seq, self.scheduler.depth()
+
     def dispatch_report(self) -> List[dict]:
         """Per-dispatch timing rows (pack/compile/solve/overlap ms, mesh
         width) — the serving analogue of the driver's dispatch_timings
@@ -1558,4 +1646,13 @@ class SolveService:
             "phase_iters": phase_iters,
             "idle": idle,
             "buckets": buckets,
+            # Per-tenant admission accounting (None without the SLO
+            # layer): admitted/rejected-by-reason/in-system/tokens —
+            # the summary event's overload post-mortem surface, and the
+            # /statusz field the router's load tie-break reads past.
+            "admission": (
+                self._admission.stats()
+                if self._admission is not None
+                else None
+            ),
         }

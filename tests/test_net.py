@@ -1,0 +1,791 @@
+"""Network serving plane tests (README "Network serving"): protocol
+parsing, SLO-aware admission (token-bucket quotas, weighted-fair
+shares, priority flush shading), EDF slot assignment, the HTTP
+front-end surface (solve/metrics/healthz/statusz, sync + async), the
+router tier (shape/load routing, health-checked failover), and the
+probe_net.py tier-1 smoke.
+
+All CPU; servers bind ephemeral localhost ports.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import Status
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+from distributedlpsolver_tpu.net import (
+    AdmissionConfig,
+    AdmissionController,
+    NetConfig,
+    ProtocolError,
+    SolveHTTPServer,
+    TenantQuota,
+    parse_solve_request,
+    peek_route_hint,
+)
+from distributedlpsolver_tpu.net.router import (
+    Router,
+    RouterConfig,
+    RouterHTTPServer,
+)
+from distributedlpsolver_tpu.obs.metrics import MetricsRegistry
+from distributedlpsolver_tpu.serve import (
+    BucketSpec,
+    BucketTable,
+    ServiceConfig,
+    ServiceOverloaded,
+    SolveService,
+)
+from distributedlpsolver_tpu.serve.scheduler import PendingRequest, Scheduler
+
+pytestmark = pytest.mark.net
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _req(request_id, now, deadline=None, flush_scale=1.0, m=8, n=24):
+    rng = np.random.default_rng(request_id)
+    return PendingRequest(
+        request_id=request_id,
+        name=f"r{request_id}",
+        c=rng.standard_normal(n),
+        A=rng.standard_normal((m, n)),
+        b=rng.standard_normal(m),
+        tol=1e-8,
+        future=None,
+        t_submit=now,
+        deadline=deadline,
+        flush_scale=flush_scale,
+    )
+
+
+def _http(url, body=None, timeout=60.0):
+    req = urllib.request.Request(
+        url,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:
+            return e.code, {}
+
+
+# ---------------------------------------------------------------------------
+# protocol
+
+
+def test_parse_json_inline_problem():
+    p = random_dense_lp(4, 9, seed=3)
+    body = json.dumps(
+        {
+            "problem": {
+                "c": p.c.tolist(),
+                "A": np.asarray(p.A).tolist(),
+                "b": p.rlb.tolist(),
+            },
+            "tol": 1e-6,
+            "deadline_ms": 250,
+            "tenant": "acme",
+            "priority": "high",
+            "id": "job-1",
+        }
+    ).encode()
+    req = parse_solve_request(body, "application/json")
+    assert req.problem.m == 4 and req.problem.n == 9
+    assert req.tol == 1e-6
+    assert req.deadline_s == 0.25
+    assert req.tenant == "acme" and req.priority == "high"
+    assert req.name == "job-1" and not req.want_async
+
+
+def test_parse_generated_and_query_fields():
+    req = parse_solve_request(
+        json.dumps({"m": 6, "n": 14, "seed": 1}).encode(),
+        "application/json",
+        query="tenant=t9&deadline_ms=100",
+    )
+    assert req.problem.m == 6 and req.tenant == "t9"
+    assert req.deadline_s == 0.1
+
+
+def test_parse_mps_body(tmp_path):
+    from distributedlpsolver_tpu.io.mps import write_mps
+
+    p = random_dense_lp(3, 7, seed=5)
+    path = tmp_path / "p.mps"
+    write_mps(p, str(path))
+    req = parse_solve_request(
+        path.read_bytes(), "text/plain", query="tenant=mps&tol=1e-7"
+    )
+    assert req.problem.m == 3 and req.problem.n == 7
+    assert req.tenant == "mps" and req.tol == 1e-7
+
+
+@pytest.mark.parametrize(
+    "body,ctype",
+    [
+        (b"not json", "application/json"),
+        (b"{}", "application/json"),
+        (b'{"problem": {"c": [1], "A": [[1, 2]], "b": [1]}}',
+         "application/json"),
+        (b"", "text/plain"),
+    ],
+)
+def test_parse_rejects_malformed(body, ctype):
+    with pytest.raises(ProtocolError):
+        parse_solve_request(body, ctype)
+
+
+def test_peek_route_hint():
+    assert peek_route_hint(
+        json.dumps({"m": 8, "n": 24, "tol": 1e-6}).encode(),
+        "application/json",
+    ) == (8, 24, 1e-6)
+    inline = json.dumps(
+        {"problem": {"c": [1, 2, 3], "A": [[1, 2, 3]], "b": [4]}}
+    ).encode()
+    assert peek_route_hint(inline, "application/json") == (1, 3, 1e-8)
+    assert peek_route_hint(b"RAW MPS", "text/plain") is None
+    assert peek_route_hint(b"RAW MPS", "text/plain", query="m=5&n=9") == (
+        5, 9, 1e-8,
+    )
+
+
+# ---------------------------------------------------------------------------
+# admission: quotas, fairness, priority shading
+
+
+def test_quota_exhaustion_and_refill():
+    clock = [0.0]
+    ctl = AdmissionController(
+        AdmissionConfig(quotas={"t": TenantQuota(rate=10.0, burst=2.0)}),
+        max_depth=100,
+        clock=lambda: clock[0],
+    )
+    assert ctl.admit("t").admitted
+    assert ctl.admit("t").admitted
+    v = ctl.admit("t")
+    assert not v.admitted and v.reason == "quota"
+    assert 0 < v.retry_after_s <= 0.1  # next token at rate 10/s
+    clock[0] += v.retry_after_s  # wait exactly the hint -> admitted
+    assert ctl.admit("t").admitted
+    stats = ctl.stats()["t"]
+    assert stats["admitted"] == 3 and stats["rejected"] == {"quota": 1}
+
+
+def test_unmetered_tenant_never_quota_rejected():
+    ctl = AdmissionController(AdmissionConfig(), max_depth=100)
+    for _ in range(500):
+        assert ctl.admit("anyone").admitted
+
+
+def test_weighted_fair_rejects_hog_under_contention_only():
+    ctl = AdmissionController(
+        AdmissionConfig(
+            quotas={
+                "hog": TenantQuota(weight=1.0),
+                "vip": TenantQuota(weight=3.0),
+            },
+            fair_start=0.5,
+        ),
+        max_depth=16,
+    )
+    # Below the contention threshold the hog may burst past its share.
+    for _ in range(7):
+        assert ctl.admit("hog").admitted
+        ctl.on_admitted("hog")
+    # Past fair_start (8 of 16): hog's share is 1/4 of 16 = 4 < 7 held.
+    ctl.on_admitted("hog")  # 8 in system
+    v = ctl.admit("hog")
+    assert not v.admitted and v.reason == "fair"
+    assert v.retry_after_s > 0
+    # The vip's share (3/4 of 16 = 12) still has room.
+    assert ctl.admit("vip").admitted
+    # Hog work finishing frees its share again.
+    for _ in range(6):
+        ctl.on_finished("hog")
+    assert ctl.admit("hog").admitted
+
+
+def test_priority_flush_scale_defaults():
+    ctl = AdmissionController(AdmissionConfig(), max_depth=8)
+    assert ctl.flush_scale("high") == 0.25
+    assert ctl.flush_scale("normal") == 1.0
+    assert ctl.flush_scale("batch") == 4.0
+    assert ctl.flush_scale("unknown-class") == 1.0
+
+
+def test_service_overloaded_carries_verdict():
+    svc = SolveService(
+        ServiceConfig(
+            batch=4, flush_s=0.02, max_queue_depth=100,
+            admission=AdmissionConfig(
+                quotas={"q": TenantQuota(rate=1.0, burst=1.0)}
+            ),
+        ),
+        auto_start=False,
+    )
+    try:
+        svc.submit(random_dense_lp(4, 9, seed=0), tenant="q")
+        with pytest.raises(ServiceOverloaded) as ei:
+            svc.submit(random_dense_lp(4, 9, seed=1), tenant="q")
+        assert ei.value.reason == "quota"
+        assert ei.value.tenant == "q"
+        assert ei.value.retry_after_s > 0
+        assert svc.stats()["admission"]["q"]["rejected"] == {"quota": 1}
+    finally:
+        svc.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# scheduler: EDF slot assignment + priority-shaded flush
+
+
+def test_edf_pop_orders_by_deadline_then_arrival():
+    table = BucketTable(None, batch=4)
+    s = Scheduler(table, max_depth=100, flush_s=10.0)
+    now = 100.0
+    # Arrival order: no-deadline, late deadline, early deadline, middle.
+    reqs = [
+        _req(0, now + 0.00),
+        _req(1, now + 0.01, deadline=now + 9.0),
+        _req(2, now + 0.02, deadline=now + 1.0),
+        _req(3, now + 0.03, deadline=now + 5.0),
+    ]
+    for p in reqs:
+        s.add(p)
+    key = next(iter(s.occupancy()))  # all same shape -> one queue
+    live, expired = s.pop(
+        (table.spec_for(8, 24), 1e-8), now + 0.1
+    )
+    assert not expired
+    # EDF: earliest deadline first; the deadline-less request sorts last.
+    assert [p.request_id for p in live] == [2, 3, 1, 0]
+
+
+def test_edf_pop_keeps_fifo_without_deadlines_and_splits_expired():
+    table = BucketTable(None, batch=2)
+    s = Scheduler(table, max_depth=100, flush_s=10.0)
+    now = 10.0
+    for i in range(4):
+        s.add(_req(i, now + i * 0.01))
+    s.add(_req(99, now, deadline=now + 0.05))  # expires before pop
+    live, expired = s.pop((table.spec_for(8, 24), 1e-8), now + 1.0)
+    # Expired split out even though it was beyond the batch head.
+    assert [p.request_id for p in expired] == [99]
+    assert [p.request_id for p in live] == [0, 1]  # FIFO preserved
+    live2, _ = s.pop((table.spec_for(8, 24), 1e-8), now + 1.0)
+    assert [p.request_id for p in live2] == [2, 3]
+    assert s.depth() == 0
+
+
+def test_priority_flush_scale_shades_ready_and_next_event():
+    table = BucketTable(None, batch=8)
+    s = Scheduler(table, max_depth=100, flush_s=1.0)
+    now = 50.0
+    s.add(_req(0, now, flush_scale=4.0))  # batch class: flush at 4 s
+    assert s.ready(now + 1.5) == []  # plain flush_s would have fired
+    t = s.next_event_in(now + 1.5)
+    assert t == pytest.approx(2.5, abs=1e-6)
+    s.add(_req(1, now + 2.0, flush_scale=0.25))  # high: flush at .25 s
+    key = (table.spec_for(8, 24), 1e-8)
+    assert s.ready(now + 2.3) == [key]
+
+
+def _flood_leg(admission):
+    """One starvation-scenario leg: 8 threads flood 'loose' traffic
+    while 10 'tight' requests arrive on a steady clock. Returns the
+    tight tenant's sorted queue waits (ms), the flood's results, and
+    how often either side was shed."""
+    svc = SolveService(
+        ServiceConfig(
+            batch=8, flush_s=0.02, max_queue_depth=64, pipeline_depth=1,
+            admission=admission,
+        )
+    )
+    loose_f, tight_f = [], []
+    shed = {"loose": 0, "tight": 0}
+    try:
+        # Warm the (8,24) bucket program first: the measured phase is
+        # about queueing policy, not the one-time compile.
+        warm = [
+            svc.submit(random_dense_lp(8, 24, seed=k), tenant="warm")
+            for k in range(8)
+        ]
+        assert svc.drain(timeout=300)
+        assert all(
+            f.result(timeout=10).status is Status.OPTIMAL for f in warm
+        )
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def flood():
+            # Sustained: keeps submitting for the whole tight stream
+            # (bounded at 400 futures so the drain stays finite).
+            k = 0
+            while not stop.is_set():
+                with lock:
+                    if len(loose_f) >= 400:
+                        return
+                try:
+                    fut = svc.submit(
+                        random_dense_lp(8, 24, seed=500 + k),
+                        tenant="loose",
+                    )
+                    with lock:
+                        loose_f.append(fut)
+                except ServiceOverloaded:
+                    with lock:
+                        shed["loose"] += 1
+                    time.sleep(0.002)
+                k += 1
+
+        flooders = [threading.Thread(target=flood) for _ in range(8)]
+        for t in flooders:
+            t.start()
+        time.sleep(0.1)  # let the flood build a real queue
+        for k in range(10):
+            t_first = time.perf_counter()
+            while True:
+                try:
+                    fut = svc.submit(
+                        random_dense_lp(8, 24, seed=900 + k),
+                        tenant="tight",
+                        priority="high",
+                        deadline=30.0,
+                    )
+                    break
+                except ServiceOverloaded:
+                    # Without the SLO layer the depth backstop sheds the
+                    # tight tenant too — that IS starvation; count it
+                    # and keep trying like a real client would. The
+                    # retry delay is part of the tenant's wait.
+                    shed["tight"] += 1
+                    time.sleep(0.005)
+            tight_f.append(
+                (fut, (time.perf_counter() - t_first) * 1e3)
+            )
+            time.sleep(0.03)
+        stop.set()
+        for t in flooders:
+            t.join(timeout=30)
+        assert svc.drain(timeout=120)
+        tight_r = [(f.result(timeout=10), d) for f, d in tight_f]
+        loose_r = [f.result(timeout=10) for f in loose_f]
+    finally:
+        svc.shutdown(drain=False)
+    assert all(r.status is Status.OPTIMAL for r, _ in tight_r)
+    assert all(r.status is Status.OPTIMAL for r in loose_r)
+    assert all(r.tenant == "tight" for r, _ in tight_r)
+    # The tenant-perspective wait: admission retry delay (the 429/shed
+    # loop) + post-admission queue wait until slot assignment.
+    return sorted(d + r.queue_ms for r, d in tight_r), loose_r, shed
+
+
+def test_tight_slo_tenant_not_starved_by_loose_flood():
+    """Starvation A/B: the same tight-SLO stream under the same loose
+    flood, with the SLO-aware layer ON (weighted-fair admission + EDF +
+    priority flush shading) vs OFF (plain FIFO, depth backstop only).
+    The layer must cut the tight tenant's queue waits — median AND
+    worst case — and shed the flood, never the tight tenant."""
+    slo = AdmissionConfig(
+        quotas={
+            "tight": TenantQuota(weight=3.0),
+            "loose": TenantQuota(weight=1.0),
+        },
+        fair_start=0.25,
+    )
+    tq_slo, _, shed_slo = _flood_leg(slo)
+    tq_fifo, _, shed_fifo = _flood_leg(None)
+    # The flood really overloaded both legs.
+    assert shed_slo["loose"] >= 1
+    assert shed_fifo["loose"] >= 1
+    # With the layer on, the tight tenant is never shed at admission;
+    # without it, the depth backstop starves the tight tenant's own
+    # submits behind the flood.
+    assert shed_slo["tight"] == 0
+    assert shed_fifo["tight"] >= 1
+    # And the tight tenant's worst-case wait (admission delay + queue)
+    # is strictly better with the layer on.
+    assert max(tq_slo) < max(tq_fifo), (tq_slo, tq_fifo)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+
+
+@pytest.fixture
+def backend():
+    reg = MetricsRegistry()
+    svc = SolveService(
+        ServiceConfig(
+            batch=4, flush_s=0.02, max_queue_depth=64,
+            admission=AdmissionConfig(
+                quotas={"limited": TenantQuota(rate=2.0, burst=1.0)}
+            ),
+        ),
+        metrics=reg,
+    )
+    front = SolveHTTPServer(
+        svc, NetConfig(healthz_cache_s=0.02), metrics=reg
+    ).start()
+    yield front
+    front.shutdown()
+    svc.shutdown()
+
+
+def test_http_sync_solve_and_records(backend):
+    code, out = _http(
+        backend.url + "/v1/solve",
+        {"m": 8, "n": 24, "seed": 4, "tenant": "acme", "id": "sync-1"},
+    )
+    assert code == 200
+    assert out["status"] == "optimal" and out["tenant"] == "acme"
+    assert out["name"] == "sync-1"
+    assert len(out["x"]) == 24
+    # Objective agrees with a direct solve of the same generated LP.
+    from distributedlpsolver_tpu.ipm import solve
+
+    ref = solve(random_dense_lp(8, 24, seed=4))
+    assert out["objective"] == pytest.approx(ref.objective, rel=1e-6)
+
+
+def test_http_mps_body_roundtrip(backend, tmp_path):
+    from distributedlpsolver_tpu.io.mps import write_mps
+
+    p = random_dense_lp(6, 14, seed=8)
+    path = tmp_path / "p.mps"
+    write_mps(p, str(path))
+    req = urllib.request.Request(
+        backend.url + "/v1/solve?tenant=mps",
+        data=path.read_bytes(),
+        headers={"Content-Type": "text/plain"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.loads(r.read())
+    assert out["status"] == "optimal" and out["tenant"] == "mps"
+
+
+def test_http_bad_request_is_400(backend):
+    code, out = _http(backend.url + "/v1/solve", {"nope": 1})
+    assert code == 400 and "error" in out
+    code, _ = _http(backend.url + "/v1/nothing", {"m": 4, "n": 9})
+    assert code == 404
+
+
+def test_http_async_flow(backend):
+    code, out = _http(
+        backend.url + "/v1/solve",
+        {"m": 8, "n": 24, "seed": 2, "async": True},
+    )
+    assert code == 202 and out["href"].startswith("/v1/solve/")
+    deadline = time.perf_counter() + 60
+    while True:
+        code, res = _http(backend.url + out["href"])
+        if code != 202 or time.perf_counter() > deadline:
+            break
+        time.sleep(0.02)
+    assert code == 200 and res["status"] == "optimal"
+    code, _ = _http(backend.url + "/v1/solve/bogus-id")
+    assert code == 404
+
+
+def test_http_429_with_retry_after(backend):
+    # burst=1 at 2/s: the second immediate submit must shed.
+    codes = []
+    for k in range(2):
+        code, out = _http(
+            backend.url + "/v1/solve",
+            {"m": 8, "n": 24, "seed": 40 + k, "tenant": "limited",
+             "async": True},
+        )
+        codes.append((code, out))
+    (c1, _), (c2, o2) = codes
+    assert c1 == 202
+    assert c2 == 429
+    assert o2["reason"] == "quota" and o2["retry_after_s"] > 0
+
+
+def test_http_deadline_maps_to_504(backend):
+    # A microscopic deadline expires while queued -> service TIMEOUT ->
+    # HTTP 504 with the solver's verdict in the body.
+    code, out = _http(
+        backend.url + "/v1/solve",
+        {"m": 8, "n": 24, "seed": 77, "deadline_ms": 0.01},
+    )
+    assert code == 504
+    assert out.get("status") in ("timeout", None)
+
+
+def test_http_metrics_and_statusz(backend):
+    _http(backend.url + "/v1/solve", {"m": 8, "n": 24, "seed": 11})
+    with urllib.request.urlopen(backend.url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "net_requests_total" in text
+    assert "net_inflight" in text
+    assert "serve_requests_total" in text  # one registry, whole backend
+    code, st = _http(backend.url + "/statusz")
+    assert code == 200
+    assert st["net"]["requests_total"] >= 1
+    assert st["stats"]["requests"] >= 1
+    assert "admission" in st["stats"]
+
+
+def test_healthz_flips_on_device_loss_and_wedge(backend):
+    import jax
+
+    from distributedlpsolver_tpu.parallel.runtime import (
+        restore_devices,
+        simulate_device_loss,
+    )
+
+    code, body = _http(backend.url + "/healthz")
+    assert code == 200 and body["status"] == "ok"
+    try:
+        simulate_device_loss([d.id for d in jax.devices()])
+        time.sleep(0.05)  # step past the healthz cache
+        code, body = _http(backend.url + "/healthz")
+        assert code == 503
+        assert body["devices_unhealthy"]
+    finally:
+        restore_devices()
+    time.sleep(0.05)
+    code, body = _http(backend.url + "/healthz")
+    assert code == 200 and body["pipeline_alive"]
+
+
+# ---------------------------------------------------------------------------
+# router tier
+
+
+def _mk_backend(reg=None):
+    reg = reg or MetricsRegistry()
+    svc = SolveService(
+        ServiceConfig(batch=4, flush_s=0.02, max_queue_depth=64),
+        metrics=reg,
+    )
+    front = SolveHTTPServer(
+        svc, NetConfig(healthz_cache_s=0.02), metrics=reg
+    ).start()
+    return svc, front
+
+
+def test_router_routes_and_spreads_load():
+    svcs_fronts = [_mk_backend() for _ in range(2)]
+    router = Router(
+        [f.url for _, f in svcs_fronts],
+        RouterConfig(poll_s=0.1),
+        metrics=MetricsRegistry(),
+    ).start()
+    rhttp = RouterHTTPServer(router).start()
+    try:
+        for k in range(8):
+            code, out = _http(
+                rhttp.url + "/v1/solve", {"m": 8, "n": 24, "seed": k}
+            )
+            assert code == 200 and out["status"] == "optimal"
+        st = router.statusz()
+        forwards = [b["forwards"] for b in st["backends"]]
+        assert sum(forwards) == 8
+        assert all(f > 0 for f in forwards)  # both backends saw traffic
+    finally:
+        rhttp.shutdown()
+        router.shutdown()
+        for svc, front in svcs_fronts:
+            front.shutdown()
+            svc.shutdown()
+
+
+def test_router_shape_aware_pick_prefers_tight_bucket():
+    r = Router.__new__(Router)  # scoring is pure — no live backends
+    assert Router._padding_score(8, 24, [(8, 24, 8)]) == 0.0
+    loose = Router._padding_score(8, 24, [(16, 32, 8)])
+    assert 0 < loose < 1
+    assert Router._padding_score(100, 400, [(8, 24, 8)]) == 1.0
+
+
+def test_router_failover_no_request_lost():
+    """Kill a backend mid-stream: every request still completes via the
+    retry-once failover; the dead backend is ejected and the survivor
+    carries the tail."""
+    svcs_fronts = [_mk_backend() for _ in range(2)]
+    router = Router(
+        [f.url for _, f in svcs_fronts],
+        RouterConfig(poll_s=0.5),
+        metrics=MetricsRegistry(),
+    ).start()
+    rhttp = RouterHTTPServer(router).start()
+    results = []
+    try:
+        for k in range(20):
+            if k == 6:  # mid-stream kill, no drain
+                svcs_fronts[1][1].shutdown()
+            code, out = _http(
+                rhttp.url + "/v1/solve", {"m": 8, "n": 24, "seed": 200 + k}
+            )
+            results.append((code, out.get("status")))
+        assert all(c == 200 and s == "optimal" for c, s in results)
+        st = router.statusz()
+        dead = next(
+            b for b in st["backends"] if b["url"] == svcs_fronts[1][1].url
+        )
+        assert dead["ejected"]
+        code, body = _http(rhttp.url + "/healthz")
+        assert code == 200 and body["healthy_backends"] == 1
+    finally:
+        rhttp.shutdown()
+        router.shutdown()
+        svcs_fronts[0][1].shutdown()
+        for svc, _ in svcs_fronts:
+            svc.shutdown()
+
+
+def test_router_recovers_backend_on_health_return():
+    svc, front = _mk_backend()
+    router = Router(
+        [front.url, "http://127.0.0.1:1"],  # second is never alive
+        RouterConfig(poll_s=0.05, eject_after=1),
+        metrics=MetricsRegistry(),
+    ).start()
+    try:
+        time.sleep(0.2)
+        assert router.healthy_count() == 1
+        # Device loss flips the live backend's healthz -> ejected...
+        import jax
+
+        from distributedlpsolver_tpu.parallel.runtime import (
+            restore_devices,
+            simulate_device_loss,
+        )
+
+        try:
+            simulate_device_loss([d.id for d in jax.devices()])
+            deadline = time.perf_counter() + 10
+            while router.healthy_count() > 0:
+                assert time.perf_counter() < deadline, "never ejected"
+                time.sleep(0.05)
+        finally:
+            restore_devices()
+        # ... and recovery re-admits it without a restart.
+        deadline = time.perf_counter() + 10
+        while router.healthy_count() < 1:
+            assert time.perf_counter() < deadline, "never re-admitted"
+            time.sleep(0.05)
+    finally:
+        router.shutdown()
+        front.shutdown()
+        svc.shutdown()
+
+
+def test_router_metrics_and_events(tmp_path):
+    log = tmp_path / "router.jsonl"
+    svc, front = _mk_backend()
+    reg = MetricsRegistry()
+    router = Router(
+        [front.url],
+        RouterConfig(poll_s=0.1, log_jsonl=str(log)),
+        metrics=reg,
+    ).start()
+    rhttp = RouterHTTPServer(router, metrics=reg).start()
+    try:
+        code, _ = _http(rhttp.url + "/v1/solve", {"m": 8, "n": 24, "seed": 9})
+        assert code == 200
+        front.shutdown()  # now kill it and watch the ejection land
+        code, _ = _http(rhttp.url + "/v1/solve", {"m": 8, "n": 24, "seed": 10})
+        assert code in (502, 503)  # single backend: nothing to fail over to
+        with urllib.request.urlopen(rhttp.url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "router_backend_healthy" in text
+        assert "router_routed_total" in text
+    finally:
+        rhttp.shutdown()
+        router.shutdown()
+        svc.shutdown()
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    kinds = {e["event"] for e in events}
+    assert "route" in kinds and "backend_ejected" in kinds
+    route = next(e for e in events if e["event"] == "route")
+    assert route["m"] == 8 and route["backend"] == front.url
+    assert all("ts" in e and "schema_version" in e for e in events)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_serve_surfaces_admission_and_backoffs(tmp_path, capsys):
+    """The cli serve overload path uses the admission verdict's wait
+    hint and surfaces rejects in the summary (satellite fix)."""
+    from distributedlpsolver_tpu.cli import main
+
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text(
+        "".join(
+            json.dumps(
+                {"m": 8, "n": 24, "seed": s, "id": f"r{s}",
+                 "tenant": "only", "priority": "normal"}
+            ) + "\n"
+            for s in range(12)
+        )
+    )
+    out = tmp_path / "res.jsonl"
+    quotas = json.dumps(
+        {"tenants": {"only": {"rate": 200.0, "burst": 2.0}}}
+    )
+    rc = main(
+        [
+            "serve", "--requests", str(reqs), "--out", str(out),
+            "--batch", "4", "--flush-ms", "5", "--quotas", quotas,
+        ]
+    )
+    assert rc == 0
+    records = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(records) == 12
+    assert all(r["status"] == "optimal" for r in records)
+    assert all(r["tenant"] == "only" for r in records)
+    summary = json.loads(capsys.readouterr().err.strip().splitlines()[-1])
+    # burst 2 at 200/s against 12 fast submits: the reader must have
+    # been shed at least once, and the summary says so (both sides).
+    assert summary["submit_backoffs"] >= 1
+    assert summary["admission"]["only"]["rejected"].get("quota", 0) >= 1
+
+
+def test_cli_route_requires_backend():
+    from distributedlpsolver_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["route"])  # --backend is required
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: the full 200-request router/2-backend probe
+
+
+def test_probe_net_smoke():
+    """CI satellite: the network-plane acceptance probe (200 HTTP
+    requests, 2 tenants, router over 2 backends, mid-run kill, metrics/
+    healthz validity) runs on every tier-1 pass under a wall budget."""
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "probe_net.py"),
+         "--requests", "200", "--budget-s", "240"],
+        capture_output=True, text=True, timeout=400,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+    assert time.perf_counter() - t0 < 400
