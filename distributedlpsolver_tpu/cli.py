@@ -410,7 +410,9 @@ def cmd_serve(args) -> int:
                         # retry is pointless for THIS tenant (token
                         # refill / drain window) — sleep that, not a
                         # blind flush tick.
-                        wait = max(e.retry_after_s, 1e-3)
+                        # Clamped on both sides: the verdict is already
+                        # finite, but a sleep(inf) here would be fatal.
+                        wait = min(max(e.retry_after_s, 1e-3), 60.0)
                         backoffs += 1
                         backoff_s += wait
                         time.sleep(wait)

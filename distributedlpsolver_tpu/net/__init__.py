@@ -24,6 +24,7 @@ dependencies):
 from distributedlpsolver_tpu.net.admission import (
     AdmissionConfig,
     AdmissionController,
+    TenantLabeler,
     TenantQuota,
     Verdict,
 )
@@ -46,6 +47,7 @@ __all__ = [
     "RouterConfig",
     "SolveHTTPServer",
     "SolveRequest",
+    "TenantLabeler",
     "TenantQuota",
     "Verdict",
     "parse_solve_request",

@@ -8,8 +8,9 @@ backstop in the scheduler). Three verdict axes, checked in order:
 1. **Token-bucket quota** — each tenant refills ``rate`` tokens/sec up
    to ``burst``; a submit with an empty bucket is rejected
    ``reason="quota"`` with ``retry_after_s`` set to exactly when the
-   next token lands. This bounds a tenant's *sustained* rate no matter
-   how idle the service is.
+   next token lands (clamped to ``max_retry_after_s`` — a zero-rate
+   quota never hints an infinite wait). This bounds a tenant's
+   *sustained* rate no matter how idle the service is.
 2. **Weighted-fair share** — under contention (total in-system requests
    past ``fair_start`` of the depth bound) a tenant holding more than
    ``weight / Σ active weights`` of the depth bound is rejected
@@ -24,7 +25,10 @@ maps to a ``flush_scale`` multiplier on the scheduler's flush window
 (high = flush sooner at more padding waste, batch = wait longer for
 fuller buckets), and the scheduler's earliest-deadline-first pop orders
 slots within the bucket. Rejections are counted per (reason, tenant) on
-the obs registry (``net_admission_rejects_total``).
+the obs registry (``net_admission_rejects_total``); unconfigured
+tenants past ``max_tenant_labels`` share the ``other`` label, and their
+controller state LRU-evicts past ``max_tracked_tenants`` (both caps
+exist because tenant strings are client-controlled).
 
 Thread-safety: the controller has its own lock and never calls out of
 module scope while holding it; the service calls it from the submit
@@ -36,7 +40,8 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, Mapping, Optional
+from collections import OrderedDict
+from typing import Dict, Iterable, Mapping, Optional
 
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
 
@@ -81,6 +86,18 @@ class AdmissionConfig:
     priority_flush_scale: Mapping[str, float] = dataclasses.field(
         default_factory=lambda: dict(DEFAULT_PRIORITY_FLUSH_SCALE)
     )
+    # Ceiling on any verdict's retry_after_s: a zero-rate quota would
+    # otherwise hint "retry in inf seconds", which breaks strict-JSON
+    # bodies, the Retry-After header, and client sleep(wait) loops.
+    max_retry_after_s: float = 60.0
+    # Tenant strings are client-controlled; without a bound every novel
+    # tenant would permanently allocate controller state. Unconfigured
+    # tenants past this cap LRU-evict idle (zero in-system) states;
+    # configured tenants are never evicted.
+    max_tracked_tenants: int = 1024
+    # Distinct unconfigured tenants that get their own metric label
+    # before collapsing into "other" (bounds metric cardinality).
+    max_tenant_labels: int = 32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +110,34 @@ class Verdict:
     retry_after_s: float = 0.0
     tenant: str = "default"
     detail: str = ""
+
+
+class TenantLabeler:
+    """Bounded tenant -> metric-label map. Configured tenants always
+    keep their own label; the first ``cap`` distinct unconfigured
+    tenants do too; every later novel tenant collapses into ``"other"``
+    so a client-controlled tenant string cannot grow metric cardinality
+    without bound. Shared by the admission reject counters and the HTTP
+    front-end's ``net_requests_total`` so both families agree."""
+
+    OTHER = "other"
+
+    def __init__(self, configured: Iterable[str] = (), cap: int = 32):
+        self._configured = frozenset(configured)
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._extra: Dict[str, None] = {}  # guarded-by: _lock
+
+    def label(self, tenant: str) -> str:
+        if tenant in self._configured:
+            return tenant
+        with self._lock:
+            if tenant in self._extra:
+                return tenant
+            if len(self._extra) < self._cap:
+                self._extra[tenant] = None
+                return tenant
+        return self.OTHER
 
 
 class _TenantState:
@@ -132,9 +177,16 @@ class AdmissionController:
         self.flush_s = flush_s
         self._clock = clock
         self._lock = threading.Lock()
-        self._tenants: Dict[str, _TenantState] = {}  # guarded-by: _lock
+        # LRU order (most-recent last) so the unconfigured-tenant cap
+        # can evict the coldest idle state first.
+        self._tenants: "OrderedDict[str, _TenantState]" = (
+            OrderedDict()
+        )  # guarded-by: _lock
         m = metrics if metrics is not None else obs_metrics.get_registry()
         self._metrics = m
+        self.labeler = TenantLabeler(
+            self.config.quotas, cap=self.config.max_tenant_labels
+        )
         self._m_rejects: Dict[tuple, object] = {}  # guarded-by: _lock
         self._m_in_system = m.gauge(
             "net_admission_in_system",
@@ -149,9 +201,27 @@ class AdmissionController:
 
     def _state(self, tenant: str) -> _TenantState:  # holds: _lock
         st = self._tenants.get(tenant)
-        if st is None:
-            st = _TenantState(self.quota_for(tenant).burst)
-            self._tenants[tenant] = st
+        if st is not None:
+            self._tenants.move_to_end(tenant)
+            return st
+        st = _TenantState(self.quota_for(tenant).burst)
+        self._tenants[tenant] = st
+        # Bound client-controlled state: past the cap, drop the coldest
+        # idle unconfigured states. Eviction resets a returning
+        # tenant's token bucket to full burst — acceptable for the
+        # unconfigured (default-unmetered) tenants this applies to;
+        # configured quotas never lose accounting.
+        configured = self.config.quotas
+        extra = sum(1 for name in self._tenants if name not in configured)
+        if extra > self.config.max_tracked_tenants:
+            for name in list(self._tenants):
+                if extra <= self.config.max_tracked_tenants:
+                    break
+                if name == tenant or name in configured:
+                    continue
+                if self._tenants[name].in_system == 0:
+                    del self._tenants[name]
+                    extra -= 1
         return st
 
     def _refill(self, st: _TenantState, q: TenantQuota, now: float) -> None:
@@ -170,15 +240,17 @@ class AdmissionController:
         self, st: _TenantState, tenant: str, reason: str,
         retry_after_s: float, detail: str,
     ) -> Verdict:  # holds: _lock
+        retry_after_s = min(retry_after_s, self.config.max_retry_after_s)
         st.rejected[reason] = st.rejected.get(reason, 0) + 1
-        ctr = self._m_rejects.get((reason, tenant))
+        label = self.labeler.label(tenant)
+        ctr = self._m_rejects.get((reason, label))
         if ctr is None:
             ctr = self._metrics.counter(
                 "net_admission_rejects_total",
-                labels={"reason": reason, "tenant": tenant},
+                labels={"reason": reason, "tenant": label},
                 help="admission rejections by verdict reason and tenant",
             )
-            self._m_rejects[(reason, tenant)] = ctr
+            self._m_rejects[(reason, label)] = ctr
         ctr.inc()
         return Verdict(
             admitted=False, reason=reason,

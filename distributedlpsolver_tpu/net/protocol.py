@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import urllib.parse
 from typing import Optional, Tuple
 
@@ -33,6 +34,14 @@ import numpy as np
 
 from distributedlpsolver_tpu.ipm.state import Status
 from distributedlpsolver_tpu.models.problem import LPProblem
+
+# Every application-level response a backend front-end sends carries
+# this header. It lets the router tell a backend-ORIGINATED 504/503
+# (a solver TIMEOUT verdict, a graceful shutdown — normal outcomes that
+# must pass through to the client) from a transport/gateway failure of
+# the same code, which is failover evidence.
+PLANE_HEADER = "X-DLPS-Plane"
+PLANE_BACKEND = "backend"
 
 
 class ProtocolError(ValueError):
@@ -182,20 +191,28 @@ _STATUS_HTTP = {
 }
 
 
+def _finite(v) -> Optional[float]:
+    """float(v), or None when non-finite: TIMEOUT/FAILED results carry
+    inf gaps/residuals (and NaN objectives), and ``json.dumps`` would
+    serialize those as ``Infinity``/``NaN`` — not valid JSON, so strict
+    clients could not parse exactly the error bodies."""
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
 def result_payload(result, include_x: bool = True) -> Tuple[int, dict]:
-    """(http_code, response_body) for one finished request."""
+    """(http_code, response_body) for one finished request. All float
+    fields are sanitized to strict JSON (non-finite -> null)."""
     code = _STATUS_HTTP.get(result.status, 200)
     body = {
         "id": result.request_id,
         "name": result.name,
         "status": result.status.value,
-        "objective": None
-        if result.objective != result.objective  # NaN -> null
-        else float(result.objective),
+        "objective": _finite(result.objective),
         "iterations": int(result.iterations),
-        "rel_gap": float(result.rel_gap),
-        "pinf": float(result.pinf),
-        "dinf": float(result.dinf),
+        "rel_gap": _finite(result.rel_gap),
+        "pinf": _finite(result.pinf),
+        "dinf": _finite(result.dinf),
         "bucket": list(result.bucket) if result.bucket else None,
         "m": int(result.m),
         "n": int(result.n),

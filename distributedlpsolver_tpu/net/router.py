@@ -18,10 +18,15 @@ that drive routing:
   probes (or one failed forward — a dead socket is better evidence
   than a stale 200) ejects a backend from rotation; the poll thread
   keeps probing ejected backends and re-admits on recovery. Forwards
-  that die on a transport error or 502/503/504 are retried ONCE on the
-  next-best backend — retry-once keeps a dead backend's in-flight
-  requests alive without letting a poisoned request storm every
-  backend.
+  that die on a transport error, or come back 502/503/504 WITHOUT the
+  backend's ``X-DLPS-Plane`` header, are retried ONCE on the next-best
+  backend — retry-once keeps a dead backend's in-flight requests alive
+  without letting a poisoned request storm every backend. A 504/503
+  that DOES carry the header is the backend talking (a solver TIMEOUT
+  verdict, a graceful shutdown — normal SLO outcomes, not failover
+  evidence) and passes through to the client without ejecting the
+  backend: under a deadline storm, ejecting on those would empty the
+  whole rotation and duplicate every shed solve elsewhere.
 
 Everything is stdlib: ``urllib.request`` for forwarding,
 ``http.server`` for the front. Async-poll ids are backend-local, so
@@ -337,10 +342,20 @@ class Router:
             if st is not None and st.live > 0:
                 st.live -= 1
 
+    @staticmethod
+    def _from_backend(headers) -> bool:
+        """True when the response was application-level (the backend
+        front-end stamped it) rather than a gateway/transport artifact
+        of the same status code."""
+        return (
+            headers.get(protocol.PLANE_HEADER) == protocol.PLANE_BACKEND
+        )
+
     def _forward_once(
         self, url: str, path: str, body: bytes, content_type: str,
         method: str,
-    ) -> Tuple[int, bytes]:
+    ) -> Tuple[int, bytes, bool]:
+        """(code, body, from_backend) for one forward attempt."""
         req = urllib.request.Request(
             url + path,
             data=body if method == "POST" else None,
@@ -351,9 +366,12 @@ class Router:
             with urllib.request.urlopen(
                 req, timeout=self.config.forward_timeout_s
             ) as resp:
-                return resp.status, resp.read()
+                return (
+                    resp.status, resp.read(),
+                    self._from_backend(resp.headers),
+                )
         except urllib.error.HTTPError as e:
-            return e.code, e.read()
+            return e.code, e.read(), self._from_backend(e.headers)
 
     def forward(
         self, path: str, body: bytes, content_type: str, method: str = "POST"
@@ -361,8 +379,12 @@ class Router:
         """Route + forward one request with retry-once failover. Returns
         (code, body, backend) — backend None means no backend was
         routable (the 503 path). Transport errors and gateway-class
-        responses (502/503/504) from the first backend eject it and
-        retry exactly once elsewhere."""
+        responses (502/503/504 WITHOUT the backend's plane header) from
+        the first backend eject it and retry exactly once elsewhere.
+        A backend-stamped 504/503 — the solver's own TIMEOUT verdict or
+        a graceful shutdown — is a normal response: it passes through
+        without ejecting the (healthy) backend or duplicating the solve
+        on a second one."""
         hint = (
             protocol.peek_route_hint(
                 body, content_type, urlsplit(path).query
@@ -378,12 +400,12 @@ class Router:
                 return 503, b"", None
             t0 = time.perf_counter()
             try:
-                code, payload = self._forward_once(
+                code, payload, from_backend = self._forward_once(
                     url, path, body, content_type, method
                 )
                 transport_dead = False
             except (urllib.error.URLError, socket.timeout, OSError):
-                code, payload = 502, b""
+                code, payload, from_backend = 502, b"", False
                 transport_dead = True
             finally:
                 self._release(url)
@@ -400,7 +422,9 @@ class Router:
                     "retried": attempt > 0,
                 }
             )
-            if transport_dead or code in (502, 503, 504):
+            if transport_dead or (
+                code in (502, 503, 504) and not from_backend
+            ):
                 self._note_forward_failure(url)
                 if attempt == 0:
                     tried = (url,)
@@ -596,7 +620,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     )
                     return
                 try:
-                    code, payload = front.router._forward_once(
+                    code, payload, _ = front.router._forward_once(
                         url, path, b"", "application/json", "GET"
                     )
                 except (urllib.error.URLError, socket.timeout, OSError):

@@ -42,6 +42,7 @@ from typing import Dict, Optional, Tuple
 from urllib.parse import urlsplit
 
 from distributedlpsolver_tpu.net import protocol
+from distributedlpsolver_tpu.net.admission import TenantLabeler
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
 from distributedlpsolver_tpu.serve.scheduler import ServiceOverloaded
 from distributedlpsolver_tpu.utils.logging import IterLogger
@@ -107,6 +108,15 @@ class SolveHTTPServer:
         # shows the whole backend (serve_* and net_* families together).
         self.metrics = metrics if metrics is not None else service.metrics
         m = self.metrics
+        # Tenant strings are client-controlled: bound the metric label
+        # set, sharing the admission controller's labeler when the
+        # service has one so both metric families agree on "other".
+        adm = getattr(service, "admission", None)
+        self._tenant_labels = (
+            adm.labeler
+            if adm is not None and hasattr(adm, "labeler")
+            else TenantLabeler()
+        )
         self._m_by_code: Dict[tuple, object] = {}  # guarded-by: _lock
         self._m_inflight = m.gauge(
             "net_inflight", help="HTTP requests currently being handled"
@@ -184,19 +194,20 @@ class SolveHTTPServer:
         tenant: str, request_id,
     ) -> None:
         ms = (time.perf_counter() - t0) * 1e3
+        label = self._tenant_labels.label(tenant)
         with self._lock:
             self._inflight -= 1
             self._requests_total += 1
             self._by_code[code] = self._by_code.get(code, 0) + 1
             self._m_inflight.set(self._inflight)
-            ctr = self._m_by_code.get((code, tenant))
+            ctr = self._m_by_code.get((code, label))
             if ctr is None:
                 ctr = self.metrics.counter(
                     "net_requests_total",
-                    labels={"code": str(code), "tenant": tenant},
+                    labels={"code": str(code), "tenant": label},
                     help="HTTP requests by response code and tenant",
                 )
-                self._m_by_code[(code, tenant)] = ctr
+                self._m_by_code[(code, label)] = ctr
         ctr.inc()
         self._m_http_ms.observe(ms)
         self._logger.event(
@@ -299,6 +310,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # Marks this as an application-level response: the router must
+        # not read a backend-originated 504 (solver TIMEOUT verdict) or
+        # 503 as gateway failure and eject a healthy backend.
+        self.send_header(protocol.PLANE_HEADER, protocol.PLANE_BACKEND)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -309,6 +324,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        self.send_header(protocol.PLANE_HEADER, protocol.PLANE_BACKEND)
         self.end_headers()
         self.wfile.write(body)
 
@@ -348,7 +364,9 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             except ServiceOverloaded as e:
                 code = 429
-                retry = max(e.retry_after_s, 0.001)
+                # Admission clamps its hints, but keep the header/body
+                # finite no matter which path raised the overload.
+                retry = min(max(e.retry_after_s, 0.001), 3600.0)
                 self._send_json(
                     code,
                     {

@@ -342,6 +342,9 @@ class SolveService:
             )
         else:
             self._admission = None
+        # Read-only surface for the HTTP front-end (shared tenant
+        # labeler) and introspection; None without the SLO layer.
+        self.admission = self._admission
         self._mesh = self._build_mesh(self.config.mesh_devices)  # guarded-by: _lock
         n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
         self.scheduler = Scheduler(  # guarded-by: _lock
