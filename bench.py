@@ -1042,6 +1042,101 @@ def run_scale(args) -> list:
     return rows
 
 
+def _bench_sparse(args) -> list:
+    """Huge-sparse tier rows (``--sparse``): the SAME storm-profile
+    instance through each engine of the tier so the win is attributable —
+    matrix-free inexact IPM (PCG normal equations, 1e-8), restarted PDHG
+    (matrix-free first-order, its 1e-4 tier), and the dense baseline
+    (only where the dense assembly fits — at storm scale the row records
+    WHY it is absent instead of silently shrinking the instance).
+    Columns include density/nnz/cg_iters/precond so BENCH_SPARSE.json
+    tracks preconditioner quality over rounds, not just wall clock."""
+    from distributedlpsolver_tpu.backends.base import get_backend
+    from distributedlpsolver_tpu.models.generators import storm_sparse_lp
+
+    K = 32 if args.quick else 320
+    p = storm_sparse_lp(K, 64, 96, 64, seed=1)
+    m, n = p.A.shape
+    nnz = int(p.A.nnz)
+    base = {
+        "family": "sparse",
+        "instance": p.name,
+        "m": m,
+        "n": n,
+        "nnz": nnz,
+        "density": round(nnz / (m * n), 6),
+    }
+    rows = []
+
+    def add(row):
+        row["platform"] = args.platform
+        rows.append(row)
+        _log(json.dumps(row))
+
+    # 1. matrix-free inexact IPM at full tolerance.
+    be = get_backend("sparse-iterative")
+    r = _solve_timed(p, be, tol=1e-8, max_iter=200)
+    rep = be.cg_report()
+    add(
+        dict(
+            base,
+            engine="sparse-iterative",
+            tol=1e-8,
+            status=r.status.value,
+            iters=int(r.iterations),
+            cg_iters=int(rep["cg_iters"]),
+            precond=rep["precond"],
+            time_s=round(r.solve_time, 4),
+            setup_s=round(r.setup_time, 4),
+            max_operand_mb=round(be.max_operand_nbytes() / 1e6, 2),
+        )
+    )
+
+    # 2. restarted PDHG at its tolerance tier (matrix-free first-order).
+    r = _solve_timed(p, "pdlp", tol=1e-4)
+    add(
+        dict(
+            base,
+            engine="pdhg",
+            tol=1e-4,
+            status=r.status.value,
+            iters=int(r.iterations),
+            time_s=round(r.solve_time, 4),
+            setup_s=round(r.setup_time, 4),
+        )
+    )
+
+    # 3. dense baseline on the SAME instance — only while the dense
+    # assembly fits (~256 MB f64); past that the row says so explicitly.
+    if m * n <= 1 << 25:
+        r = _solve_timed(p, "cpu-native", tol=1e-8)
+        add(
+            dict(
+                base,
+                engine="dense(cpu-native)",
+                tol=1e-8,
+                status=r.status.value,
+                iters=int(r.iterations),
+                time_s=round(r.solve_time, 4),
+                setup_s=round(r.setup_time, 4),
+            )
+        )
+    else:
+        add(
+            dict(
+                base,
+                engine="dense(cpu-native)",
+                tol=1e-8,
+                status="skipped",
+                skip_reason=(
+                    f"dense assembly would be {m * n * 8 / 1e9:.1f} GB "
+                    "(the arena this tier exists to avoid)"
+                ),
+            )
+        )
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (smoke)")
@@ -1052,6 +1147,10 @@ def main() -> int:
     ap.add_argument("--serve", action="store_true",
                     help="serving-throughput row only (rps, p50/p99, "
                     "padding waste, warm recompiles) as the stdout JSON line")
+    ap.add_argument("--sparse", action="store_true",
+                    help="huge-sparse tier rows (sparse-iterative vs "
+                    "PDHG vs dense on one storm-profile instance; "
+                    "density/nnz/cg_iters columns) -> BENCH_SPARSE.json")
     ap.add_argument("--serve-http", action="store_true",
                     help="serving rows incl. the HTTP network plane: the "
                     "in-process row plus a localhost POST /v1/solve row, "
@@ -1110,6 +1209,17 @@ def main() -> int:
         backend = args.backend = "tpu"
 
     _obs_enable()
+
+    if args.sparse:
+        rows = _bench_sparse(args)
+        for r in rows:
+            r.setdefault("metrics", _obs_row(args.platform))
+        out = os.path.join(_REPO, "BENCH_SPARSE.json")
+        with open(out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+        _log(f"sparse rows -> {out}")
+        print(json.dumps(rows[0]))  # headline: the matrix-free IPM row
+        return 0  # sparse tier is its own run; no headline solve after
 
     if args.serve or args.serve_http:
         row = _bench_serve(args.quick)

@@ -94,6 +94,12 @@ NARROW_SANCTIONED = {
     "backends/dense.py",
     "backends/block_angular.py",
     "backends/batched.py",
+    # Huge-sparse tier: the ELL operator stores int32 column indices and
+    # may down-convert cached f64 value arrays to the configured solve
+    # dtype; the PCG preconditioners build f32 probe factors for the
+    # loose (early-μ) forcing-sequence solves.
+    "ops/sparse.py",
+    "ops/pcg.py",
 }
 
 # -- JSONL schema (rules_schema) ---------------------------------------------
@@ -191,6 +197,13 @@ JSONL_FIELDS = {
     # "warm"/"rejected"/"cold" start label, batch events the number of
     # warm-started slots (serve/service.py, serve/records.py)
     "warm",
+    # huge-sparse tier (tolerance-tiered serve ladder + inexact IPM):
+    # request/batch records carry the solve engine ("ipm"|"pdhg"),
+    # sparse-iterative iteration rows/bench rows the PCG iteration count
+    # and the resolved preconditioner (jacobi/block/bordered)
+    "engine",
+    "cg_iters",
+    "precond",
     # network serving plane (net/): http_request records (method/path/
     # code/ms), admission-verdict reject records (tenant/priority/
     # reason/retry_after_s), router route records (backend/padding/

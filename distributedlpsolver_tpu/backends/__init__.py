@@ -15,4 +15,5 @@ import distributedlpsolver_tpu.backends.cpu_native  # noqa: F401  (registers cpu
 import distributedlpsolver_tpu.backends.block_angular  # noqa: F401  (registers block/schur)
 import distributedlpsolver_tpu.backends.cpu_sparse  # noqa: F401  (registers cpu-sparse)
 import distributedlpsolver_tpu.backends.first_order  # noqa: F401  (registers pdlp/first-order)
+import distributedlpsolver_tpu.backends.sparse_iterative  # noqa: F401  (registers sparse-iterative/inexact-ipm)
 import distributedlpsolver_tpu.backends.auto  # noqa: F401  (registers auto)

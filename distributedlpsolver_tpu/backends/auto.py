@@ -30,16 +30,26 @@ from distributedlpsolver_tpu.models.problem import InteriorForm
 # dispatch (measured: 27×51 → ~10 ms CPU vs ~0.5 s tunneled-TPU).
 _SMALL_ENTRIES = 200_000
 
+# At/above this many rows a sparse problem routes to the matrix-free
+# inexact-IPM backend: the dense normal-equations tiers hit the
+# storm-class wall (ROUND5_NOTES lever 4 kernel faults, the 10 GB
+# assembly arena) and cpu-sparse's sparse-direct factorization fill-in
+# grows superlinearly past this scale.
+_HUGE_SPARSE_ROWS = 20_000
+
 # Supervisor degradation order (supervisor/supervisor.py): each step trades
 # throughput for independence from whatever the faulting layer was —
-# multi-device sharding → single-device dense → CPU sparse-direct → plain
-# CPU numpy, which shares no device runtime at all. Note that a mesh
-# backend gets one rung ABOVE this chain: on device loss (or hangs the
-# health probe pins to a shard) the supervisor first tries to SHRINK the
-# mesh over the surviving devices (backend.reshard on
+# multi-device sharding → single-device dense → matrix-free inexact IPM
+# (sparse-iterative: PCG normal equations, no ADAᵀ — it sidesteps both
+# the dense assembly arena and the large-f64-program kernel-fault class
+# ROUND5_NOTES lever 4 pins on the dense path) → CPU sparse-direct →
+# plain CPU numpy, which shares no device runtime at all. Note that a
+# mesh backend gets one rung ABOVE this chain: on device loss (or hangs
+# the health probe pins to a shard) the supervisor first tries to SHRINK
+# the mesh over the surviving devices (backend.reshard on
 # parallel.mesh.reform_mesh) — dropping one participant of a healthy pod
 # beats abandoning the pod for a single device or the CPU.
-DEGRADATION_CHAIN = ("sharded", "tpu", "cpu-sparse", "cpu")
+DEGRADATION_CHAIN = ("sharded", "tpu", "sparse-iterative", "cpu-sparse", "cpu")
 
 
 def degradation_chain(name: str) -> list:
@@ -69,6 +79,24 @@ def choose_backend_name(
     detection is RETURNED as the hint rather than attached to ``inf`` —
     this function is pure so callers can use it to inspect routing without
     mutating the problem object (AutoBackend.setup attaches the hint)."""
+    import scipy.sparse as sp
+
+    # Huge-sparse tier (platform-independent — no other rung can even
+    # assemble these): a bordered (two-stage / dual block-angular) hint
+    # routes to the matrix-free inexact IPM, whose Woodbury
+    # preconditioner that pattern was built for, and any storm-class
+    # sparse problem past the dense tier's row wall goes there too —
+    # densifying A (or ADAᵀ) at that scale is the 10 GB arena /
+    # kernel-fault class this tier exists to end.
+    hint0 = inf.block_structure or {}
+    if hint0.get("kind") == "bordered":
+        return "sparse-iterative", None
+    if (
+        sp.issparse(inf.A)
+        and inf.m >= _HUGE_SPARSE_ROWS
+        and inf.A.nnz / max(inf.m * inf.n, 1) < 0.1
+    ):
+        return "sparse-iterative", None
     if platform == "cpu":
         return "cpu-native", None
     # Any accelerator (tpu/gpu/...): tiny problems still go to the CPU —
@@ -88,8 +116,6 @@ def choose_backend_name(
     # (pds/stormG2-class) routes to the TPU Schur backend; truly
     # unstructured sparsity goes to the sparse-direct CPU backend
     # (SURVEY.md §7).
-    import scipy.sparse as sp
-
     if sp.issparse(inf.A):
         density = inf.A.nnz / max(m * n, 1)
         if density < 0.1:

@@ -1088,12 +1088,20 @@ def bucket_cache_size() -> int:
     route plus the segmented start/segment/norms route. The cache keys
     include the input shardings, so the invariant holds per
     (bucket, mesh) pair: the same bucket dispatched over a different
-    mesh compiles once more, then stays warm there too."""
+    mesh compiles once more, then stays warm there too. The PDHG
+    bucket engine's programs (backends/first_order) count too — the
+    serve layer's zero-warm-recompile invariant covers every engine of
+    the tolerance-tiered ladder."""
+    from distributedlpsolver_tpu.backends.first_order import (
+        pdhg_bucket_cache_size,
+    )
+
     return (
         _solve_bucket_jit._cache_size()
         + _bucket_start_jit._cache_size()
         + _bucket_segment_jit._cache_size()
         + _bucket_norms_jit._cache_size()
+        + pdhg_bucket_cache_size()
     )
 
 

@@ -67,9 +67,17 @@ class PDHGState(NamedTuple):
     it_cycle: jnp.ndarray
 
 
-def _estimate_norm(matvec, rmatvec, n, dtype, iters: int = 30, seed: int = 0):
-    """Power iteration for ‖A‖₂ (σ_max) — sets the PDHG step size."""
-    v = jax.random.normal(jax.random.PRNGKey(seed), (n,), dtype=dtype)
+def _estimate_norm(matvec, rmatvec, n, dtype, iters: int = 30, seed=0):
+    """Power iteration for ‖A‖₂ (σ_max) — sets the PDHG step size.
+
+    ``seed`` may be a Python int or a traced int32 scalar — the batched
+    bucket program threads each lane's slot index through here, so lane
+    k of every dispatch runs the identical power iteration (deterministic
+    per slot; the old fixed seed=0 made every lane share one start
+    vector, which tied lane results to the batch layout)."""
+    v = jax.random.normal(
+        jax.random.PRNGKey(jnp.asarray(seed, jnp.uint32)), (n,), dtype=dtype
+    )
     v = v / jnp.linalg.norm(v)
 
     def body(_, v):
@@ -227,9 +235,18 @@ class FirstOrderBackend(SolverBackend):
     number of PDHG sweeps per call and reporting KKT stats.
     """
 
-    def __init__(self, mesh: Optional[jax.sharding.Mesh] = None):
+    def __init__(
+        self,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        seed: Optional[int] = None,
+    ):
         self._sparse = False
         self._mesh = mesh
+        # Norm-estimate seed: explicit wins; else derived from the
+        # problem name at setup — deterministic per request, so two
+        # solves of the same instance share step sizes bit-for-bit
+        # while distinct requests stop sharing one fixed seed=0.
+        self._seed = seed
 
     def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
         self._cfg = config
@@ -336,8 +353,15 @@ class FirstOrderBackend(SolverBackend):
         A_, AT_ = self._A, self._AT
         self._matvec = lambda v: A_ @ v
         self._rmatvec = lambda v: AT_ @ v
+        if self._seed is not None:
+            seed = int(self._seed)
+        else:
+            import zlib
+
+            seed = zlib.crc32(inf.name.encode()) & 0x7FFFFFFF
         nrm = _estimate_norm(
-            self._matvec, self._rmatvec, inf.n + self._n_pad, dtype
+            self._matvec, self._rmatvec, inf.n + self._n_pad, dtype,
+            seed=seed,
         )
         self._eta = float(0.9 / max(float(nrm), 1e-12))
         self._it_done = 0
@@ -514,3 +538,285 @@ class FirstOrderBackend(SolverBackend):
 
     def block_until_ready(self, obj) -> None:
         jax.block_until_ready(obj)
+
+
+# -- bucketed batched PDHG: the serve ladder's first-order engine -----------
+#
+# One compiled program per (B, m, n, dtype) bucket shape — tol and
+# max_iter are traced operands, so the tolerance tiers share the
+# executable and a warm bucket NEVER recompiles (the same invariant as
+# backends/batched._solve_bucket_jit). Each lane runs the restarted-PDHG
+# loop of this module (averaging + adaptive restarts + primal-weight
+# updates), vectorized over the batch with per-lane convergence masks;
+# per-lane step sizes come from a slot-seeded power iteration
+# (deterministic per slot — the norm-estimate seed satellite). Verdicts
+# are crossover-honest: a lane is OPTIMAL only when its true KKT error
+# (pinf, dinf, relative gap) passes the REQUEST tolerance.
+
+
+class _PDHGLanes(NamedTuple):
+    x: jnp.ndarray  # (B, n)
+    y: jnp.ndarray  # (B, m)
+    x_sum: jnp.ndarray
+    y_sum: jnp.ndarray
+    n_avg: jnp.ndarray  # (B,)
+    x_restart: jnp.ndarray
+    y_restart: jnp.ndarray
+    err_restart: jnp.ndarray  # (B,)
+    omega: jnp.ndarray  # (B,)
+    it_cycle: jnp.ndarray  # (B,) int32
+
+
+def _lanes_kkt(A, b, c, x, y):
+    """Per-lane (pinf, dinf, gap, pobj, dobj) for bucket standard form
+    (x ≥ 0, no upper bounds)."""
+    r_p = b - jnp.einsum("bmn,bn->bm", A, x)
+    r = c - jnp.einsum("bmn,bm->bn", A, y)
+    pinf = jnp.linalg.norm(r_p, axis=1) / (
+        1.0 + jnp.linalg.norm(b, axis=1)
+    )
+    dinf = jnp.linalg.norm(jnp.minimum(r, 0.0), axis=1) / (
+        1.0 + jnp.linalg.norm(c, axis=1)
+    )
+    pobj = jnp.sum(c * x, axis=1)
+    dobj = jnp.sum(b * y, axis=1)
+    gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+    return pinf, dinf, gap, pobj, dobj
+
+
+def _lanes_err(A, b, c, x, y):
+    pinf, dinf, gap, _, _ = _lanes_kkt(A, b, c, x, y)
+    return jnp.maximum(pinf, jnp.maximum(dinf, gap))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("check_every", "restart_len", "restart_beta")
+)
+def _pdhg_bucket_jit(
+    A, b, c, active, tol, max_iter,
+    check_every=40, restart_len=2000, restart_beta=0.5,
+):
+    """Fused batched restarted-PDHG over one padded bucket.
+
+    Carry: per-lane PDHG state + iteration counts + a live mask. Every
+    trip runs ``check_every`` fused primal-dual steps for ALL lanes
+    (finished lanes' updates are masked out), then re-measures each
+    lane's KKT error and applies the restart/averaging bookkeeping
+    per lane. The loop exits when no live lane remains.
+    """
+    B, m, n = A.shape
+    dtype = A.dtype
+
+    # Per-lane ‖A_k‖₂ from a slot-seeded power iteration (slot index IS
+    # the seed — deterministic per slot across dispatches).
+    def one_norm(Ak, slot):
+        return _estimate_norm(
+            lambda v: Ak @ v, lambda v: Ak.T @ v, n, dtype, seed=slot
+        )
+
+    nrm = jax.vmap(one_norm)(A, jnp.arange(B, dtype=jnp.int32))
+    eta = 0.9 / jnp.maximum(nrm, 1e-12)
+
+    def one_pdhg(x, y, omega, Ak, bk, ck, eta_k):
+        tau = eta_k / omega
+        sigma = eta_k * omega
+        x_new = jnp.maximum(x - tau * (ck - Ak.T @ y), 0.0)
+        y_new = y + sigma * (bk - Ak @ (2.0 * x_new - x))
+        return x_new, y_new
+
+    zB = jnp.zeros((B,), dtype=dtype)
+    err0 = _lanes_err(A, b, c, jnp.zeros_like(c), jnp.zeros_like(b))
+    st0 = _PDHGLanes(
+        x=jnp.zeros_like(c), y=jnp.zeros_like(b),
+        x_sum=jnp.zeros_like(c), y_sum=jnp.zeros_like(b),
+        n_avg=zB,
+        x_restart=jnp.zeros_like(c), y_restart=jnp.zeros_like(b),
+        err_restart=err0,
+        omega=jnp.ones((B,), dtype=dtype),
+        it_cycle=jnp.zeros((B,), jnp.int32),
+    )
+    live0 = active & (err0 > tol)
+
+    def cond(carry):
+        st, it, err, live = carry
+        return jnp.any(live)
+
+    def body(carry):
+        st, it, err, live = carry
+
+        def inner(_, xy):
+            x, y = xy
+            xn, yn = jax.vmap(one_pdhg)(x, y, st.omega, A, b, c, eta)
+            x = jnp.where(live[:, None], xn, x)
+            y = jnp.where(live[:, None], yn, y)
+            return x, y
+
+        x, y = jax.lax.fori_loop(0, check_every, inner, (st.x, st.y))
+        ce = jnp.asarray(check_every, dtype)
+        x_sum = st.x_sum + x * ce
+        y_sum = st.y_sum + y * ce
+        n_avg = st.n_avg + ce
+        x_avg = x_sum / n_avg[:, None]
+        y_avg = y_sum / n_avg[:, None]
+
+        err_cur = _lanes_err(A, b, c, x, y)
+        err_avg = _lanes_err(A, b, c, x_avg, y_avg)
+        it_cycle = st.it_cycle + check_every
+
+        use_avg = err_avg < err_cur
+        x_cand = jnp.where(use_avg[:, None], x_avg, x)
+        y_cand = jnp.where(use_avg[:, None], y_avg, y)
+        err_cand = jnp.minimum(err_avg, err_cur)
+        do_restart = (err_cand <= restart_beta * st.err_restart) | (
+            it_cycle >= restart_len
+        )
+
+        dx = jnp.linalg.norm(x_cand - st.x_restart, axis=1)
+        dy = jnp.linalg.norm(y_cand - st.y_restart, axis=1)
+        omega_new = jnp.where(
+            (dx > 1e-30) & (dy > 1e-30),
+            jnp.exp(0.5 * jnp.log(st.omega) + 0.5 * jnp.log(dy / dx)),
+            st.omega,
+        )
+
+        rs = do_restart & live
+        rcol = rs[:, None]
+        st_new = _PDHGLanes(
+            x=jnp.where(rcol, x_cand, x),
+            y=jnp.where(rcol, y_cand, y),
+            x_sum=jnp.where(rcol, jnp.zeros_like(x), x_sum),
+            y_sum=jnp.where(rcol, jnp.zeros_like(y), y_sum),
+            n_avg=jnp.where(rs, zB, n_avg),
+            x_restart=jnp.where(rcol, x_cand, st.x_restart),
+            y_restart=jnp.where(rcol, y_cand, st.y_restart),
+            err_restart=jnp.where(rs, err_cand, st.err_restart),
+            omega=jnp.where(rs, omega_new, st.omega),
+            it_cycle=jnp.where(rs, jnp.zeros_like(it_cycle), it_cycle),
+        )
+        # Frozen lanes keep their previous state verbatim.
+        st_new = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(
+                live.reshape((B,) + (1,) * (new.ndim - 1)), new, old
+            ),
+            st_new, st,
+        )
+        err_new = jnp.where(live, jnp.minimum(err_cand, err_cur), err)
+        it = jnp.where(live, it + check_every, it)
+        live = live & (err_new > tol) & (it < max_iter) & jnp.isfinite(err_new)
+        return st_new, it, err_new, live
+
+    st, it, err, live = jax.lax.while_loop(
+        cond, body, (st0, jnp.zeros((B,), jnp.int32), err0, live0)
+    )
+    # Report the better of (last, cycle average) per lane.
+    has_avg = st.n_avg > 0
+    x_avg = jnp.where(
+        has_avg[:, None], st.x_sum / jnp.maximum(st.n_avg, 1.0)[:, None], st.x
+    )
+    y_avg = jnp.where(
+        has_avg[:, None], st.y_sum / jnp.maximum(st.n_avg, 1.0)[:, None], st.y
+    )
+    err_avg = _lanes_err(A, b, c, x_avg, y_avg)
+    err_cur = _lanes_err(A, b, c, st.x, st.y)
+    use_avg = err_avg < err_cur
+    x_fin = jnp.where(use_avg[:, None], x_avg, st.x)
+    y_fin = jnp.where(use_avg[:, None], y_avg, st.y)
+    pinf, dinf, gap, pobj, dobj = _lanes_kkt(A, b, c, x_fin, y_fin)
+    return x_fin, y_fin, it, pinf, dinf, gap, pobj
+
+
+def pdhg_bucket_cache_size() -> int:
+    """Compiled bucket-PDHG program count — the serve layer's
+    zero-warm-recompile accounting (summed into
+    backends.batched.bucket_cache_size)."""
+    return int(_pdhg_bucket_jit._cache_size())
+
+
+def solve_pdhg_bucket(
+    batch,
+    active,
+    config: Optional[SolverConfig] = None,
+    mesh=None,
+    batch_axis: str = "batch",
+    max_iter: Optional[int] = None,
+    **config_overrides,
+):
+    """Solve one pre-padded serving bucket with batched restarted PDHG —
+    the first-order engine of the tolerance-tiered serve ladder
+    (requests at tol ≥ ServiceConfig.pdhg_tol route here; see
+    serve/service.py).
+
+    Mirrors :func:`backends.batched.solve_bucket`'s contract: ``batch``
+    is (B, m, n)/(B, m)/(B, n) arrays already padded to the bucket
+    shape, ``active`` the live-slot mask; returns a ``BatchedResult``.
+    ``config.max_iter`` is interpreted as bursts of 400 inner PDHG
+    steps (the same scaling as the solo backend's ``solve_full``).
+    Verdicts are crossover-honest: OPTIMAL only where the final true
+    KKT error meets the request tolerance — anything else is
+    ITERATION_LIMIT and the service's solo ladder (IPM polish at the
+    same tolerance) owns it. ``y``/``s``/``w``/``z`` are deliberately
+    left None: a tol-loose PDHG iterate must not seed the warm cache
+    the IPM engine draws from.
+    """
+    import time as _time
+
+    from distributedlpsolver_tpu.backends.batched import (
+        BatchedResult,
+        place_bucket,
+    )
+    from distributedlpsolver_tpu.ipm.state import Status
+
+    cfg = config or SolverConfig()
+    if config_overrides:
+        cfg = cfg.replace(**config_overrides)
+    dtype = jnp.dtype(cfg.dtype)
+
+    t0 = _time.perf_counter()
+    if isinstance(batch.A, jax.Array) and batch.A.dtype == dtype:
+        A, b, c = batch.A, batch.b, batch.c
+        if not isinstance(active, jax.Array):
+            active = jnp.asarray(np.asarray(active, dtype=bool))
+    else:
+        placed, active = place_bucket(
+            batch, active, cfg, mesh=mesh, batch_axis=batch_axis
+        )
+        A, b, c = placed.A, placed.b, placed.c
+    setup_time = _time.perf_counter() - t0
+
+    inner_cap = int(max_iter if max_iter is not None else cfg.max_iter) * 400
+    t1 = _time.perf_counter()
+    x, y, it, pinf, dinf, gap, pobj = _pdhg_bucket_jit(
+        A, b, c, active,
+        jnp.asarray(cfg.tol, dtype),
+        jnp.asarray(inner_cap, jnp.int32),
+    )
+    jax.block_until_ready(x)
+    solve_time = _time.perf_counter() - t1
+
+    pinf = np.asarray(pinf, dtype=np.float64)
+    dinf = np.asarray(dinf, dtype=np.float64)
+    gap = np.asarray(gap, dtype=np.float64)
+    ok = (gap <= cfg.tol) & (pinf <= cfg.tol) & (dinf <= cfg.tol)
+    # Inactive (padding) slots report the same placeholder OPTIMAL as
+    # solve_bucket — demux by slot and ignore them.
+    ok = ok | ~np.asarray(active, dtype=bool)
+    status = np.array(
+        [Status.OPTIMAL if o else Status.ITERATION_LIMIT for o in ok],
+        dtype=object,
+    )
+    return BatchedResult(
+        status=status,
+        objective=np.asarray(pobj, dtype=np.float64),
+        x=np.asarray(x, dtype=np.float64),
+        iterations=np.asarray(it),
+        rel_gap=gap,
+        pinf=pinf,
+        dinf=dinf,
+        solve_time=solve_time,
+        setup_time=setup_time,
+        phase_report=[
+            {"phase": 0, "engine": "pdhg", "tol": float(cfg.tol),
+             "iters": int(np.asarray(it).max(initial=0))}
+        ],
+        fused_iters=40,  # check_every inner steps per while trip
+    )

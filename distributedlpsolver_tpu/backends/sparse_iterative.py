@@ -1,0 +1,356 @@
+"""Matrix-free inexact-IPM backend: PCG normal equations, no ADAᵀ ever.
+
+The huge-sparse rung of the backend ladder (ROADMAP "huge-sparse
+scenario tier"). Every other normal-equations path materializes
+``M = A·diag(d)·Aᵀ`` — dense (the 10.07 GB flagship arena), sparse-CSR
+(cpu-sparse), or per-block dense (block) — and the storm-class
+≥100k-row wall says that ends. Here the per-iteration Newton solves run
+preconditioned CG against the matrix-free operator
+``v ↦ A·(d ∘ Aᵀv) + reg·v`` over the padded-ELL
+:class:`~distributedlpsolver_tpu.ops.sparse.SparseOperator`; the only
+m-sized objects are vectors and the preconditioner's fixed small blocks
+(asserted by :meth:`SparseIterativeBackend.memory_report` — the
+acceptance guard that ADAᵀ was never formed in any format).
+
+Preconditioners (ops/pcg.py), resolved at setup:
+
+* ``jacobi`` (default) — diag of the normal matrix, O(nnz)/step;
+* ``block`` — exact bs×bs diagonal blocks, vmapped Cholesky;
+* ``bordered`` — block-Jacobi over scenario row blocks + Woodbury
+  first-stage capacitance, selected automatically when the problem
+  carries a ``kind: "bordered"`` block-structure hint (storm-class
+  two-stage programs). On an exactly bordered pattern this inverts the
+  regularized normal matrix, so CG stays at a handful of iterations at
+  every μ — the property that carries the IPM to 1e-8 where plain
+  Jacobi stalls (measured: diag-Jacobi CG counts grow ~μ^-1/2 and hit
+  any cap below μ ≈ 1e-4).
+
+Inexactness: the CG tolerance rides a forcing sequence keyed to the
+iterate's KKT error (loose solves early, tight near convergence —
+Bellavia-style inexact IPM, PAPERS.md arXiv 1708.04298), and KKT-level
+refinement (core._solve_kkt) absorbs the residual inexactness exactly
+as it absorbs regularization filtering on the dense path.
+
+Honest capability envelope: the 1e-8 guarantee holds where a
+preconditioner captures the endgame spectrum — bordered/storm patterns
+(Woodbury) and diagonally-dominant programs (Jacobi). On UNSTRUCTURED
+ill-conditioned patterns the endgame normal matrix's spectrum reaches
+the regularization floor and f64 CG breaks down where a backward-stable
+direct factorization survives; that failure is STRUCTURED (a bad-step
+fault, never a wrong verdict), and the supervisor degrades along
+DEGRADATION_CHAIN to cpu-sparse — which is also where auto routing
+sends moderate unstructured problems in the first place.
+
+Warm-cache seam (the PR 8 follow-on): ``offer_precond`` accepts a prior
+same-structure solve's final scaling vector and freezes its
+preconditioner factors for the early iterations (CG corrects the
+staleness; the per-step factor build is skipped until μ drops toward
+the endgame), and ``export_precond`` hands this solve's final scaling
+back for the cache. The whole step is one jitted program per (shape,
+precond structure, frozen on/off); chunked ≤128-wide batched PCG
+(ops/pcg.py) keeps any fan-out inside the healthy TPU program class
+(ROUND5_NOTES lever 4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from distributedlpsolver_tpu.backends.base import SolverBackend, register_backend
+from distributedlpsolver_tpu.ipm import core
+from distributedlpsolver_tpu.ipm.config import SolverConfig
+from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
+from distributedlpsolver_tpu.models.problem import InteriorForm
+from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.ops import pcg as pcg_ops
+from distributedlpsolver_tpu.ops import sparse as sparse_ops
+
+# CG cap per Newton solve: m+32 makes PCG an exact solver on probe
+# shapes (CG terminates in ≤ m steps in exact arithmetic); the absolute
+# cap keeps one solve bounded at storm scale, where the structured
+# preconditioners hold the real count to O(10).
+_CG_CAP = 2048
+
+# Forcing sequence: cg_tol = clip(_FORCE_FRAC · err, cfg.cg_tol,
+# _FORCE_MAX) — loose solves while the iterate is far (err ~ 1),
+# tightening with the KKT error so the last iterations solve nearly
+# exactly (the KKT refinement rounds clean up the rest).
+_FORCE_FRAC = 0.05
+_FORCE_MAX = 1e-2
+
+# A frozen (warm-cache-supplied) preconditioner is kept while the
+# iterate's relative KKT error stays above this; past it the factors
+# refresh every step — endgame scaling spreads change too fast for a
+# stale factor to help.
+_FROZEN_ERR_EXIT = 1e-4
+
+
+def _build_factors(op, prec, d, reg):
+    """Preconditioner factors for scaling ``d``: the inverse normal
+    diagonal for Jacobi (``prec is None``), else the block/bordered
+    factor pytree."""
+    if prec is None:
+        return 1.0 / op.normal_diag(d, reg)
+    return prec.factor(d, reg)
+
+
+def _apply_factors(prec, factors):
+    if prec is None:
+        idiag = factors
+        return lambda r: r * idiag
+    return prec.apply_with(factors)
+
+
+def _make_ops(op, prec, reg, cg_tol, cg_max, acc, frozen=None):
+    """LinOps over the matrix-free normal operator. ``acc`` collects the
+    traced CG iteration counts during tracing (summed into the step
+    program's extra output — the ``cg_iters`` telemetry). ``frozen``
+    short-circuits the per-step factor build with warm-cache factors."""
+
+    def factorize(d):
+        if frozen is not None:
+            return d, frozen
+        return d, _build_factors(op, prec, d, reg)
+
+    def solve(factors, rhs):
+        d, fac = factors
+
+        def mv(v):
+            return op.matvec(d * op.rmatvec(v)) + reg * v
+
+        x, it = pcg_ops.pcg(mv, _apply_factors(prec, fac), rhs, cg_tol, cg_max)
+        acc.append(it)
+        return x
+
+    return core.LinOps(
+        xp=jnp,
+        matvec=op.matvec,
+        rmatvec=op.rmatvec,
+        factorize=factorize,
+        solve=solve,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("params", "cg_max"))
+def _sparse_step_jit(op, prec, data, state, reg, cg_tol, params, cg_max):
+    acc = []
+    ops = _make_ops(op, prec, reg, cg_tol, cg_max, acc)
+    st, stats = core.mehrotra_step(ops, data, params, state)
+    total = sum(acc) if acc else jnp.asarray(0, jnp.int32)
+    return st, stats, total
+
+
+@functools.partial(jax.jit, static_argnames=("params", "cg_max"))
+def _sparse_step_frozen_jit(
+    op, prec, frozen, data, state, reg, cg_tol, params, cg_max
+):
+    acc = []
+    ops = _make_ops(op, prec, reg, cg_tol, cg_max, acc, frozen=frozen)
+    st, stats = core.mehrotra_step(ops, data, params, state)
+    total = sum(acc) if acc else jnp.asarray(0, jnp.int32)
+    return st, stats, total
+
+
+@functools.partial(jax.jit, static_argnames=("params", "cg_max"))
+def _sparse_start_jit(op, prec, data, reg, cg_tol, params, cg_max):
+    acc = []
+    ops = _make_ops(op, prec, reg, cg_tol, cg_max, acc)
+    st = core.starting_point(ops, data, params)
+    total = sum(acc) if acc else jnp.asarray(0, jnp.int32)
+    return st, total
+
+
+@register_backend("sparse-iterative", "inexact-ipm", "sparse-pcg")
+class SparseIterativeBackend(SolverBackend):
+    """Inexact (PCG) normal-equations execution of the shared IPM core."""
+
+    def __init__(self, precond: str = "auto"):
+        if precond not in ("auto", "jacobi", "block", "bordered"):
+            raise ValueError(
+                f"precond must be auto/jacobi/block/bordered; got {precond!r}"
+            )
+        self._precond_req = precond
+        self._prec = None
+        self._frozen = None
+        self._cfg: Optional[SolverConfig] = None
+
+    # -- setup -----------------------------------------------------------
+
+    def setup(self, inf: InteriorForm, config: SolverConfig) -> None:
+        self._cfg = config
+        dtype = jnp.dtype(config.dtype)
+        A = inf.A
+        self._op = sparse_ops.from_scipy(A, dtype=dtype)
+        hint = inf.block_structure or {}
+        kind = self._precond_req
+        if kind == "auto":
+            kind = "bordered" if hint.get("kind") == "bordered" else "jacobi"
+        if kind == "bordered":
+            A_csr = A if sp.issparse(A) else sp.csr_matrix(np.asarray(A))
+            self._prec = pcg_ops.BorderedPrecond(A_csr, hint, dtype=dtype)
+        elif kind == "block":
+            A_csr = A if sp.issparse(A) else sp.csr_matrix(np.asarray(A))
+            self._prec = pcg_ops.BlockJacobi(A_csr, dtype=dtype)
+        else:
+            self._prec = None
+        self.precond = kind
+        self._data = core.make_problem_data(
+            jnp,
+            jnp.asarray(np.asarray(inf.c), dtype=dtype),
+            jnp.asarray(np.asarray(inf.b), dtype=dtype),
+            jnp.asarray(np.asarray(inf.u), dtype=dtype),
+            dtype,
+        )
+        self._dtype = dtype
+        self._params = config.step_params()
+        self._reg = float(config.reg_dual)
+        self._cg_cap = min(self._op.m + 32, _CG_CAP)
+        self._cg_floor = float(config.cg_tol)
+        self._last_err = 1.0
+        self._frozen = None
+        self._frozen_used = 0
+        self._last_state = None
+        self._cg_iters_total = 0
+        self._cg_per_iter = []
+        reg = obs_metrics.get_registry()
+        self._m_cg = reg.counter(
+            "sparse_cg_iters_total",
+            labels={"precond": kind},
+            help="PCG iterations spent in the sparse-iterative backend",
+        )
+
+    # -- warm-cache preconditioner seam (PR 8 follow-on) -----------------
+
+    def offer_precond(self, d_prior) -> bool:
+        """Seed the preconditioner from a prior same-structure solve's
+        final scaling vector (warm cache). The factors are built ONCE
+        here and reused (frozen) until the iterate's KKT error drops to
+        the endgame, skipping the per-step factor build; CG corrects
+        the staleness. Shape-guarded: a mismatched vector is refused."""
+        d_prior = np.asarray(d_prior, dtype=np.float64).ravel()
+        if self._cfg is None or d_prior.shape != (self._op.n,):
+            return False
+        if not np.all(np.isfinite(d_prior)) or not np.all(d_prior > 0):
+            return False
+        d = jnp.asarray(d_prior, dtype=self._dtype)
+        self._frozen = _build_factors(
+            self._op, self._prec, d, jnp.asarray(self._reg, self._dtype)
+        )
+        self._frozen_used = 0
+        return True
+
+    def export_precond(self):
+        """This solve's final scaling vector — what a warm cache stores
+        for the next same-structure request (None before any step).
+        Computed lazily from the last good iterate: once per solve, not
+        once per iteration."""
+        if self._last_state is None:
+            return None
+        d = core.scaling_d(self._last_state, self._data, self._params)
+        return np.asarray(d)
+
+    # -- driver surface --------------------------------------------------
+
+    def _cg_tol(self) -> float:
+        return float(
+            min(_FORCE_MAX, max(self._cg_floor, _FORCE_FRAC * self._last_err))
+        )
+
+    def starting_point(self) -> IPMState:
+        st, cg = _sparse_start_jit(
+            self._op, self._prec, self._data,
+            jnp.asarray(self._reg, self._dtype),
+            jnp.asarray(self._cg_tol(), self._dtype),
+            self._params, self._cg_cap,
+        )
+        self._note_cg(cg)
+        return st
+
+    def iterate(self, state: IPMState) -> Tuple[IPMState, StepStats]:
+        reg = jnp.asarray(self._reg, self._dtype)
+        cg_tol = jnp.asarray(self._cg_tol(), self._dtype)
+        if self._frozen is not None and self._last_err > _FROZEN_ERR_EXIT:
+            new_state, stats, cg = _sparse_step_frozen_jit(
+                self._op, self._prec, self._frozen, self._data, state,
+                reg, cg_tol, self._params, self._cg_cap,
+            )
+            self._frozen_used += 1
+        else:
+            self._frozen = None
+            new_state, stats, cg = _sparse_step_jit(
+                self._op, self._prec, self._data, state,
+                reg, cg_tol, self._params, self._cg_cap,
+            )
+        self._note_cg(cg)
+        bad = bool(np.asarray(stats.bad))
+        if bad:
+            # A frozen (stale) preconditioner is the first suspect on a
+            # failed solve: drop it before the driver escalates reg.
+            self._frozen = None
+        else:
+            self._last_err = float(
+                max(
+                    np.asarray(stats.rel_gap),
+                    np.asarray(stats.pinf),
+                    np.asarray(stats.dinf),
+                )
+            )
+            self._last_state = new_state
+        return new_state, stats
+
+    def _note_cg(self, cg) -> None:
+        n = int(np.asarray(cg))
+        self._cg_iters_total += n
+        self._cg_per_iter.append(n)
+        self._m_cg.inc(n)
+
+    def bump_regularization(self) -> bool:
+        if self._reg * self._cfg.reg_grow > 1e-2:
+            return False
+        self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
+        return True
+
+    def block_until_ready(self, obj) -> None:
+        jax.block_until_ready(obj)
+
+    # -- telemetry & guards ----------------------------------------------
+
+    def cg_report(self) -> dict:
+        """cg_iters telemetry: total + per-IPM-iteration counts and the
+        resolved preconditioner (bench --sparse columns)."""
+        return {
+            "cg_iters": self._cg_iters_total,
+            "cg_per_iteration": list(self._cg_per_iter),
+            "precond": self.precond,
+            "cg_cap": self._cg_cap,
+            # IPM iterations that ran on warm-cache-frozen preconditioner
+            # factors (the PR 8 follow-on seam) this solve.
+            "warm_precond_steps": self._frozen_used,
+        }
+
+    def memory_report(self) -> dict:
+        """Every device array this backend holds, name → {shape, nbytes}
+        — the never-materialized-ADAᵀ guard: no entry may approach the
+        (m, m) normal-matrix footprint."""
+        rep = {f"operator.{k}": v for k, v in self._op.memory_report().items()}
+        if self._prec is not None:
+            rep.update(
+                {f"precond.{k}": v for k, v in self._prec.memory_report().items()}
+            )
+        for name in ("c", "b", "u_f", "hub"):
+            a = getattr(self._data, name)
+            rep[f"data.{name}"] = {
+                "shape": tuple(int(s) for s in a.shape),
+                "nbytes": int(a.size) * a.dtype.itemsize,
+            }
+        return rep
+
+    def max_operand_nbytes(self) -> int:
+        return max(v["nbytes"] for v in self.memory_report().values())

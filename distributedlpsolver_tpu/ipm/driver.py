@@ -177,6 +177,21 @@ def solve(
 
     t_setup0 = time.perf_counter()
     be.setup(inf_solve, cfg)
+    # Warm-cache-supplied preconditioner (the PR 8 follow-on): a backend
+    # with the offer/export seam (sparse-iterative) seeds its PCG
+    # preconditioner from the cached final scaling of the last OPTIMAL
+    # same-structure solve — the factors freeze for the early (loose-
+    # forcing) iterations instead of refactoring every step. Only valid
+    # when this solve reuses the SAME Ruiz scaling the cached d was
+    # exported under (the delta-solve path); offer_precond shape-guards
+    # the rest.
+    if (
+        cache_entry is not None
+        and cache_entry.precond_d is not None
+        and hasattr(be, "offer_precond")
+        and (not cfg.scale or cache_entry.scaling is not None)
+    ):
+        be.offer_precond(cache_entry.precond_d)
     fingerprint = ckpt.problem_fingerprint(inf) if cfg.checkpoint_path else ""
     resumed = (
         ckpt.maybe_load(cfg.checkpoint_path, fingerprint)
@@ -212,6 +227,7 @@ def solve(
         def on_host_state(final_status, host_state):
             if final_status is not Status.OPTIMAL:
                 return
+            export = getattr(be, "export_precond", None)
             warm_cache.store(
                 cache_fp,
                 m=inf.m,
@@ -220,6 +236,7 @@ def solve(
                 scaling=scaling,
                 scaled_A=inf_solve.A if scaling is not None else None,
                 structure=inf.block_structure,
+                precond_d=export() if export is not None else None,
                 tol=cfg.tol,
             )
 

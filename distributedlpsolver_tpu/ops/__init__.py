@@ -9,12 +9,22 @@ from distributedlpsolver_tpu.ops.normal_eq import (
     pad_for_pallas,
     supports_pallas,
 )
+from distributedlpsolver_tpu.ops.sparse import (
+    SparseOperator,
+    from_problem,
+    from_scipy,
+    ruiz_equilibrate,
+)
 
 __all__ = [
+    "SparseOperator",
     "chol_tri_inv_mesh",
+    "from_problem",
+    "from_scipy",
     "normal_eq",
     "normal_eq_pallas",
     "normal_eq_reference",
     "pad_for_pallas",
+    "ruiz_equilibrate",
     "supports_pallas",
 ]

@@ -1,0 +1,464 @@
+"""Padded fixed-shape sparse operator for the matrix-free solve tier.
+
+Everything above this module still rides dense normal equations; the
+storm-class ≥100k-row wall (ROUND5_NOTES lever 4) and the 10 GB dense
+assembly arena at the 10k flagship say that path is ending. This layer is
+the huge-sparse tier's answer: a hybrid row-ELL representation of the
+constraint matrix — ``vals``/``cols`` padded to one static
+nonzeros-per-row width, plus a fixed-length COO spill ``tail`` for the
+few rows heavier than that width — whose ``matvec``/``rmatvec``/
+``normal_diag`` are pure gathers + reductions (+ one bounded
+scatter-add for the tail), so they jit into fixed-shape XLA programs
+(no data-dependent shapes, SURVEY.md §7) and the m×m normal matrix
+``A·diag(d)·Aᵀ`` is never materialized in any format.
+
+Why hybrid and not plain ELL: a plain ELL pads EVERY row to the widest
+row's count. The storm-class bordered pattern makes that pathological —
+a first-stage column touched by every scenario turns into a transpose
+row with K·t_nnz entries, padding the other 30k columns to width ~1000
+(hundreds of MB and a 100× matvec slowdown for <0.3% of the nonzeros).
+The hybrid keeps the ELL width at a quantile of the row-count
+distribution and spills the heavy tails into a quantized-length COO
+triple processed by one ``at[].add`` — both shapes static.
+
+Why ELL and not BCOO: the serve/backends layers key compiled programs on
+array SHAPES. A BCOO's nse rides the value count of one instance; the
+ELL pad width and tail length are quantized (``_PAD_QUANTUM``/
+``_TAIL_QUANTUM``), so same-profile instances (parameterized storm
+scenarios, correlated streams) share one compiled program. The
+transpose is stored as a second hybrid ELL (``tvals``/``tcols`` +
+``ttail``) — an O(nnz) one-time host cost that turns ``rmatvec`` into
+the same gather-reduce shape as ``matvec`` instead of a full scatter.
+
+Dense fallback: below ~25% density the hybrid wins on both bytes and
+gather locality; above it (or at tiny shapes) the operator stores a
+plain dense array and the same API degenerates to GEMV — callers never
+branch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+# Quantize the ELL pad width so instances with nearly-equal row-count
+# quantiles share one (m, k) program shape (the bucket-ladder idea
+# applied to sparsity): width rounds up to the next multiple.
+_PAD_QUANTUM = 8
+
+# Quantize the COO spill-tail length the same way (pad entries point at
+# a synthetic row with value 0, so the scatter-add is a no-op for them).
+_TAIL_QUANTUM = 256
+
+# ELL width = this quantile of the per-row nonzero counts; rows heavier
+# than the (quantized) quantile spill their excess into the tail. 1.0
+# would recover plain ELL; 0.98 keeps the width at the bulk of the
+# distribution while the bordered pattern's ~n1 dense-ish transpose rows
+# ride the tail.
+_WIDTH_QUANTILE = 0.98
+
+# Above this density the ELL gathers cost more than a dense GEMV and the
+# padded arrays approach the dense footprint — store dense instead.
+DENSE_FALLBACK_DENSITY = 0.25
+
+# Below this many entries a dense operator is unconditionally cheaper
+# (gather setup dominates at tiny shapes).
+_DENSE_FALLBACK_ENTRIES = 16_384
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseOperator:
+    """Fixed-shape sparse (or dense-fallback) linear operator.
+
+    ``fmt == "ell"``: ``vals``/``cols`` are (m, k) row-ELL arrays of A
+    (pad entries carry col 0 / val 0) and ``tail_vals``/``tail_rows``/
+    ``tail_cols`` the fixed-length COO spill of rows wider than k (pad
+    entries carry row m / val 0 — they scatter into a synthetic slot
+    that is sliced off); ``tvals``/``tcols`` + ``ttail_*`` the same
+    hybrid for Aᵀ. ``fmt == "dense"``: ``dense`` holds A itself and the
+    hybrid fields are None. Registered as a jax pytree — an operator is
+    an ordinary traced operand of the jitted kernels, so two same-shape
+    instances share one compiled program.
+    """
+
+    shape: Tuple[int, int]
+    nnz: int
+    fmt: str  # "ell" | "dense"
+    vals: Optional[jnp.ndarray] = None  # (m, k)
+    cols: Optional[jnp.ndarray] = None  # (m, k) int32
+    tail_vals: Optional[jnp.ndarray] = None  # (t,)
+    tail_rows: Optional[jnp.ndarray] = None  # (t,) int32, pad → m
+    tail_cols: Optional[jnp.ndarray] = None  # (t,) int32
+    tvals: Optional[jnp.ndarray] = None  # (n, kt)
+    tcols: Optional[jnp.ndarray] = None  # (n, kt) int32
+    ttail_vals: Optional[jnp.ndarray] = None  # (tt,)
+    ttail_rows: Optional[jnp.ndarray] = None  # (tt,) int32, pad → n
+    ttail_cols: Optional[jnp.ndarray] = None  # (tt,) int32
+    dense: Optional[jnp.ndarray] = None  # (m, n) fallback
+
+    @property
+    def m(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n(self) -> int:
+        return self.shape[1]
+
+    @property
+    def density(self) -> float:
+        return self.nnz / max(self.m * self.n, 1)
+
+    @property
+    def dtype(self):
+        return self.dense.dtype if self.fmt == "dense" else self.vals.dtype
+
+    # -- linear maps (jittable: self is a pytree operand) ---------------
+
+    def matvec(self, v):
+        """A @ v, (n,) → (m,) — one gather + reduction over the ELL part
+        plus one bounded scatter-add for the spill tail."""
+        if self.fmt == "dense":
+            return self.dense @ v
+        out = jnp.sum(self.vals * v[self.cols], axis=1)
+        return _tail_add(
+            out, self.tail_vals, self.tail_rows, self.tail_cols, v
+        )
+
+    def rmatvec(self, v):
+        """Aᵀ @ v, (m,) → (n,) — same hybrid shape via the transpose
+        ELL (no full scatter on the hot path)."""
+        if self.fmt == "dense":
+            return self.dense.T @ v
+        out = jnp.sum(self.tvals * v[self.tcols], axis=1)
+        return _tail_add(
+            out, self.ttail_vals, self.ttail_rows, self.ttail_cols, v
+        )
+
+    def normal_diag(self, d, reg=0.0):
+        """diag(A·diag(d)·Aᵀ) + reg — the Jacobi preconditioner of the
+        normal equations, computed WITHOUT forming the normal matrix:
+        entry i is Σ_j A_ij²·d_j."""
+        if self.fmt == "dense":
+            return jnp.sum(self.dense * self.dense * d[None, :], axis=1) + reg
+        out = jnp.sum(self.vals * self.vals * d[self.cols], axis=1)
+        if self.tail_vals is not None:
+            out = _scatter_sq(
+                out, self.tail_vals, self.tail_rows, d[self.tail_cols]
+            )
+        return out + reg
+
+    def row_norms(self):
+        """Per-row 2-norms of A (the PDHG/scaling diagnostics surface)."""
+        if self.fmt == "dense":
+            return jnp.sqrt(jnp.sum(self.dense * self.dense, axis=1))
+        sq = jnp.sum(self.vals * self.vals, axis=1)
+        if self.tail_vals is not None:
+            sq = _scatter_sq(sq, self.tail_vals, self.tail_rows, None)
+        return jnp.sqrt(sq)
+
+    def col_norms(self):
+        if self.fmt == "dense":
+            return jnp.sqrt(jnp.sum(self.dense * self.dense, axis=0))
+        sq = jnp.sum(self.tvals * self.tvals, axis=1)
+        if self.ttail_vals is not None:
+            sq = _scatter_sq(sq, self.ttail_vals, self.ttail_rows, None)
+        return jnp.sqrt(sq)
+
+    def scaled(self, dr, dc) -> "SparseOperator":
+        """Dr·A·Dc as a new operator — sparse-aware Ruiz application:
+        only the O(nnz) value arrays are rescaled, the pattern (and the
+        compiled-program shape) is untouched."""
+        dr = jnp.asarray(dr, dtype=self.dtype)
+        dc = jnp.asarray(dc, dtype=self.dtype)
+        if self.fmt == "dense":
+            return dataclasses.replace(
+                self, dense=self.dense * dr[:, None] * dc[None, :]
+            )
+        # Pad entries index synthetic row m / col 0; append a 1 so the
+        # gather stays a no-op for them (their value is 0 anyway).
+        dr1 = jnp.concatenate([dr, jnp.ones((1,), dr.dtype)])
+        dc1 = jnp.concatenate([dc, jnp.ones((1,), dc.dtype)])
+        rep = {
+            "vals": self.vals * dr[:, None] * dc[self.cols],
+            "tvals": self.tvals * dc[:, None] * dr[self.tcols],
+        }
+        if self.tail_vals is not None:
+            rep["tail_vals"] = (
+                self.tail_vals * dr1[self.tail_rows] * dc[self.tail_cols]
+            )
+        if self.ttail_vals is not None:
+            rep["ttail_vals"] = (
+                self.ttail_vals * dc1[self.ttail_rows] * dr[self.ttail_cols]
+            )
+        return dataclasses.replace(self, **rep)
+
+    # -- host-side helpers ----------------------------------------------
+
+    def to_scipy(self) -> sp.csr_matrix:
+        """Exact CSR reconstruction (tests / oracles)."""
+        if self.fmt == "dense":
+            return sp.csr_matrix(np.asarray(self.dense, dtype=np.float64))
+        m, k = self.vals.shape
+        rows = np.repeat(np.arange(m), k)
+        vals = np.asarray(self.vals, dtype=np.float64).ravel()
+        cols = np.asarray(self.cols).ravel()
+        if self.tail_vals is not None:
+            rows = np.concatenate([rows, np.asarray(self.tail_rows)])
+            vals = np.concatenate(
+                [vals, np.asarray(self.tail_vals, dtype=np.float64)]
+            )
+            cols = np.concatenate([cols, np.asarray(self.tail_cols)])
+        live = (vals != 0.0) & (rows < m)
+        return sp.csr_matrix(
+            (vals[live], (rows[live], cols[live])), shape=self.shape
+        )
+
+    def nbytes(self) -> int:
+        return sum(int(a.size) * a.dtype.itemsize for a in self._arrays())
+
+    def memory_report(self) -> dict:
+        """name → {shape, nbytes} of every device array held — the
+        no-dense-normal-matrix guard: the acceptance test asserts no
+        array approaches (m, m) and total bytes stay far below m²·8."""
+        out = {}
+        for name in (
+            "vals", "cols", "tail_vals", "tail_rows", "tail_cols",
+            "tvals", "tcols", "ttail_vals", "ttail_rows", "ttail_cols",
+            "dense",
+        ):
+            a = getattr(self, name)
+            if a is not None:
+                out[name] = {
+                    "shape": tuple(int(s) for s in a.shape),
+                    "nbytes": int(a.size) * a.dtype.itemsize,
+                }
+        return out
+
+    def _arrays(self):
+        return [
+            a
+            for a in (
+                self.vals, self.cols,
+                self.tail_vals, self.tail_rows, self.tail_cols,
+                self.tvals, self.tcols,
+                self.ttail_vals, self.ttail_rows, self.ttail_cols,
+                self.dense,
+            )
+            if a is not None
+        ]
+
+
+def _tail_add(out, tail_vals, tail_rows, tail_cols, v):
+    """out += scatter(tail · v[tail_cols]) with the synthetic pad slot
+    (index len(out)) sliced off — a no-op when there is no tail."""
+    if tail_vals is None:
+        return out
+    pad = jnp.zeros((1,), dtype=out.dtype)
+    acc = jnp.concatenate([out, pad])
+    acc = acc.at[tail_rows].add(tail_vals * v[tail_cols])
+    return acc[:-1]
+
+
+def _scatter_sq(out, tail_vals, tail_rows, w):
+    """out += scatter(tail² · w) (w=None → 1) through the pad slot."""
+    contrib = tail_vals * tail_vals if w is None else tail_vals * tail_vals * w
+    pad = jnp.zeros((1,), dtype=out.dtype)
+    acc = jnp.concatenate([out, pad])
+    acc = acc.at[tail_rows].add(contrib)
+    return acc[:-1]
+
+
+def _quantize(k: int, q: int) -> int:
+    return max(q, -(-k // q) * q)
+
+
+def _hybrid_from_csr(A: sp.csr_matrix, dtype):
+    """(vals, cols, tail_vals, tail_rows, tail_cols) hybrid row-ELL of a
+    CSR matrix. ELL width is the quantized ``_WIDTH_QUANTILE`` of the
+    per-row counts; heavier rows spill their excess into the COO tail
+    (quantized length; pad entries point at synthetic row m with value
+    0). ELL pad entries point at column 0 with value 0 — the matvec
+    gather stays in bounds and the padded products vanish."""
+    m = A.shape[0]
+    counts = np.diff(A.indptr)
+    kmax = int(counts.max(initial=0))
+    kq = int(np.quantile(counts, _WIDTH_QUANTILE)) if m else 0
+    k = _quantize(max(kq, 1), _PAD_QUANTUM)
+    if k >= kmax:
+        k = _quantize(max(kmax, 1), _PAD_QUANTUM)
+
+    # Position of each nonzero within its row, vectorized.
+    offs = np.arange(A.nnz, dtype=np.int64) - np.repeat(
+        A.indptr[:-1].astype(np.int64), counts
+    )
+    rowidx = np.repeat(np.arange(m, dtype=np.int64), counts)
+    main = offs < k
+
+    vals = np.zeros((m, k), dtype=dtype)
+    cols = np.zeros((m, k), dtype=np.int32)
+    vals[rowidx[main], offs[main]] = A.data[main]
+    cols[rowidx[main], offs[main]] = A.indices[main]
+
+    spill = ~main
+    t_live = int(spill.sum())
+    if t_live == 0:
+        return vals, cols, None, None, None
+    t = _quantize(t_live, _TAIL_QUANTUM)
+    tail_vals = np.zeros((t,), dtype=dtype)
+    tail_rows = np.full((t,), m, dtype=np.int32)  # pad → synthetic row m
+    tail_cols = np.zeros((t,), dtype=np.int32)
+    tail_vals[:t_live] = A.data[spill]
+    tail_rows[:t_live] = rowidx[spill]
+    tail_cols[:t_live] = A.indices[spill]
+    return vals, cols, tail_vals, tail_rows, tail_cols
+
+
+def from_scipy(
+    A,
+    dtype=np.float64,
+    density_threshold: float = DENSE_FALLBACK_DENSITY,
+) -> SparseOperator:
+    """Build a :class:`SparseOperator` from scipy-sparse or dense input
+    WITHOUT densifying sparse inputs (the whole point of the tier);
+    dense-ish or tiny inputs take the dense fallback."""
+    if sp.issparse(A):
+        A = A.tocsr()
+        m, n = A.shape
+        nnz = int(A.nnz)
+        dens = nnz / max(m * n, 1)
+        if dens <= density_threshold and m * n > _DENSE_FALLBACK_ENTRIES:
+            vals, cols, tv_, tr_, tc_ = _hybrid_from_csr(A, dtype)
+            tvals, tcols, ttv, ttr, ttc = _hybrid_from_csr(
+                A.T.tocsr(), dtype
+            )
+            j = jnp.asarray
+            return SparseOperator(
+                shape=(m, n),
+                nnz=nnz,
+                fmt="ell",
+                vals=j(vals),
+                cols=j(cols),
+                tail_vals=None if tv_ is None else j(tv_),
+                tail_rows=None if tr_ is None else j(tr_),
+                tail_cols=None if tc_ is None else j(tc_),
+                tvals=j(tvals),
+                tcols=j(tcols),
+                ttail_vals=None if ttv is None else j(ttv),
+                ttail_rows=None if ttr is None else j(ttr),
+                ttail_cols=None if ttc is None else j(ttc),
+            )
+        Ad = np.asarray(A.todense(), dtype=dtype)
+    else:
+        Ad = np.asarray(A, dtype=dtype)
+        nnz = int(np.count_nonzero(Ad))
+    m, n = Ad.shape
+    return SparseOperator(
+        shape=(m, n), nnz=nnz, fmt="dense", dense=jnp.asarray(Ad)
+    )
+
+
+def from_problem(inf, dtype=np.float64, **kw) -> SparseOperator:
+    """Operator over an LPProblem/InteriorForm's constraint matrix."""
+    return from_scipy(inf.A, dtype=dtype, **kw)
+
+
+def ruiz_equilibrate(
+    op: SparseOperator, iterations: int = 10, tol: float = 1e-2
+):
+    """Sparse-aware Ruiz scaling on the operator itself: ∞-norm row/col
+    equilibration computed from the hybrid value arrays (O(nnz) per
+    sweep, no CSR round trips), returning ``(scaled_op, dr, dc)`` with
+    the same convention as models/scaling.equilibrate (A' = Dr·A·Dc)."""
+    if op.fmt == "dense":
+        absA = np.abs(np.asarray(op.dense, dtype=np.float64))
+        m, n = absA.shape
+        dr = np.ones(m)
+        dc = np.ones(n)
+        for _ in range(iterations):
+            row = absA.max(axis=1, initial=0.0)
+            col = absA.max(axis=0, initial=0.0)
+            if (np.abs(row[row > 0] - 1.0) < tol).all() and (
+                np.abs(col[col > 0] - 1.0) < tol
+            ).all():
+                break
+            r = np.where(row > 0, 1.0 / np.sqrt(row), 1.0)
+            c = np.where(col > 0, 1.0 / np.sqrt(col), 1.0)
+            absA *= r[:, None]
+            absA *= c
+            dr *= r
+            dc *= c
+        return op.scaled(dr, dc), dr, dc
+    vals = np.abs(np.asarray(op.vals, dtype=np.float64))
+    tvals = np.abs(np.asarray(op.tvals, dtype=np.float64))
+    cols = np.asarray(op.cols)
+    tcols = np.asarray(op.tcols)
+    has_tail = op.tail_vals is not None
+    has_ttail = op.ttail_vals is not None
+    if has_tail:
+        a_tv = np.abs(np.asarray(op.tail_vals, dtype=np.float64))
+        a_tr = np.asarray(op.tail_rows)
+        a_tc = np.asarray(op.tail_cols)
+    if has_ttail:
+        t_tv = np.abs(np.asarray(op.ttail_vals, dtype=np.float64))
+        t_tr = np.asarray(op.ttail_rows)
+        t_tc = np.asarray(op.ttail_cols)
+    dr = np.ones(op.m)
+    dc = np.ones(op.n)
+    for _ in range(iterations):
+        row = np.zeros(op.m + 1)
+        row[: op.m] = vals.max(axis=1, initial=0.0)
+        if has_tail:
+            np.maximum.at(row, a_tr, a_tv)
+        row = row[: op.m]
+        col = np.zeros(op.n + 1)
+        col[: op.n] = tvals.max(axis=1, initial=0.0)
+        if has_ttail:
+            np.maximum.at(col, t_tr, t_tv)
+        col = col[: op.n]
+        if (np.abs(row[row > 0] - 1.0) < tol).all() and (
+            np.abs(col[col > 0] - 1.0) < tol
+        ).all():
+            break
+        r = 1.0 / np.sqrt(np.where(row > 0, row, 1.0))
+        c = 1.0 / np.sqrt(np.where(col > 0, col, 1.0))
+        vals *= r[:, None]
+        vals *= c[cols]
+        tvals *= c[:, None]
+        tvals *= r[tcols]
+        if has_tail:
+            r1 = np.concatenate([r, [1.0]])
+            a_tv *= r1[a_tr] * c[a_tc]
+        if has_ttail:
+            c1 = np.concatenate([c, [1.0]])
+            t_tv *= c1[t_tr] * r[t_tc]
+        dr *= r
+        dc *= c
+    return op.scaled(dr, dc), dr, dc
+
+
+_CHILD_FIELDS = (
+    "vals", "cols", "tail_vals", "tail_rows", "tail_cols",
+    "tvals", "tcols", "ttail_vals", "ttail_rows", "ttail_cols",
+    "dense",
+)
+
+
+def _flatten(op: SparseOperator):
+    children = tuple(getattr(op, f) for f in _CHILD_FIELDS)
+    aux = (op.shape, op.nnz, op.fmt)
+    return children, aux
+
+
+def _unflatten(aux, children):
+    shape, nnz, fmt = aux
+    kw = dict(zip(_CHILD_FIELDS, children))
+    return SparseOperator(shape=shape, nnz=nnz, fmt=fmt, **kw)
+
+
+jax.tree_util.register_pytree_node(SparseOperator, _flatten, _unflatten)

@@ -64,6 +64,9 @@ class RequestResult:
     # (and the starvation probe) split on.
     tenant: str = "default"
     priority: str = "normal"
+    # Solve engine of the tolerance-tiered ladder ("ipm" | "pdhg") —
+    # which compiled program family served this request.
+    engine: str = "ipm"
 
     def record(self) -> dict:
         """The JSONL record for this request (x is elided — solutions go
@@ -94,6 +97,7 @@ class RequestResult:
             "warm": self.warm,
             "tenant": self.tenant,
             "priority": self.priority,
+            "engine": self.engine,
             "faults": [f.asdict() for f in self.faults],
         }
 

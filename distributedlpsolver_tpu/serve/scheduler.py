@@ -89,6 +89,11 @@ class PendingRequest:
     tenant: str = "default"
     priority: str = "normal"
     flush_scale: float = 1.0
+    # Solve engine of the tolerance-tiered ladder: "ipm" (bucketed
+    # batched IPM) or "pdhg" (bucketed batched first-order; requests at
+    # tol ≥ ServiceConfig.pdhg_tol). A first-class bucket dimension —
+    # engines never mix in one dispatch, each compiles its own program.
+    engine: str = "ipm"
 
     @property
     def m(self) -> int:
@@ -99,11 +104,14 @@ class PendingRequest:
         return self.A.shape[1] if self.A is not None else self.problem.n
 
 
-# Queue key: the bucket spec plus the request tolerance — tol is part of
-# the compiled program's static params, so mixing tolerances in one batch
-# would either recompile per dispatch or solve some requests to the wrong
-# tolerance. Requests at a novel tol pay one compile and then share it.
-QueueKey = Tuple[BucketSpec, float]
+# Queue key: the bucket spec plus the request tolerance plus the solve
+# ENGINE — tol is part of the compiled program's static params, so mixing
+# tolerances in one batch would either recompile per dispatch or solve
+# some requests to the wrong tolerance, and the engine (bucketed IPM vs
+# bucketed PDHG, the tolerance-tiered routing of the serve ladder) picks
+# which compiled program family the dispatch runs. Requests at a novel
+# (tol, engine) pay one compile and then share it.
+QueueKey = Tuple[BucketSpec, float, str]
 
 
 class Scheduler:
@@ -138,7 +146,7 @@ class Scheduler:
 
     def occupancy(self) -> dict:
         return {
-            f"{k[0].m}x{k[0].n}x{k[0].batch}@{k[1]:g}": len(q)
+            f"{k[0].m}x{k[0].n}x{k[0].batch}@{k[1]:g}/{k[2]}": len(q)
             for k, q in self._queues.items()
             if q
         }
@@ -157,9 +165,9 @@ class Scheduler:
                 tenant=p.tenant,
             )
         if p.A is None:  # general form: solo pseudo-bucket (batch of 1)
-            key = (BucketSpec(p.m, p.n, 1), p.tol)
+            key = (BucketSpec(p.m, p.n, 1), p.tol, "ipm")
         else:
-            key = (self.table.spec_for(p.m, p.n), p.tol)
+            key = (self.table.spec_for(p.m, p.n), p.tol, p.engine)
         self._queues.setdefault(key, deque()).append(p)
         self._depth += 1
         self._m_depth.set(self._depth)

@@ -166,6 +166,17 @@ class ServiceConfig:
     # shading, layered ABOVE max_queue_depth (which stays as the global
     # backstop). None = the classic depth-only admission.
     admission: Optional[object] = None
+    # Tolerance-tiered engine routing: standard-form requests at
+    # tol ≥ pdhg_tol dispatch to the bucketed batched PDHG engine
+    # (backends/first_order.solve_pdhg_bucket — matrix-free first-order,
+    # the accuracy regime it owns), tighter requests to the bucketed
+    # IPM. Crossover honesty: a PDHG lane is OPTIMAL only at its true
+    # KKT error ≤ the REQUEST tolerance; anything else re-solves through
+    # the solo IPM ladder at that same tolerance (first-order pre-solve,
+    # interior-point polish). pdhg_routing=False pins every request to
+    # the IPM engine.
+    pdhg_routing: bool = True
+    pdhg_tol: float = 1e-4
 
 
 def standard_form(problem: LPProblem):
@@ -301,6 +312,8 @@ class SolveService:
         # engine (f32/df32/f64), phase switches per dispatch, and the
         # fused-iterations-per-while-trip the bucket programs run with.
         self._m_phase_iters: dict = {}  # engine -> counter (created lazily)
+        # Tolerance-tiered ladder: dispatches by solve engine (ipm/pdhg).
+        self._m_engine_dispatches: dict = {}  # engine -> counter (lazy)
         self._m_phase_switches = m.counter(
             "serve_phase_switches_total",
             help="precision-phase transitions across bucket dispatches",
@@ -385,6 +398,7 @@ class SolveService:
         self._overlap_ms_total = 0.0  # guarded-by: _lock
         self._pack_ms_total = 0.0  # guarded-by: _lock
         self._phase_iters: dict = {}  # engine -> total iters; guarded-by: _lock
+        self._engine_dispatches: dict = {}  # guarded-by: _lock
         # Idle telemetry: how the dispatcher sleeps (satellite: the loop
         # waits exactly until Scheduler.next_event_in, surfaced here).
         self._idle_waits = 0  # guarded-by: _lock
@@ -533,13 +547,25 @@ class SolveService:
         now = time.perf_counter()
         if deadline is None:
             deadline = self.config.default_deadline_s
+        req_tol = tol if tol is not None else self.solver_config.tol
+        # Tolerance-tiered engine routing: loose standard-form requests
+        # ride the matrix-free PDHG engine, tight ones the IPM buckets.
+        engine = (
+            "pdhg"
+            if (
+                self.config.pdhg_routing
+                and sf is not None
+                and req_tol >= self.config.pdhg_tol
+            )
+            else "ipm"
+        )
         p = PendingRequest(
             request_id=-1,
             name=name or problem.name,
             c=sf[0] if sf else None,
             A=sf[1] if sf else None,
             b=sf[2] if sf else None,
-            tol=tol if tol is not None else self.solver_config.tol,
+            tol=req_tol,
             future=Future(),
             t_submit=now,
             deadline=None if deadline is None else now + deadline,
@@ -552,6 +578,7 @@ class SolveService:
                 if self._admission is not None
                 else 1.0
             ),
+            engine=engine,
         )
         with self._wake:
             if self._stopping:
@@ -586,6 +613,7 @@ class SolveService:
                     "id": p.request_id, "name": p.name,
                     "m": p.m, "n": p.n,
                     "bucket": list(key[0].key()), "tol": key[1],
+                    "engine": key[2],
                 },
             )
             self.tracer.async_begin("queue", p.request_id)
@@ -660,6 +688,26 @@ class SolveService:
             if job is None:
                 self._solve_q.put(None)
                 return
+            if job.live:
+                # Second expiry gate: pop splits expired requests against
+                # the scheduler's timestamp, captured before the queue
+                # lock — a sub-millisecond deadline submitted while the
+                # dispatcher is waking can race it and pop as live. Slot
+                # assignment happens HERE, so this is the last honest
+                # moment to split TIMEOUT verdicts out before device
+                # work is committed on their behalf.
+                t_gate = time.perf_counter()
+                still, late = [], []
+                for p in job.live:
+                    dst = (
+                        late
+                        if p.deadline is not None and p.deadline <= t_gate
+                        else still
+                    )
+                    dst.append(p)
+                if late:
+                    job.live = still
+                    job.expired.extend(late)
             if job.live and job.live[0].A is not None:
                 spec = job.key[0]
                 for p in job.live:
@@ -702,7 +750,7 @@ class SolveService:
         from distributedlpsolver_tpu.ipm.state import IPMState
         from distributedlpsolver_tpu.models.generators import BatchedLP
 
-        spec, tol = key
+        spec, tol, engine = key
         B = spec.batch
         t0 = time.perf_counter()
         A = np.zeros((B, spec.m, spec.n))
@@ -715,7 +763,15 @@ class SolveService:
         for k in range(len(live), B):  # inactive slots: well-posed copies
             A[k], b[k], c[k] = A[0], b[0], c[0]
         batch = BatchedLP(c=c, A=A, b=b, name=f"bucket_{spec.m}x{spec.n}")
-        warm_states, warm_mask, warm_hits = self._build_warm_lanes(spec, live)
+        if engine == "pdhg":
+            # The first-order engine neither consumes nor produces warm
+            # iterates (a tol-loose PDHG point must not seed the IPM
+            # warm cache); its lanes stay cold by design.
+            warm_states, warm_mask, warm_hits = None, None, None
+        else:
+            warm_states, warm_mask, warm_hits = self._build_warm_lanes(
+                spec, live
+            )
         # Snapshot: a reshard mid-pipeline only affects later packs; this
         # bucket solves on the mesh it was placed on.
         with self._lock:
@@ -888,6 +944,7 @@ class SolveService:
                     t_done=now,
                     m=p.m,
                     n=p.n,
+                    engine=p.engine,
                 ),
             )
         if not live:
@@ -909,20 +966,25 @@ class SolveService:
             bucket_cache_size,
             solve_bucket,
         )
+        from distributedlpsolver_tpu.backends.first_order import (
+            solve_pdhg_bucket,
+        )
 
-        spec, tol = key
+        spec, tol, engine = key
         if packed is None:
             # Direct-call fallback (tests, pipeline disabled): pack inline.
             packed = self._pack_bucket(key, live)
         batch, active, mesh = packed.batch, packed.active, packed.mesh
         cfg = self.solver_config.replace(tol=tol)
         waste = packed.waste
-        self._late_warm_lookup(spec, tol, live, packed, mesh)
+        if engine != "pdhg":
+            self._late_warm_lookup(spec, tol, live, packed, mesh)
         with self._lock:
             seq = self._dispatch_seq
             self._dispatch_seq += 1
 
-        warm_key = (spec.key(), tol, cfg.dtype, self._mesh_key(mesh))
+        solve_engine_fn = solve_pdhg_bucket if engine == "pdhg" else solve_bucket
+        warm_key = (spec.key(), tol, cfg.dtype, self._mesh_key(mesh), engine)
         compile_ms = 0.0
 
         faults: List[FaultRecord] = []
@@ -950,10 +1012,10 @@ class SolveService:
                     size0 = bucket_cache_size()
                     t0 = time.perf_counter()
                     with self.tracer.span(
-                        f"compile {spec.m}x{spec.n}x{spec.batch}",
+                        f"compile {spec.m}x{spec.n}x{spec.batch}/{engine}",
                         cat="pipeline",
                     ):
-                        solve_bucket(
+                        solve_engine_fn(
                             batch, active, cfg, mesh=mesh, max_iter=1
                         )
                     compile_ms = (time.perf_counter() - t0) * 1e3
@@ -964,6 +1026,8 @@ class SolveService:
                         self._compiles += new_programs
 
                 def _solve():
+                    if engine == "pdhg":
+                        return solve_pdhg_bucket(batch, active, cfg, mesh=mesh)
                     return solve_bucket(
                         batch, active, cfg, mesh=mesh,
                         warm=packed.warm, warm_mask=packed.warm_mask,
@@ -1024,6 +1088,15 @@ class SolveService:
         # device window — the pipeline's realized overlap.
         overlap_ms = self._overlap_ms(t_sol0, t_sol1)
         self._m_dispatches.inc()
+        ctr = self._m_engine_dispatches.get(engine)
+        if ctr is None:
+            ctr = self.metrics.counter(
+                "serve_engine_dispatches_total",
+                labels={"engine": engine},
+                help="bucket dispatches by solve engine (ipm/pdhg)",
+            )
+            self._m_engine_dispatches[engine] = ctr
+        ctr.inc()
         self._m_pack_ms.observe(packed.pack_ms)
         self._m_solve_ms.observe((t_sol1 - t_sol0) * 1e3)
         self._m_overlap_ms.observe(overlap_ms)
@@ -1065,10 +1138,14 @@ class SolveService:
                 self._phase_iters[r["engine"]] = (
                     self._phase_iters.get(r["engine"], 0) + r["iters"]
                 )
+            self._engine_dispatches[engine] = (
+                self._engine_dispatches.get(engine, 0) + 1
+            )
             self._dispatch_rows.append(
                 {
                     "dispatch": seq,
                     "bucket": list(spec.key()),
+                    "engine": engine,
                     "live": len(live),
                     "pack_ms": round(packed.pack_ms, 3),
                     "compile_ms": round(compile_ms, 3),
@@ -1089,6 +1166,7 @@ class SolveService:
                 "dispatch": seq,
                 "bucket": list(spec.key()),
                 "tol": tol,
+                "engine": engine,
                 "live": len(live),
                 "padding_waste": round(waste, 4),
                 "pack_ms": round(packed.pack_ms, 3),
@@ -1202,6 +1280,7 @@ class SolveService:
                     pack_ms=packed.pack_ms,
                     overlap_ms=overlap_ms,
                     warm=warm_label,
+                    engine=engine,
                 ),
             )
 
@@ -1461,7 +1540,7 @@ class SolveService:
             self._wake.notify_all()
         for p, e in misfits:
             self._fail_batch(
-                (BucketSpec(p.m, p.n, 1), p.tol), [p], e
+                (BucketSpec(p.m, p.n, 1), p.tol, p.engine), [p], e
             )
         self.tracer.instant(
             "serve.ladder_swap",
@@ -1500,7 +1579,10 @@ class SolveService:
             return d, None
 
     def warm_buckets(
-        self, specs: Sequence[BucketSpec], tol: Optional[float] = None
+        self,
+        specs: Sequence[BucketSpec],
+        tol: Optional[float] = None,
+        engines: Optional[Sequence[str]] = None,
     ) -> int:
         """Pre-compile the bucket programs for ``specs`` at ``tol``
         (default: the service tolerance) on the current mesh, so live
@@ -1518,67 +1600,83 @@ class SolveService:
             place_bucket,
             solve_bucket,
         )
+        from distributedlpsolver_tpu.backends.first_order import (
+            solve_pdhg_bucket,
+        )
         from distributedlpsolver_tpu.models.generators import random_batched_lp
 
         tol = self.solver_config.tol if tol is None else tol
+        if engines is None:
+            # The PDHG engine only ever serves its tolerance tier —
+            # warming it below pdhg_tol would compile programs no
+            # request can reach.
+            engines = ["ipm"]
+            if self.config.pdhg_routing and tol >= self.config.pdhg_tol:
+                engines.append("pdhg")
         cfg = self.solver_config.replace(tol=tol)
         with self._lock:
             mesh = self._mesh
         warmed = 0
         for spec in specs:
-            wk = (spec.key(), tol, cfg.dtype, self._mesh_key(mesh))
-            with self._lock:
-                already = wk in self._warm
-            if already:
-                continue
-            # A feasible+bounded random batch at the exact bucket shape:
-            # max_iter is traced, so this max_iter=1 call compiles the
-            # same executable real dispatches reuse.
-            dummy = random_batched_lp(spec.batch, spec.m, spec.n, seed=0)
-            placed, act = place_bucket(
-                dummy, np.ones(spec.batch, dtype=bool), cfg, mesh=mesh
-            )
-            size0 = bucket_cache_size()
-            cache_dir, entries0 = self._cache_dir_snapshot()
-            t0 = time.perf_counter()
-            try:
-                solve_bucket(placed, act, cfg, mesh=mesh, max_iter=1)
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except Exception as e:  # warm-up failure: traffic pays later
+            for engine in engines:
+                wk = (spec.key(), tol, cfg.dtype, self._mesh_key(mesh), engine)
+                with self._lock:
+                    already = wk in self._warm
+                if already:
+                    continue
+                # A feasible+bounded random batch at the exact bucket
+                # shape: max_iter is traced, so this max_iter=1 call
+                # compiles the same executable real dispatches reuse.
+                dummy = random_batched_lp(spec.batch, spec.m, spec.n, seed=0)
+                placed, act = place_bucket(
+                    dummy, np.ones(spec.batch, dtype=bool), cfg, mesh=mesh
+                )
+                fn = solve_pdhg_bucket if engine == "pdhg" else solve_bucket
+                size0 = bucket_cache_size()
+                cache_dir, entries0 = self._cache_dir_snapshot()
+                t0 = time.perf_counter()
+                try:
+                    fn(placed, act, cfg, mesh=mesh, max_iter=1)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:  # warm-up failure: traffic pays later
+                    self._logger.event(
+                        {
+                            "event": "warmup_error",
+                            "bucket": list(spec.key()),
+                            "engine": engine,
+                            "detail": f"{type(e).__name__}: {e}"[:300],
+                        }
+                    )
+                    continue
+                warmed += 1
+                new_programs = bucket_cache_size() - size0
+                self._m_compiles.inc(new_programs)
+                with self._lock:
+                    self._warm.add(wk)
+                    self._compiles += new_programs
+                if not cache_dir:
+                    cache = "off"
+                else:
+                    _, entries1 = self._cache_dir_snapshot()
+                    wrote = (
+                        entries0 is not None
+                        and entries1 is not None
+                        and bool(entries1 - entries0)
+                    )
+                    cache = "miss" if wrote else "hit"
                 self._logger.event(
                     {
-                        "event": "warmup_error",
+                        "event": "warmup",
                         "bucket": list(spec.key()),
-                        "detail": f"{type(e).__name__}: {e}"[:300],
+                        "tol": tol,
+                        "engine": engine,
+                        "cache": cache,
+                        "compile_ms": round(
+                            (time.perf_counter() - t0) * 1e3, 3
+                        ),
                     }
                 )
-                continue
-            warmed += 1
-            new_programs = bucket_cache_size() - size0
-            self._m_compiles.inc(new_programs)
-            with self._lock:
-                self._warm.add(wk)
-                self._compiles += new_programs
-            if not cache_dir:
-                cache = "off"
-            else:
-                _, entries1 = self._cache_dir_snapshot()
-                wrote = (
-                    entries0 is not None
-                    and entries1 is not None
-                    and bool(entries1 - entries0)
-                )
-                cache = "miss" if wrote else "hit"
-            self._logger.event(
-                {
-                    "event": "warmup",
-                    "bucket": list(spec.key()),
-                    "tol": tol,
-                    "cache": cache,
-                    "compile_ms": round((time.perf_counter() - t0) * 1e3, 3),
-                }
-            )
         return warmed
 
     # -- introspection ---------------------------------------------------
@@ -1620,6 +1718,7 @@ class SolveService:
             overlap_total = self._overlap_ms_total
             pack_total = self._pack_ms_total
             phase_iters = dict(self._phase_iters)
+            engine_dispatches = dict(self._engine_dispatches)
             buckets = [list(s.key()) for s in self.scheduler.table.specs()]
             idle = {
                 "waits": self._idle_waits,
@@ -1647,6 +1746,7 @@ class SolveService:
             "schedule": self.solver_config.bucket_schedule_resolved(platform),
             "fused_iters": self.solver_config.fused_iters_resolved(platform),
             "phase_iters": phase_iters,
+            "engine_dispatches": engine_dispatches,
             "idle": idle,
             "buckets": buckets,
             # Per-tenant admission accounting (None without the SLO

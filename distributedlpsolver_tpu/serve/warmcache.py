@@ -47,6 +47,12 @@ class WarmEntry:
     scaled_A: Optional[object] = None
     # Block-structure hint (models/structure.py detection result).
     structure: Optional[dict] = None
+    # Final IPM scaling vector d of the last OPTIMAL solve — the
+    # sparse-iterative backend's warm preconditioner seed: the next
+    # same-structure solve freezes its PCG preconditioner factors on
+    # this d for the early (loose-forcing) iterations instead of
+    # refactoring every step (backends/sparse_iterative.offer_precond).
+    precond_d: Optional[object] = None
     tol: float = 0.0
     solves: int = 0  # OPTIMAL finishes stored under this fingerprint
 
@@ -114,6 +120,7 @@ class WarmCache:
         scaling=None,
         scaled_A=None,
         structure=None,
+        precond_d=None,
         tol: float = 0.0,
     ) -> None:
         """Insert/refresh the entry for ``fingerprint``, evicting the
@@ -138,6 +145,9 @@ class WarmCache:
                 structure=structure
                 if structure is not None
                 else (prev.structure if prev else None),
+                precond_d=precond_d
+                if precond_d is not None
+                else (prev.precond_d if prev else None),
                 tol=tol or (prev.tol if prev else 0.0),
                 solves=(prev.solves if prev else 0) + (1 if state is not None else 0),
             )

@@ -1,0 +1,17 @@
+# Seeds: dtype-explicit x2 + dtype-narrow x1 — sparse-ops idioms written
+# OUTSIDE the sanctioned matrix-free modules. Checked with
+# pkg_path="ipm/fx.py": the ELL pad buffers must pin their dtype (an
+# unpinned jnp.zeros rides the x64 flag) and the f32 probe-factor
+# narrowing belongs in ops/pcg.py (NARROW_SANCTIONED), anywhere else it
+# is unbudgeted precision loss.
+import jax.numpy as jnp
+
+
+def ell_pad(m, k):
+    vals = jnp.zeros((m, k))  # dtype-explicit
+    cols = jnp.full((m, k), 0)  # dtype-explicit
+    return vals, cols
+
+
+def probe_factor(diag):
+    return (1.0 / diag).astype(jnp.float32)  # dtype-narrow

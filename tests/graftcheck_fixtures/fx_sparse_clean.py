@@ -1,0 +1,17 @@
+# Compliant twin of fx_sparse_bad: the IDENTICAL idioms are clean when
+# the pad buffers pin their dtypes and the probe-factor narrowing lives
+# in the sanctioned matrix-free module — checked with
+# pkg_path="ops/pcg.py" (analysis/config.NARROW_SANCTIONED; ops/sparse.py
+# is sanctioned the same way). dtype-explicit applies everywhere in
+# ops/, so the constructors still pin.
+import jax.numpy as jnp
+
+
+def ell_pad(m, k):
+    vals = jnp.zeros((m, k), jnp.float64)
+    cols = jnp.zeros((m, k), jnp.int32)
+    return vals, cols
+
+
+def probe_factor(diag):
+    return (1.0 / diag).astype(jnp.float32)  # sanctioned: loose-solve factor

@@ -98,6 +98,20 @@ class TestRuleFamilies:
         rules, _ = _rules_hit("fx_df32_clean.py", "ops/df32.py")
         assert rules == []
 
+    def test_sparse_ops_narrowing_flagged_outside_sanctioned_module(self):
+        # The sparse tier's idioms (unpinned ELL pad buffers, f32 probe
+        # factors) seeded outside the sanctioned matrix-free modules.
+        rules, findings = _rules_hit("fx_sparse_bad.py", "ipm/fx.py")
+        assert rules == ["dtype-explicit", "dtype-narrow"]
+        assert sum(f.rule == "dtype-explicit" for f in findings) == 2
+        assert sum(f.rule == "dtype-narrow" for f in findings) == 1
+
+    def test_sparse_ops_module_sanctioned_for_narrowing(self):
+        # The identical idioms under ops/pcg.py — a sanctioned
+        # matrix-free module — with pinned constructors: silent.
+        rules, _ = _rules_hit("fx_sparse_clean.py", "ops/pcg.py")
+        assert rules == []
+
     def test_locks_catches_seeded(self):
         rules, findings = _rules_hit("fx_locks_bad.py", "serve/fx.py")
         assert rules == ["guarded-by"]

@@ -144,9 +144,10 @@ def test_persistent_crash_degrades_backend(reference_result):
         supervisor=_sup(fault_plan=plan, max_retries=8),
     )
     assert r.status == Status.OPTIMAL
-    assert r.backend == "cpu-sparse"  # first chain entry after "tpu"
+    # first chain entry after "tpu" (the matrix-free inexact-IPM rung)
+    assert r.backend == "sparse-iterative"
     assert [f.kind for f in r.faults] == [FaultKind.CRASH] * 4
-    assert r.faults[-1].action == "degrade:cpu-sparse"
+    assert r.faults[-1].action == "degrade:sparse-iterative"
     np.testing.assert_allclose(
         r.objective, reference_result.objective, rtol=1e-6
     )
