@@ -126,6 +126,13 @@ JSONL_EVENT_TYPES = {
     "route",
     "backend_ejected",
     "backend_readmitted",
+    # Crash-safe serving fabric: one record per journal recovery pass
+    # (serve/service._replay_journal), per drain phase transition
+    # (begin/end/listener_close), and per applied shared-registry
+    # mutation (net/registry.py).
+    "journal_replay",
+    "drain",
+    "registry_write",
 }
 
 # Every field a stamped JSONL record may carry, across all streams: the
@@ -225,6 +232,24 @@ JSONL_FIELDS = {
     "iteration",
     "recovery_overhead_s",
     "t",
+    # crash-safe serving fabric: journal_replay tallies (replayed/
+    # re-enqueued/expired-honest-TIMEOUT/failed-spec, torn/skipped WAL
+    # lines, result files re-bound), drain phases (begin/end/
+    # listener_close + drained verdict + in-flight count), and
+    # registry_write records (ejected flag, file generation, writer id)
+    "replayed",
+    "reenqueued",
+    "expired",
+    "failed",
+    "torn",
+    "skipped",
+    "results",
+    "phase",
+    "inflight",
+    "drained",
+    "ejected",
+    "generation",
+    "writer",
 }
 
 # ``X.write(json.dumps(...))`` record emission points that must stamp:

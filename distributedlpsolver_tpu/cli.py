@@ -358,6 +358,8 @@ def _service_config_from(args) -> "ServiceConfig":
         warm_start=not args.no_warm_start,
         warm_cache_entries=args.warm_cache_entries,
         admission=_admission_from(args),
+        journal_dir=getattr(args, "journal_dir", None),
+        journal_fsync=getattr(args, "journal_fsync", "flush"),
     )
 
 
@@ -471,21 +473,35 @@ def cmd_serve_http(args) -> int:
     if not reg.enabled:
         reg = obs_metrics.MetricsRegistry()
     try:
-        with SolveService(
+        svc = SolveService(
             svc_cfg,
             solver_config=_config_from(args).replace(verbose=False),
             metrics=reg,
-        ) as svc:
+            # Warm-up (below) runs BEFORE the pipeline threads start so
+            # even journal-replayed work recovered at construction
+            # dispatches against compiled programs.
+            auto_start=not args.warm_buckets,
+        )
+        if args.warm_buckets:
+            n = svc.warm_buckets(svc.scheduler.table.specs())
+            print(f"warmed {n} bucket programs", file=sys.stderr)
+        with svc:
             server = SolveHTTPServer(svc, net_cfg).start()
+            import threading
+
+            stopped = threading.Event()
+            # The /quitquitquit drain path closes the listener, then
+            # this callback lets the process exit cleanly.
+            server.on_drained = lambda drained: stopped.set()
             print(
                 f"serving on {server.url} "
-                f"(POST /v1/solve; GET /metrics /healthz /statusz)",
+                f"(POST /v1/solve; GET /metrics /healthz /readyz "
+                f"/statusz; POST /quitquitquit drains)",
                 file=sys.stderr,
             )
             try:
-                import threading
-
-                threading.Event().wait()  # serve until SIGINT
+                stopped.wait()  # serve until SIGINT or drained
+                print("drained; exiting", file=sys.stderr)
             except KeyboardInterrupt:
                 print("shutting down", file=sys.stderr)
             finally:
@@ -517,6 +533,8 @@ def cmd_route(args) -> int:
             poll_s=args.poll_s,
             eject_after=args.eject_after,
             log_jsonl=args.log_jsonl,
+            registry_path=args.registry,
+            probe_backoff_cap_s=args.probe_backoff_cap_s,
         ),
         metrics=reg,
     )
@@ -708,6 +726,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             '"weight": 2}}, "default": {...}, "fair_start": 0.5} '
             "(README 'Network serving')",
         )
+        p.add_argument(
+            "--journal-dir", default=None,
+            help="durable job journal directory: write-ahead request "
+            "log + on-disk async results; a restart against the same "
+            "directory replays unfinished work and re-binds poll URLs "
+            "(README 'Durability & graceful shutdown')",
+        )
+        p.add_argument(
+            "--journal-fsync", default="flush",
+            choices=["none", "flush", "always"],
+            help="journal persistence per record: flush survives "
+            "kill -9 (default), always additionally fsyncs",
+        )
 
     ap_srv = sub.add_parser(
         "serve",
@@ -749,6 +780,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--net-log-jsonl", default=None,
         help="http_request JSONL event stream (stamped schema)",
     )
+    ap_http.add_argument(
+        "--warm-buckets", action="store_true",
+        help="pre-compile the explicit --buckets ladder before binding "
+        "the listener (restart recovery runs warm from request one)",
+    )
     _add_serving_flags(ap_http)
     _add_solver_flags(ap_http)
     ap_http.set_defaults(fn=cmd_serve_http, quiet=True)
@@ -778,6 +814,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap_rt.add_argument(
         "--log-jsonl", default=None,
         help="route/ejection JSONL event stream (stamped schema)",
+    )
+    ap_rt.add_argument(
+        "--registry", default=None,
+        help="shared backend-registry file: replicated routers pointed "
+        "at the same path share one consistent view of backends, "
+        "ejections and re-admissions (README 'Durability & graceful "
+        "shutdown')",
+    )
+    ap_rt.add_argument(
+        "--probe-backoff-cap-s", type=float, default=30.0,
+        help="ceiling on the exponential re-probe backoff of ejected "
+        "backends",
     )
     ap_rt.add_argument("--metrics-path", default=None, help=argparse.SUPPRESS)
     ap_rt.add_argument("--trace-path", default=None, help=argparse.SUPPRESS)

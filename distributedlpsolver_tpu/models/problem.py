@@ -97,6 +97,74 @@ class LPProblem:
     def objective(self, x: np.ndarray) -> float:
         return float(self.c @ x) + self.c0
 
+    def to_dict(self) -> dict:
+        """JSON-serializable round-trip of the problem — the durable job
+        journal's replay payload (serve/journal.py). Dense ``A`` stores
+        row lists; sparse ``A`` stores COO triplets so journaling never
+        densifies. Infinities survive as the strings "inf"/"-inf"
+        (strict JSON has no Infinity literal)."""
+
+        def _vec(v):
+            return [
+                float(x) if np.isfinite(x) else ("inf" if x > 0 else "-inf")
+                for x in np.asarray(v, dtype=np.float64).ravel()
+            ]
+
+        d = {
+            "c": _vec(self.c),
+            "rlb": _vec(self.rlb),
+            "rub": _vec(self.rub),
+            "lb": _vec(self.lb),
+            "ub": _vec(self.ub),
+            "c0": float(self.c0),
+            "name": self.name,
+            "maximize": bool(self.maximize),
+            "shape": [int(self.m), int(self.n)],
+        }
+        if _is_sparse(self.A):
+            coo = self.A.tocoo()
+            d["A_coo"] = {
+                "row": [int(i) for i in coo.row],
+                "col": [int(j) for j in coo.col],
+                "val": [float(v) for v in coo.data],
+            }
+        else:
+            d["A"] = [[float(v) for v in row] for row in np.asarray(self.A)]
+        if self.block_structure:
+            d["block_structure"] = {
+                k: int(v) for k, v in self.block_structure.items()
+            }
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LPProblem":
+        """Inverse of :meth:`to_dict`."""
+
+        def _vec(v):
+            # float("inf")/float("-inf") parse the to_dict sentinels.
+            return np.array([float(x) for x in v], dtype=np.float64)
+
+        m, n = (int(v) for v in d["shape"])
+        if "A_coo" in d:
+            coo = d["A_coo"]
+            A: Matrix = sp.csr_matrix(
+                (coo["val"], (coo["row"], coo["col"])), shape=(m, n)
+            )
+        else:
+            A = np.asarray(d["A"], dtype=np.float64).reshape(m, n)
+        return cls(
+            c=_vec(d["c"]),
+            A=A,
+            rlb=_vec(d["rlb"]),
+            rub=_vec(d["rub"]),
+            lb=_vec(d["lb"]),
+            ub=_vec(d["ub"]),
+            c0=float(d.get("c0", 0.0)),
+            name=str(d.get("name", "LP")),
+            maximize=bool(d.get("maximize", False)),
+            block_structure=d.get("block_structure"),
+        )
+
     def row_activity(self, x: np.ndarray) -> np.ndarray:
         return np.asarray(self.A @ x).ravel()
 

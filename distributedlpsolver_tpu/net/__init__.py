@@ -19,6 +19,14 @@ dependencies):
   backend registry — shape-aware routing onto each backend's advertised
   bucket ladder, load-aware tie-breaking from polled ``/statusz``,
   health-checked failover with retry-once semantics.
+- **Crash-safe fabric** (README "Durability & graceful shutdown"):
+  :mod:`net.registry` — a file-backed shared backend table so N
+  replicated routers agree on ejections/re-admissions (cross-process
+  stale-probe guard, single-writer lease); drain endpoints
+  (``/readyz``, ``POST /quitquitquit``) over the durable job journal
+  in :mod:`distributedlpsolver_tpu.serve.journal`; and
+  :mod:`net.chaos` — the deterministic kill -9 / torn-tail / stall
+  harness ``scripts/probe_chaos.py`` drives in tier-1.
 """
 
 from distributedlpsolver_tpu.net.admission import (
@@ -32,15 +40,18 @@ from distributedlpsolver_tpu.net.protocol import (
     ProtocolError,
     SolveRequest,
     parse_solve_request,
+    payload_from_record,
     peek_route_hint,
     result_payload,
 )
+from distributedlpsolver_tpu.net.registry import BackendRegistry
 from distributedlpsolver_tpu.net.router import Router, RouterConfig
 from distributedlpsolver_tpu.net.server import NetConfig, SolveHTTPServer
 
 __all__ = [
     "AdmissionConfig",
     "AdmissionController",
+    "BackendRegistry",
     "NetConfig",
     "ProtocolError",
     "Router",
@@ -51,6 +62,7 @@ __all__ = [
     "TenantQuota",
     "Verdict",
     "parse_solve_request",
+    "payload_from_record",
     "peek_route_hint",
     "result_payload",
 ]

@@ -1,0 +1,369 @@
+"""Deterministic chaos harness for the serving fabric: seeded fault
+schedules injected into a live multi-process plane (README "Durability
+& graceful shutdown").
+
+The harness manages REAL processes (``cli serve-http`` backends and
+``cli route`` routers via :class:`ChaosPlane`) and injects the faults
+the crash-safe fabric exists to survive:
+
+- ``kill9``            — SIGKILL a process (backend, router, front-end);
+- ``restart``          — relaunch a killed process with its original
+                         command line (same port, same journal_dir —
+                         the journal-replay recovery path);
+- ``torn_tail``        — truncate the final bytes of a journal WAL
+                         before a restart (the crash-mid-write
+                         artifact replay must absorb);
+- ``sigstop``/``sigcont`` — freeze/thaw a backend (the slow-backend
+                         stall: probes time out, forwards hang, the
+                         router must fail over without losing work);
+- ``journal_fault``    — spawn a backend with
+                         ``DLPS_JOURNAL_FAIL_AFTER=n`` so its n-th WAL
+                         append raises (durability degrades, serving
+                         must not).
+
+Everything is seeded: :meth:`ChaosSchedule.seeded` derives the event
+fractions from one ``random.Random(seed)``, and the router's probe
+backoff jitter is already deterministic, so a failing chaos run replays
+exactly from its seed. ``scripts/probe_chaos.py`` drives the acceptance
+scenario (2 routers + 2 backends, 200 requests / 2 tenants) and asserts
+the invariant the whole PR is about: **no acknowledged request is ever
+lost** — every 200/202 resolves to an honest verdict after recovery,
+with zero duplicate solves and zero warm recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from distributedlpsolver_tpu.serve.journal import FAULT_ENV
+
+# Spawned processes run `python -m distributedlpsolver_tpu.cli` from the
+# repository root so the package resolves without installation.
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled fault: fires when the observed progress fraction
+    (completed responses / planned requests) crosses ``at_frac``."""
+
+    at_frac: float
+    kind: str  # kill9 | restart | torn_tail | sigstop | sigcont
+    target: str  # logical process name (ChaosPlane key)
+
+
+class ChaosSchedule:
+    """An ordered, seeded fault schedule over a request stream."""
+
+    def __init__(self, events: List[ChaosEvent]):
+        self.events = sorted(events, key=lambda e: e.at_frac)
+        self._fired: set = set()
+
+    @classmethod
+    def seeded(cls, seed: int) -> "ChaosSchedule":
+        """The acceptance schedule with seed-jittered firing points:
+        backend B killed early and restarted (journal replay #1), the
+        front-end of backend A killed mid-stream with a torn WAL tail
+        and restarted (journal replay #2 over a crash artifact), one
+        router killed outright (its sibling carries the traffic)."""
+        import random
+
+        rng = random.Random(seed)
+
+        def j(center: float) -> float:
+            return center + rng.uniform(-0.05, 0.05)
+
+        return cls(
+            [
+                ChaosEvent(j(0.20), "kill9", "backend-b"),
+                ChaosEvent(j(0.35), "restart", "backend-b"),
+                ChaosEvent(j(0.50), "kill9", "backend-a"),
+                ChaosEvent(j(0.55), "torn_tail", "backend-a"),
+                ChaosEvent(j(0.58), "restart", "backend-a"),
+                ChaosEvent(j(0.75), "kill9", "router-2"),
+            ]
+        )
+
+    def due(self, frac: float) -> List[ChaosEvent]:
+        """Events whose firing point has been crossed and not fired
+        yet, in order."""
+        out = []
+        for i, e in enumerate(self.events):
+            if i not in self._fired and frac >= e.at_frac:
+                self._fired.add(i)
+                out.append(e)
+        return out
+
+
+@dataclasses.dataclass
+class ManagedProcess:
+    """One spawned plane process plus everything needed to relaunch it."""
+
+    name: str
+    cmd: List[str]
+    popen: subprocess.Popen
+    url: str
+    port: int
+    journal_dir: Optional[str] = None
+    log_path: Optional[str] = None
+    env: Optional[dict] = None
+
+    @property
+    def pid(self) -> int:
+        return self.popen.pid
+
+    def alive(self) -> bool:
+        return self.popen.poll() is None
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (the restart scenario needs FIXED
+    ports — poll URLs and registry entries embed them — so the plane
+    reserves them up front instead of binding port 0)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ChaosPlane:
+    """Spawns and manipulates the multi-process serving plane."""
+
+    def __init__(self, workdir: str):
+        self.workdir = workdir
+        self.procs: Dict[str, ManagedProcess] = {}
+        os.makedirs(workdir, exist_ok=True)
+
+    # -- spawning ---------------------------------------------------------
+
+    def _spawn(
+        self,
+        name: str,
+        cmd: List[str],
+        port: int,
+        journal_dir: Optional[str] = None,
+        extra_env: Optional[dict] = None,
+    ) -> ManagedProcess:
+        log_path = os.path.join(self.workdir, f"{name}.log")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(extra_env or {})
+        with open(log_path, "ab") as log:
+            popen = subprocess.Popen(
+                cmd, stdout=log, stderr=log, env=env, cwd=_REPO_ROOT,
+            )
+        proc = ManagedProcess(
+            name=name,
+            cmd=cmd,
+            popen=popen,
+            url=f"http://127.0.0.1:{port}",
+            port=port,
+            journal_dir=journal_dir,
+            log_path=log_path,
+            env=extra_env,
+        )
+        self.procs[name] = proc
+        return proc
+
+    def spawn_backend(
+        self,
+        name: str,
+        port: Optional[int] = None,
+        journal_dir: Optional[str] = None,
+        buckets_json: Optional[str] = None,
+        extra_flags: Optional[List[str]] = None,
+        extra_env: Optional[dict] = None,
+    ) -> ManagedProcess:
+        """One ``cli serve-http`` backend (its own process, its own
+        journal directory)."""
+        port = port or free_port()
+        journal_dir = journal_dir or os.path.join(
+            self.workdir, f"journal-{name}"
+        )
+        cmd = [
+            sys.executable, "-m", "distributedlpsolver_tpu.cli",
+            "serve-http", "--port", str(port),
+            "--journal-dir", journal_dir,
+            "--quiet",
+        ]
+        if buckets_json:
+            cmd += ["--buckets", buckets_json, "--warm-buckets"]
+        cmd += extra_flags or []
+        return self._spawn(
+            name, cmd, port, journal_dir=journal_dir, extra_env=extra_env
+        )
+
+    def spawn_router(
+        self,
+        name: str,
+        backends: List[str],
+        registry_path: str,
+        port: Optional[int] = None,
+        extra_flags: Optional[List[str]] = None,
+    ) -> ManagedProcess:
+        """One ``cli route`` router over the shared registry."""
+        port = port or free_port()
+        cmd = [
+            sys.executable, "-m", "distributedlpsolver_tpu.cli",
+            "route", "--port", str(port),
+            "--registry", registry_path,
+            "--poll-s", "0.25",
+        ]
+        for b in backends:
+            cmd += ["--backend", b]
+        cmd += extra_flags or []
+        return self._spawn(name, cmd, port)
+
+    # -- readiness --------------------------------------------------------
+
+    def wait_ready(self, proc: ManagedProcess, timeout: float = 120.0) -> bool:
+        """Poll ``/healthz`` until 200 (backends answer once their
+        warm-up finished and the listener bound)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not proc.alive():
+                return False
+            try:
+                with urllib.request.urlopen(
+                    proc.url + "/healthz", timeout=2.0
+                ) as r:
+                    if r.status == 200:
+                        return True
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        return False
+
+    # -- fault injection --------------------------------------------------
+
+    def kill9(self, name: str) -> None:
+        """SIGKILL — the fault the journal exists for: no atexit, no
+        flush, no goodbye."""
+        proc = self.procs[name]
+        try:
+            os.kill(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.popen.wait(timeout=30)
+
+    def restart(self, name: str, wait: bool = True) -> ManagedProcess:
+        """Relaunch a killed process with its original command line —
+        same port, same journal directory (the replay path)."""
+        old = self.procs[name]
+        if old.alive():
+            self.kill9(name)
+        with open(old.log_path, "ab") as log:
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            # Injected journal faults are one-shot per incarnation: the
+            # restart comes back with a healthy WAL.
+            env.pop(FAULT_ENV, None)
+            popen = subprocess.Popen(
+                old.cmd, stdout=log, stderr=log, env=env, cwd=_REPO_ROOT,
+            )
+        proc = dataclasses.replace(old, popen=popen, env=None)
+        self.procs[name] = proc
+        if wait:
+            self.wait_ready(proc)
+        return proc
+
+    def sigstop(self, name: str) -> None:
+        """Freeze (the slow-backend stall: sockets stay open, nothing
+        answers)."""
+        os.kill(self.procs[name].pid, signal.SIGSTOP)
+
+    def sigcont(self, name: str) -> None:
+        os.kill(self.procs[name].pid, signal.SIGCONT)
+
+    @staticmethod
+    def torn_tail(journal_dir: str, nbytes: int = 9) -> bool:
+        """Truncate the WAL's final bytes — the crash-mid-write
+        artifact. Returns True if anything was cut."""
+        path = os.path.join(journal_dir, "journal.jsonl")
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return False
+        if size <= nbytes:
+            return False
+        with open(path, "ab") as fh:
+            fh.truncate(size - nbytes)
+        return True
+
+    def apply(self, event: ChaosEvent) -> str:
+        """Fire one scheduled event; returns a human-readable note."""
+        if event.kind == "kill9":
+            self.kill9(event.target)
+            return f"kill -9 {event.target}"
+        if event.kind == "restart":
+            self.restart(event.target)
+            return f"restarted {event.target}"
+        if event.kind == "torn_tail":
+            jd = self.procs[event.target].journal_dir
+            cut = bool(jd) and self.torn_tail(jd)
+            return f"torn tail on {event.target} (cut={cut})"
+        if event.kind == "sigstop":
+            self.sigstop(event.target)
+            return f"SIGSTOP {event.target}"
+        if event.kind == "sigcont":
+            self.sigcont(event.target)
+            return f"SIGCONT {event.target}"
+        raise ValueError(f"unknown chaos event kind {event.kind!r}")
+
+    # -- teardown ---------------------------------------------------------
+
+    def shutdown_all(self) -> None:
+        for proc in self.procs.values():
+            if proc.alive():
+                try:
+                    proc.popen.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 10.0
+        for proc in self.procs.values():
+            try:
+                proc.popen.wait(
+                    timeout=max(0.1, deadline - time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                try:
+                    os.kill(proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+
+
+def journal_duplicate_solves(journal_dir: str) -> int:
+    """Finished-record duplicates in one journal WAL (0 = the
+    fingerprint-idempotent replay never solved one job twice). Counts
+    ``finished`` records per jid across the whole file, tolerating the
+    same torn/garbage lines replay does."""
+    path = os.path.join(journal_dir, "journal.jsonl")
+    counts: Dict[str, int] = {}
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("j") == "finished":
+                    jid = str(rec.get("jid"))
+                    counts[jid] = counts.get(jid, 0) + 1
+    except OSError:
+        return 0
+    return sum(c - 1 for c in counts.values() if c > 1)

@@ -229,5 +229,50 @@ def result_payload(result, include_x: bool = True) -> Tuple[int, dict]:
     return code, body
 
 
+def payload_from_record(rec: dict) -> Tuple[int, dict]:
+    """(http_code, response_body) from a journal-stored result record
+    (``RequestResult.record()`` + optional ``"x"``) — the durable twin
+    of :func:`result_payload`, used when a poll id resolves from the
+    on-disk store after a front-end restart rather than from a live
+    Future. Same status→code mapping, same strict-JSON sanitization."""
+    status = str(rec.get("status", "failed"))
+    code = {
+        Status.TIMEOUT.value: 504,
+        Status.FAILED.value: 500,
+    }.get(status, 200)
+
+    def _f(key):
+        v = rec.get(key)
+        if v is None:
+            return None
+        v = float(v)
+        return v if math.isfinite(v) else None
+
+    body = {
+        "id": rec.get("id"),
+        "name": rec.get("name"),
+        "status": status,
+        "objective": _f("objective"),
+        "iterations": int(rec.get("iterations", 0)),
+        "rel_gap": _f("rel_gap"),
+        "pinf": _f("pinf"),
+        "dinf": _f("dinf"),
+        "bucket": rec.get("bucket"),
+        "m": int(rec.get("m", 0)),
+        "n": int(rec.get("n", 0)),
+        "tenant": rec.get("tenant", "default"),
+        "priority": rec.get("priority", "normal"),
+        "warm": rec.get("warm", "cold"),
+        "queue_ms": rec.get("queue_ms", 0.0),
+        "solve_ms": rec.get("solve_ms", 0.0),
+        "total_ms": rec.get("total_ms", 0.0),
+        "faults": rec.get("faults", []),
+        "recovered": True,  # served from the durable store
+    }
+    if rec.get("x") is not None:
+        body["x"] = [float(v) for v in rec["x"]]
+    return code, body
+
+
 def error_payload(code: int, error: str, **extra) -> Tuple[int, dict]:
     return code, {"error": error, **extra}

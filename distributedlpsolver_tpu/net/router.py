@@ -70,6 +70,20 @@ class RouterConfig:
     async_map_cap: int = 4096
     # route/eject JSONL event stream (stamped schema); None = off.
     log_jsonl: Optional[str] = None
+    # Shared backend registry (net/registry.py): N routers pointed at
+    # the same file share one consistent view of backends, ejections
+    # and re-admissions — an ejection observed by one router is honored
+    # by all, and a restarted router warm-loads the table instead of
+    # starting blind. None = classic single-router, in-memory only.
+    registry_path: Optional[str] = None
+    # Single-writer lease duration on the registry file.
+    registry_lease_s: float = 5.0
+    # Ejected backends are re-probed with exponential backoff (base
+    # doubling per consecutive failure, deterministic jitter) instead
+    # of every poll tick, capped at the ceiling — a dead backend isn't
+    # hammered, a flapping one can't oscillate the registry each tick.
+    probe_backoff_base_s: float = 0.5
+    probe_backoff_cap_s: float = 30.0
 
 
 @dataclasses.dataclass
@@ -100,6 +114,19 @@ class BackendState:
     # poll window herd onto the same "least loaded" backend; the live
     # count moves with each forward and spreads them.
     live: int = 0
+    # Readiness (GET /readyz): a draining backend is healthy-but-not-
+    # ready — it leaves rotation without eject/failover storms and
+    # returns when ready again.
+    ready: bool = True
+    # Wall-clock stamps of the last state observation and ejection —
+    # the merge keys the shared registry's stale-writer guard compares
+    # across router processes (perf_counter doesn't cross processes).
+    observed_ts: float = 0.0
+    ejected_at_ts: float = 0.0
+    # Probe backoff while ejected: current wait and the perf_counter
+    # moment the next probe is allowed.
+    backoff_s: float = 0.0
+    next_probe: float = 0.0
 
 
 class Router:
@@ -112,8 +139,6 @@ class Router:
         config: Optional[RouterConfig] = None,
         metrics: Optional[obs_metrics.MetricsRegistry] = None,
     ):
-        if not backends:
-            raise ValueError("router needs at least one backend URL")
         self.config = config or RouterConfig()
         self.metrics = (
             metrics if metrics is not None else obs_metrics.get_registry()
@@ -131,10 +156,34 @@ class Router:
         m = self.metrics
         self._m_healthy: Dict[str, object] = {}  # guarded-by: _lock
         self._m_routed: Dict[str, object] = {}  # guarded-by: _lock
+        self._m_backoff: Dict[str, object] = {}  # guarded-by: _lock
         self._m_failovers = m.counter(
             "router_failovers_total",
             help="forwards retried on another backend after a failure",
         )
+        # Shared registry: warm-load the table a sibling (or our own
+        # previous incarnation) built instead of starting blind, then
+        # contribute our configured backends.
+        if self.config.registry_path:
+            from distributedlpsolver_tpu.net.registry import BackendRegistry
+
+            self._registry: Optional[object] = BackendRegistry(
+                self.config.registry_path,
+                lease_s=self.config.registry_lease_s,
+                metrics=m,
+                logger=self._logger,
+            )
+            self._registry_version = 0
+            self._registry.ensure(list(self._backends))
+            self._sync_registry_pull()
+        else:
+            self._registry = None
+            self._registry_version = 0
+        if not self._backends:
+            raise ValueError(
+                "router needs at least one backend URL (from the "
+                "constructor or the shared registry)"
+            )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -182,17 +231,94 @@ class Router:
             return None
 
     def poll_once(self) -> None:
-        """One sweep: probe every backend's /healthz (ejected ones
-        included — that is the re-admission path) and refresh /statusz
-        for the healthy ones."""
+        """One sweep: pull sibling routers' registry observations, then
+        probe every due backend's /healthz (ejected ones included —
+        that is the re-admission path, paced by their backoff window)
+        + /readyz, and refresh /statusz for the healthy ones."""
+        self._sync_registry_pull()
+        now = time.perf_counter()
         with self._lock:
-            urls = list(self._backends)
+            urls = [
+                u
+                for u, st in self._backends.items()
+                # Exponential probe backoff: an ejected backend is only
+                # re-probed once its window elapses.
+                if not (st.ejected and now < st.next_probe)
+            ]
         for url in urls:
             t_start = time.perf_counter()
             h = self._fetch_json(url + "/healthz")
             ok = bool(h) and h.get("status") == "ok"
-            stz = self._fetch_json(url + "/statusz") if ok else None
-            self._record_probe(url, ok, stz, t_start)
+            ready = True
+            stz = None
+            if ok:
+                # Readiness is a separate axis: 503 here means
+                # "draining — stop routing", never failure evidence.
+                # Legacy backends without /readyz fall back to the
+                # healthz draining field (absent = ready).
+                r = self._fetch_json(url + "/readyz")
+                if r is not None and "status" in r:
+                    ready = r.get("status") == "ready"
+                else:
+                    ready = not bool(h.get("draining", False))
+                stz = self._fetch_json(url + "/statusz")
+            self._record_probe(url, ok, stz, t_start, ready=ready)
+
+    # -- shared-registry sync ---------------------------------------------
+
+    def _sync_registry_pull(self) -> None:
+        """Adopt newer observations from the shared registry: backends
+        a sibling discovered, ejections it observed (honored here even
+        though our own probes still said 200), and re-admissions. Only
+        runs a real load when the file version moved."""
+        if self._registry is None:
+            return
+        ver = self._registry.version()
+        if ver == self._registry_version:
+            return
+        data = self._registry.load()
+        self._registry_version = ver
+        now = time.perf_counter()
+        with self._lock:
+            for url, entry in data.get("backends", {}).items():
+                st = self._backends.get(url)
+                if st is None:
+                    st = BackendState(url=url)
+                    self._backends[url] = st
+                obs = float(entry.get("observed_ts", 0.0))
+                if obs <= st.observed_ts:
+                    continue  # our own view is as fresh or fresher
+                ejected = bool(entry.get("ejected", False))
+                if ejected and not st.ejected:
+                    st.ejected = True
+                    st.healthy = False
+                    # Stamp the LOCAL clock too: an in-flight probe of
+                    # ours that started before adoption is stale
+                    # evidence, exactly like a local ejection.
+                    st.ejected_at = now
+                elif not ejected and st.ejected:
+                    st.ejected = False
+                    st.backoff_s = 0.0
+                    st.next_probe = 0.0
+                    # healthy stays False until our own probe confirms.
+                st.fails = int(entry.get("fails", st.fails))
+                st.ejected_at_ts = float(
+                    entry.get("ejected_at_ts", st.ejected_at_ts)
+                )
+                st.observed_ts = obs
+
+    def _registry_push(self, st_snapshot: dict) -> None:
+        """Publish one observed transition (values snapshotted under
+        the router lock; the registry does its own file locking)."""
+        if self._registry is None:
+            return
+        self._registry.record(
+            st_snapshot["url"],
+            ejected=st_snapshot["ejected"],
+            fails=st_snapshot["fails"],
+            observed_ts=st_snapshot["observed_ts"],
+            ejected_at_ts=st_snapshot["ejected_at_ts"],
+        )
 
     def _gauge_for(self, url: str):  # holds: _lock
         g = self._m_healthy.get(url)
@@ -205,11 +331,41 @@ class Router:
             self._m_healthy[url] = g
         return g
 
+    def _backoff_gauge(self, url: str):  # holds: _lock
+        g = self._m_backoff.get(url)
+        if g is None:
+            g = self.metrics.gauge(
+                "router_probe_backoff_s",
+                labels={"backend": url},
+                help="current re-probe backoff of an ejected backend",
+            )
+            self._m_backoff[url] = g
+        return g
+
+    def _bump_backoff(self, st: BackendState, now: float) -> None:  # holds: _lock
+        """Exponential backoff with deterministic jitter for the next
+        re-probe of an ejected backend: doubles per consecutive failed
+        probe, jittered ±25% by a hash of (url, fails) — deterministic,
+        so a seeded chaos run replays exactly, but de-phased across
+        backends so re-probes don't synchronize."""
+        import zlib
+
+        base = self.config.probe_backoff_base_s
+        cap = self.config.probe_backoff_cap_s
+        raw = min(cap, base * (2.0 ** max(0, st.fails - self.config.eject_after)))
+        frac = (
+            zlib.crc32(f"{st.url}:{st.fails}".encode("utf-8")) % 1000
+        ) / 1000.0
+        st.backoff_s = min(cap, raw * (0.75 + 0.5 * frac))
+        st.next_probe = now + st.backoff_s
+        self._backoff_gauge(st.url).set(st.backoff_s)
+
     def _record_probe(
         self, url: str, ok: bool, statusz: Optional[dict],
-        t_start: float = 0.0,
+        t_start: float = 0.0, ready: bool = True,
     ) -> None:
         ejected = readmitted = False
+        push = None
         with self._lock:
             st = self._backends.get(url)
             if st is None:
@@ -228,6 +384,11 @@ class Router:
                     st.ejected = False
                     readmitted = True
                 st.healthy = True
+                st.ready = ready
+                st.backoff_s = 0.0
+                st.next_probe = 0.0
+                self._backoff_gauge(url).set(0.0)
+                st.observed_ts = time.time()
                 if statusz:
                     stats = statusz.get("stats") or {}
                     st.queue_depth = int(stats.get("queue_depth", 0) or 0)
@@ -236,13 +397,21 @@ class Router:
                     st.buckets = [
                         tuple(b) for b in (stats.get("buckets") or [])
                     ]
+                if readmitted:
+                    push = self._snapshot_for_registry(st)
             else:
                 st.fails += 1
                 st.healthy = False
                 if not st.ejected and st.fails >= self.config.eject_after:
                     st.ejected = True
                     st.ejected_at = time.perf_counter()
+                    st.ejected_at_ts = time.time()
                     ejected = True
+                st.observed_ts = time.time()
+                if st.ejected:
+                    self._bump_backoff(st, time.perf_counter())
+                if ejected:
+                    push = self._snapshot_for_registry(st)
             fails = st.fails
             self._gauge_for(url).set(1.0 if ok else 0.0)
         if ejected:
@@ -253,6 +422,18 @@ class Router:
             self._logger.event(
                 {"event": "backend_readmitted", "backend": url}
             )
+        if push is not None:
+            self._registry_push(push)
+
+    @staticmethod
+    def _snapshot_for_registry(st: BackendState) -> dict:  # holds: _lock
+        return {
+            "url": st.url,
+            "ejected": st.ejected,
+            "fails": st.fails,
+            "observed_ts": st.observed_ts,
+            "ejected_at_ts": st.ejected_at_ts,
+        }
 
     def _note_forward_failure(self, url: str) -> None:
         """A forward died on ``url``: a dead socket is better evidence
@@ -267,12 +448,27 @@ class Router:
             already = st.ejected
             st.ejected = True
             st.ejected_at = time.perf_counter()
+            st.ejected_at_ts = time.time()
+            st.observed_ts = time.time()
+            self._bump_backoff(st, time.perf_counter())
             fails = st.fails
+            push = self._snapshot_for_registry(st)
             self._gauge_for(url).set(0.0)
         if not already:
             self._logger.event(
                 {"event": "backend_ejected", "backend": url, "fails": fails}
             )
+        self._registry_push(push)
+
+    def _note_draining(self, url: str) -> None:
+        """A forward came back with a backend-stamped draining 503: the
+        backend is alive but shutting down — take it out of rotation
+        (ready=False) without ejection or failure accounting; the poll
+        loop re-admits it the moment /readyz recovers."""
+        with self._lock:
+            st = self._backends.get(url)
+            if st is not None:
+                st.ready = False
 
     # -- routing ---------------------------------------------------------
 
@@ -302,7 +498,10 @@ class Router:
             in_rotation = [
                 st
                 for st in self._backends.values()
-                if st.healthy and not st.ejected and st.url not in exclude
+                if st.healthy
+                and st.ready
+                and not st.ejected
+                and st.url not in exclude
             ]
             if not in_rotation:
                 return None
@@ -432,8 +631,30 @@ class Router:
                         self._failovers += 1
                     self._m_failovers.inc()
                     continue
+            elif code == 503 and from_backend and self._is_draining(payload):
+                # The backend is gracefully shutting down: alive (no
+                # eject, no failure accounting) but done taking work —
+                # stop routing to it and retry this one request on a
+                # sibling. Distinct from a stamped 429/504, which pass
+                # through as the backend's own verdict.
+                self._note_draining(url)
+                if attempt == 0:
+                    tried = (url,)
+                    with self._lock:
+                        self._failovers += 1
+                    self._m_failovers.inc()
+                    continue
             return code, payload, url
         return code, payload, url  # second attempt's outcome, whatever it was
+
+    @staticmethod
+    def _is_draining(payload: bytes) -> bool:
+        try:
+            return json.loads(payload.decode("utf-8")).get("reason") == (
+                "draining"
+            )
+        except (ValueError, UnicodeDecodeError, AttributeError):
+            return False
 
     # -- async id mapping ------------------------------------------------
 
@@ -460,15 +681,17 @@ class Router:
     def statusz(self) -> dict:
         now = time.perf_counter()
         with self._lock:
-            return {
+            out = {
                 "failovers": self._failovers,
                 "backends": [
                     {
                         "url": st.url,
                         "healthy": st.healthy,
+                        "ready": st.ready,
                         "ejected": st.ejected,
                         "fails": st.fails,
                         "probes": st.probes,
+                        "backoff_s": round(st.backoff_s, 3),
                         "queue_depth": st.queue_depth,
                         "inflight": st.inflight,
                         "live": st.live,
@@ -483,6 +706,24 @@ class Router:
                     for st in self._backends.values()
                 ],
             }
+        if self._registry is not None:
+            data = self._registry.load()
+            out["registry"] = {
+                "path": self.config.registry_path,
+                "generation": data.get("generation", 0),
+                "writer": data.get("writer"),
+                "backends": len(data.get("backends", {})),
+            }
+        return out
+
+    def all_backend_urls(self) -> List[str]:
+        """Every known backend URL, in-rotation first — the fan-out
+        order for polls of async ids this router never issued (the id
+        was minted before a router restart, or by a sibling)."""
+        with self._lock:
+            states = list(self._backends.values())
+        states.sort(key=lambda st: (st.ejected, not st.healthy))
+        return [st.url for st in states]
 
 
 class RouterHTTPServer:
@@ -614,20 +855,36 @@ class _RouterHandler(BaseHTTPRequestHandler):
             elif path.startswith("/v1/solve/"):
                 rid = path.rsplit("/", 1)[1]
                 url = front.router.backend_for_async(rid)
-                if url is None:
-                    self._send_json(
-                        404, {"error": f"unknown async id {rid!r}"}
-                    )
-                    return
-                try:
-                    code, payload, _ = front.router._forward_once(
-                        url, path, b"", "application/json", "GET"
-                    )
-                except (urllib.error.URLError, socket.timeout, OSError):
-                    self._send_json(
-                        502, {"error": f"backend {url} unreachable"}
-                    )
-                    return
+                # Fan-out fallback: an id this router never issued (a
+                # sibling's, or minted before a router restart) — or
+                # whose remembered backend is unreachable (it may have
+                # restarted elsewhere in the registry) — is tried
+                # against every known backend. Durable job ids embed a
+                # per-journal nonce, so the first non-404 answer is
+                # authoritative and re-remembered.
+                urls = front.router.all_backend_urls()
+                candidates = (
+                    [url] + [u for u in urls if u != url]
+                    if url is not None
+                    else urls
+                )
+                code, payload = 404, json.dumps(
+                    {"error": f"unknown async id {rid!r}"}
+                ).encode("utf-8")
+                for u in candidates:
+                    try:
+                        c, pl, _ = front.router._forward_once(
+                            u, path, b"", "application/json", "GET"
+                        )
+                    except (urllib.error.URLError, socket.timeout, OSError):
+                        code, payload = 502, json.dumps(
+                            {"error": f"backend {u} unreachable"}
+                        ).encode("utf-8")
+                        continue
+                    if c != 404:
+                        code, payload = c, pl
+                        front.router.remember_async(rid, u)
+                        break
                 self._send(code, payload, "application/json")
             else:
                 self._send_json(404, {"error": f"no such route {path}"})

@@ -84,6 +84,11 @@ class NetConfig:
     # Queue depth > 0 with zero dispatch progress for this long = the
     # pipeline is wedged and healthz goes unhealthy.
     wedge_s: float = 30.0
+    # Graceful drain (POST /quitquitquit): how long the drain thread
+    # waits for in-flight work before closing the listener anyway.
+    drain_timeout_s: float = 60.0
+    # Retry-After hint on not-ready (draining) 503s.
+    drain_retry_after_s: float = 5.0
     # http_request JSONL event stream (stamped schema); None = off.
     log_jsonl: Optional[str] = None
 
@@ -124,6 +129,11 @@ class SolveHTTPServer:
         self._m_http_ms = m.histogram(
             "net_request_ms", help="HTTP request wall time (handler span)"
         )
+        # Async-store eviction accounting: {state="resolved"} is normal
+        # bounded turnover; {state="unresolved"} must stay 0 — a nonzero
+        # value is the silent-loss regression this metric exists to make
+        # observable (eviction only ever takes resolved entries now).
+        self._m_evictions: Dict[str, object] = {}  # guarded-by: _lock
         self._logger = IterLogger(
             verbose=False, jsonl_path=self.config.log_jsonl
         )
@@ -140,6 +150,12 @@ class SolveHTTPServer:
         self._progress = (-1, 0.0)  # guarded-by: _health_lock
         self._health_lock = threading.Lock()
         self._t_start = time.perf_counter()
+        # Graceful drain: the admin endpoint runs this on its own
+        # thread (drain → flush → close listener); /readyz flips the
+        # moment it starts. Optional callback fires after the listener
+        # closes (the CLI uses it to exit the process cleanly).
+        self._drain_thread: Optional[threading.Thread] = None  # guarded-by: _lock
+        self.on_drained = None  # callable(drained: bool) | None
         self._httpd = PlaneHTTPServer(
             (self.config.host, self.config.port), _Handler
         )
@@ -222,13 +238,44 @@ class SolveHTTPServer:
             }
         )
 
+    def _m_evict(self, state: str):  # holds: _lock
+        ctr = self._m_evictions.get(state)
+        if ctr is None:
+            ctr = self.metrics.counter(
+                "net_store_evictions_total",
+                labels={"state": state},
+                help="async-store evictions by entry state (unresolved "
+                "must stay 0 — a resolved-only eviction policy)",
+            )
+            self._m_evictions[state] = ctr
+        return ctr
+
     def _register_async(self, fut, include_x: bool) -> str:
+        # With a durable journal the service's job id IS the poll id —
+        # stable across front-end restarts (GET /v1/solve/{jid} falls
+        # through to the on-disk store). Without one, a process-local
+        # LRU id.
+        jid = getattr(fut, "jid", None)
         with self._lock:
-            self._async_seq += 1
-            rid = f"a{self._async_seq}"
+            if jid:
+                rid = str(jid)
+            else:
+                self._async_seq += 1
+                rid = f"a{self._async_seq}"
             self._async[rid] = (fut, include_x, time.perf_counter())
-            while len(self._async) > self.config.async_results_cap:
-                self._async.popitem(last=False)
+            # Evict only RESOLVED entries past the cap: dropping an
+            # unresolved future under pressure silently lost its poll
+            # URL (the acknowledged request became a permanent 404).
+            # With nothing resolved the store may exceed the cap — it
+            # is still bounded by admission (max_queue_depth) upstream.
+            if len(self._async) > self.config.async_results_cap:
+                for old_rid in list(self._async):
+                    if len(self._async) <= self.config.async_results_cap:
+                        break
+                    old_fut = self._async[old_rid][0]
+                    if old_fut.done():
+                        del self._async[old_rid]
+                        self._m_evict("resolved").inc()
         return rid
 
     def _lookup_async(self, rid: str):
@@ -273,10 +320,68 @@ class SolveHTTPServer:
                 "pipeline_alive": pipeline,
                 "wedged": wedged,
                 "queue_depth": depth,
+                # Liveness and readiness are separate axes: a draining
+                # backend is HEALTHY (don't eject it) but NOT READY
+                # (stop routing to it) — /readyz carries the verdict.
+                "draining": bool(getattr(self.service, "draining", False)),
             }
             self._health = (ok, payload)
             self._health_t = now
             return self._health
+
+    def ready(self) -> Tuple[bool, dict]:
+        """(ready, payload) for ``/readyz``: ready to ACCEPT work —
+        pipeline up and not draining. Routers stop routing on 503 here
+        without treating it as failure evidence (the backend is alive
+        and finishing what it holds)."""
+        draining = bool(getattr(self.service, "draining", False))
+        pipeline = self.service.pipeline_alive()
+        ok = pipeline and not draining
+        return ok, {
+            "status": "ready" if ok else "not_ready",
+            "draining": draining,
+            "pipeline_alive": pipeline,
+        }
+
+    # -- graceful drain ----------------------------------------------------
+
+    def begin_drain(self) -> bool:
+        """Start the graceful-shutdown sequence (the ``/quitquitquit``
+        admin path): flip the service to draining (readyz 503s from this
+        instant), finish in-flight work, flush the journal, then close
+        the HTTP listener and fire ``on_drained``. Returns False if a
+        drain was already running."""
+        with self._lock:
+            if self._drain_thread is not None:
+                return False
+            self._drain_thread = threading.Thread(
+                target=self._drain_and_close,
+                daemon=True,
+                name=f"dlps-http-drain-{self.port}",
+            )
+            t = self._drain_thread
+        # Flip BEFORE the thread spins up so the 200 response to
+        # /quitquitquit races nothing: readyz is already 503 when the
+        # caller sees the acknowledgment.
+        self.service.begin_draining()
+        t.start()
+        return True
+
+    def _drain_and_close(self) -> None:
+        drained = self.service.drain_for_shutdown(
+            timeout=self.config.drain_timeout_s
+        )
+        self._logger.event(
+            {
+                "event": "drain",
+                "phase": "listener_close",
+                "drained": drained,
+            }
+        )
+        cb = self.on_drained
+        self.shutdown()
+        if cb is not None:
+            cb(drained)
 
     def statusz(self) -> dict:
         stats = self.service.stats()
@@ -336,6 +441,21 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = front._enter_request()
         code, tenant, rid = 500, "default", None
         try:
+            if parts.path in ("/quitquitquit", "/drainz"):
+                # Admin drain: acknowledge, then finish in-flight work
+                # and close the listener from a background thread.
+                # readyz is already 503 when this response is sent.
+                started = front.begin_drain()
+                code = 200
+                self._send_json(
+                    code,
+                    {
+                        "draining": True,
+                        "started": started,
+                        "queue_depth": front.service.progress()[1],
+                    },
+                )
+                return
             if parts.path != "/v1/solve":
                 code = 404
                 self._send_json(code, {"error": f"no such route {parts.path}"})
@@ -363,7 +483,11 @@ class _Handler(BaseHTTPRequestHandler):
                     priority=req.priority,
                 )
             except ServiceOverloaded as e:
-                code = 429
+                # Draining is a readiness verdict, not load shedding:
+                # 503 tells the router "route elsewhere, this backend
+                # is finishing up" (the plane header keeps it from
+                # being read as a transport failure and ejecting us).
+                code = 503 if e.reason == "draining" else 429
                 # Admission clamps its hints, but keep the header/body
                 # finite no matter which path raised the overload.
                 retry = min(max(e.retry_after_s, 0.001), 3600.0)
@@ -431,18 +555,29 @@ class _Handler(BaseHTTPRequestHandler):
                 ok, payload = front.health()
                 code = 200 if ok else 503
                 self._send_json(code, payload)
+            elif path == "/readyz":
+                ok, payload = front.ready()
+                code = 200 if ok else 503
+                self._send_json(
+                    code,
+                    payload,
+                    headers=(
+                        {}
+                        if ok
+                        else {
+                            "Retry-After": (
+                                f"{front.config.drain_retry_after_s:.3f}"
+                            )
+                        }
+                    ),
+                )
             elif path == "/statusz":
                 code = 200
                 self._send_json(code, front.statusz())
             elif path.startswith("/v1/solve/"):
                 rid = path.rsplit("/", 1)[1]
                 entry = front._lookup_async(rid)
-                if entry is None:
-                    code = 404
-                    self._send_json(
-                        code, {"error": f"unknown or expired id {rid!r}"}
-                    )
-                else:
+                if entry is not None:
                     fut, include_x, _ = entry
                     if not fut.done():
                         code = 202
@@ -454,6 +589,32 @@ class _Handler(BaseHTTPRequestHandler):
                             fut.result(), include_x
                         )
                         self._send_json(code, payload)
+                else:
+                    # Durable fallback: ids this process never minted
+                    # (issued before a restart) resolve through the
+                    # journal's on-disk store / pending set.
+                    job_result = getattr(
+                        front.service, "job_result", None
+                    )
+                    kind, rec = (
+                        job_result(rid)
+                        if job_result is not None
+                        else ("unknown", None)
+                    )
+                    if kind == "done":
+                        code, payload = protocol.payload_from_record(rec)
+                        self._send_json(code, payload)
+                    elif kind == "pending":
+                        code = 202
+                        self._send_json(
+                            code, {"id": rid, "status": "pending"}
+                        )
+                    else:
+                        code = 404
+                        self._send_json(
+                            code,
+                            {"error": f"unknown or expired id {rid!r}"},
+                        )
             else:
                 code = 404
                 self._send_json(code, {"error": f"no such route {path}"})

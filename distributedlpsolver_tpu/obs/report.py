@@ -30,11 +30,14 @@ from distributedlpsolver_tpu.obs.stats import summarize
 _REQUEST_PHASES = ("queue_ms", "pack_ms", "compile_ms", "solve_ms", "total_ms")
 
 
-def load_file(path: str) -> Tuple[List[dict], Optional[dict]]:
-    """(jsonl_records, metrics_snapshot) from one file. A file holding a
-    single JSON object (the ``write_snapshot`` output) is a snapshot;
-    anything else is treated as newline-delimited records. Unparseable
-    lines are skipped, not fatal — crash logs end mid-line."""
+def load_file(path: str) -> Tuple[List[dict], Optional[dict], int]:
+    """(jsonl_records, metrics_snapshot, skipped) from one file. A file
+    holding a single JSON object (the ``write_snapshot`` output) is a
+    snapshot; anything else is treated as newline-delimited records.
+    Unparseable lines are SKIPPED AND COUNTED, never fatal — a crash
+    log's torn final record (the process died mid-write) is exactly the
+    file this loader exists for, and the count surfaces in the report
+    so a truncation is a visible warning, not silence."""
     with open(path) as fh:
         text = fh.read()
     stripped = text.strip()
@@ -44,10 +47,11 @@ def load_file(path: str) -> Tuple[List[dict], Optional[dict]]:
         try:
             obj = json.loads(stripped)
             if isinstance(obj, dict) and "event" not in obj and "iter" not in obj:
-                return [], obj
+                return [], obj, 0
         except ValueError:
             pass
     records = []
+    skipped = 0
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -55,10 +59,13 @@ def load_file(path: str) -> Tuple[List[dict], Optional[dict]]:
         try:
             rec = json.loads(line)
         except ValueError:
+            skipped += 1
             continue
         if isinstance(rec, dict):
             records.append(rec)
-    return records, None
+        else:
+            skipped += 1
+    return records, None, skipped
 
 
 def build_report(
@@ -205,6 +212,19 @@ def build_report(
         "overhead_s_total": round(sum(overheads), 6),
     }
 
+    # -- durability (crash-safe serving fabric) --------------------------
+    replays = events.get("journal_replay", [])
+    drains = events.get("drain", [])
+    report["durability"] = {
+        "journal_replays": len(replays),
+        "replayed": sum(int(r.get("replayed", 0)) for r in replays),
+        "reenqueued": sum(int(r.get("reenqueued", 0)) for r in replays),
+        "expired": sum(int(r.get("expired", 0)) for r in replays),
+        "torn_tails": sum(int(r.get("torn", 0)) for r in replays),
+        "drains": sum(1 for d in drains if d.get("phase") == "begin"),
+        "registry_writes": len(events.get("registry_write", [])),
+    }
+
     # -- iteration trajectory --------------------------------------------
     t_iters = [float(r.get("t_iter", 0.0)) for r in iter_rows]
     total_t = sum(t_iters)
@@ -263,6 +283,13 @@ def render(report: dict) -> str:
         f"({report['stamped_records']} stamped, "
         f"{report['records'] - report['stamped_records']} legacy)"
     )
+    if report.get("skipped_lines"):
+        # A torn final record is the expected crash artifact — counted
+        # loudly, parsed around quietly.
+        out.append(
+            f"warning: {report['skipped_lines']} unparseable line(s) "
+            f"skipped (torn/truncated records)"
+        )
     if report["events_by_type"]:
         out.append(
             "events: "
@@ -358,6 +385,17 @@ def render(report: dict) -> str:
             f"p50={o['p50']:.3f}s p99={o['p99']:.3f}s "
             f"total={rec['overhead_s_total']:.3f}s"
         )
+    dur = report.get("durability") or {}
+    if dur.get("journal_replays") or dur.get("drains") or dur.get(
+        "registry_writes"
+    ):
+        out.append(
+            f"durability: {dur['journal_replays']} journal replays "
+            f"({dur['reenqueued']} re-enqueued, {dur['expired']} expired "
+            f"honest-TIMEOUT, {dur['torn_tails']} torn tails), "
+            f"{dur['drains']} drains, "
+            f"{dur['registry_writes']} registry writes"
+        )
 
     it = report["iterations"]
     if it["count"]:
@@ -398,11 +436,14 @@ def report_from_paths(paths: Sequence[str]) -> dict:
     build the merged report."""
     records: List[dict] = []
     metrics: dict = {}
+    skipped = 0
     for p in paths:
-        recs, snap = load_file(p)
+        recs, snap, skip = load_file(p)
         records.extend(recs)
+        skipped += skip
         if snap:
             metrics.update(snap)
     rep = build_report(records, metrics=metrics or None)
     rep["files"] = list(paths)
+    rep["skipped_lines"] = skipped
     return rep

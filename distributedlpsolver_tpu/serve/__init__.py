@@ -28,6 +28,11 @@ from distributedlpsolver_tpu.serve.buckets import (
     pad_standard_form,
     padding_waste,
 )
+from distributedlpsolver_tpu.serve.journal import (
+    JobJournal,
+    JournaledJob,
+    ReplayReport,
+)
 from distributedlpsolver_tpu.serve.records import (
     RequestResult,
     latency_summary,
@@ -52,7 +57,10 @@ __all__ = [
     "ladder_to_json",
     "BucketSpec",
     "BucketTable",
+    "JobJournal",
+    "JournaledJob",
     "PendingRequest",
+    "ReplayReport",
     "RequestResult",
     "Scheduler",
     "ServiceConfig",

@@ -47,10 +47,11 @@ class ServiceOverloaded(RuntimeError):
     Carries the structured verdict so callers (the HTTP front-end's 429
     path, the CLI's backoff loop) can act on it instead of blind
     retrying: ``reason`` is ``"depth"`` (global queue bound),
-    ``"quota"`` (the tenant's token bucket is empty) or ``"fair"`` (the
-    tenant is past its weighted fair share under contention);
-    ``retry_after_s`` is the earliest time a retry can plausibly
-    succeed (the HTTP Retry-After header value).
+    ``"quota"`` (the tenant's token bucket is empty), ``"fair"`` (the
+    tenant is past its weighted fair share under contention) or
+    ``"draining"`` (the service is gracefully shutting down — retry on
+    a different backend); ``retry_after_s`` is the earliest time a
+    retry can plausibly succeed (the HTTP Retry-After header value).
     """
 
     def __init__(
@@ -94,6 +95,11 @@ class PendingRequest:
     # tol ≥ ServiceConfig.pdhg_tol). A first-class bucket dimension —
     # engines never mix in one dispatch, each compiles its own program.
     engine: str = "ipm"
+    # Durable job journal (serve/journal.py): the job id minted at
+    # admit (the restart-stable poll token) and the request content
+    # fingerprint (the crash-retry idempotency key). None = no journal.
+    jid: Optional[str] = None
+    jfp: Optional[str] = None
 
     @property
     def m(self) -> int:

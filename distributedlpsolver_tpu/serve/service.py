@@ -177,6 +177,22 @@ class ServiceConfig:
     # the IPM engine.
     pdhg_routing: bool = True
     pdhg_tol: float = 1e-4
+    # Durable job journal (serve/journal.py): a write-ahead JSONL log of
+    # request lifecycle plus a bounded on-disk async-result store under
+    # this directory. A restarted service pointed at the same directory
+    # replays admitted-but-unfinished requests (idempotent via request
+    # fingerprints, honest TIMEOUT for work whose deadline died with
+    # the process) and re-binds every issued poll id. None = the
+    # classic in-memory-only service.
+    journal_dir: Optional[str] = None
+    # WAL persistence per record: "none" (stdio buffer), "flush"
+    # (survives kill -9 — default), "always" (flush + fsync, survives
+    # power loss).
+    journal_fsync: str = "flush"
+    # WAL records between compactions (rewrites keeping only
+    # unfinished entries) and the on-disk result-store bound.
+    journal_compact_every: int = 4096
+    journal_results_cap: int = 4096
 
 
 def standard_form(problem: LPProblem):
@@ -407,6 +423,30 @@ class SolveService:
         self._thread: Optional[threading.Thread] = None
         self._pack_thread: Optional[threading.Thread] = None
         self._solve_thread: Optional[threading.Thread] = None
+        # Graceful drain: once set, submit sheds with a structured
+        # "draining" verdict while accepted work runs to completion.
+        self._draining = False  # guarded-by: _lock
+        self._m_draining = m.gauge(
+            "serve_draining", help="1 while the service is draining"
+        )
+        # Durable job journal: WAL + on-disk result store; replay
+        # happens BEFORE the pipeline threads start so recovered work
+        # is queued (in admit order) ahead of any new traffic.
+        self._jobs: dict = {}  # jid -> Future of pending jobs; guarded-by: _lock
+        self._replayed_by_fp: dict = {}  # jfp -> jid; guarded-by: _lock
+        if self.config.journal_dir:
+            from distributedlpsolver_tpu.serve.journal import JobJournal
+
+            self._journal: Optional[object] = JobJournal(
+                self.config.journal_dir,
+                fsync=self.config.journal_fsync,
+                compact_every=self.config.journal_compact_every,
+                results_cap=self.config.journal_results_cap,
+                metrics=m,
+            )
+            self._replay_journal()
+        else:
+            self._journal = None
         if auto_start:
             self.start()
 
@@ -500,10 +540,202 @@ class SolveService:
             summary["metrics"] = self.metrics.snapshot()
         self._logger.event(summary)
         self._logger.close()
+        if self._journal is not None:
+            self._journal.close()
         if self.config.metrics_path and self.metrics.enabled:
             self.metrics.write_prometheus(self.config.metrics_path)
         if self._owns_tracer:
             self.tracer.close()
+
+    # -- graceful drain ---------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain_for_shutdown` flipped the flag — the
+        ``/readyz`` signal (healthz stays live; admission is closed)."""
+        with self._lock:
+            return self._draining
+
+    def begin_draining(self) -> None:
+        """Flip the draining flag synchronously: admission closes (and
+        ``/readyz`` goes 503) the moment this returns, while accepted
+        work keeps running. The blocking wait lives in
+        :meth:`drain_for_shutdown`."""
+        with self._wake:
+            first = not self._draining
+            self._draining = True
+            depth = self.scheduler.depth()
+            inflight = self._inflight
+            self._wake.notify_all()
+        if first:
+            self._m_draining.set(1)
+            self.tracer.instant(
+                "serve.drain", args={"queue_depth": depth}, cat="serve"
+            )
+            self._logger.event(
+                {
+                    "event": "drain",
+                    "phase": "begin",
+                    "queue_depth": depth,
+                    "inflight": inflight,
+                }
+            )
+
+    def drain_for_shutdown(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admission (submit raises a structured
+        ``"draining"`` :class:`ServiceOverloaded` — the HTTP 503 +
+        Retry-After path), finish every in-flight and queued request,
+        then flush the journal. The pipeline threads stay up — callers
+        own the final :meth:`shutdown` — and ``/healthz`` stays
+        truthful throughout (the process is alive, just not ready).
+        Returns True iff the service fully drained within ``timeout``.
+        Idempotent: a second call just waits on the same drain."""
+        self.begin_draining()
+        drained = self.drain(timeout)
+        if self._journal is not None:
+            self._journal.flush()
+        with self._lock:
+            depth_end = self.scheduler.depth()
+        self._logger.event(
+            {
+                "event": "drain",
+                "phase": "end",
+                "drained": drained,
+                "queue_depth": depth_end,
+            }
+        )
+        return drained
+
+    # -- durable-journal recovery ----------------------------------------
+
+    def _replay_journal(self) -> None:
+        """Crash recovery: re-enqueue every admitted-but-unfinished job
+        the WAL holds (in admit order), resolving ones whose wall-clock
+        deadline died with the previous process to an honest TIMEOUT —
+        an acknowledged request always ends in a verdict, never a
+        silent disappearance."""
+        from distributedlpsolver_tpu.models.problem import LPProblem
+        from distributedlpsolver_tpu.serve.journal import JournaledJob
+
+        rep = self._journal.replay()
+        now_ts = time.time()
+        reenqueued = expired = failed = 0
+        for job in rep.unfinished:
+            if job.deadline_ts is not None and job.deadline_ts <= now_ts:
+                self._finish_replayed(
+                    job, Status.TIMEOUT,
+                    "deadline expired while the service was down",
+                )
+                expired += 1
+                continue
+            try:
+                problem = LPProblem.from_dict(job.spec["problem"])
+                remaining = (
+                    None
+                    if job.deadline_ts is None
+                    else max(0.001, job.deadline_ts - now_ts)
+                )
+                self.submit(
+                    problem,
+                    deadline=remaining,
+                    tol=job.spec.get("tol"),
+                    name=job.spec.get("name"),
+                    tenant=job.tenant,
+                    priority=job.priority,
+                    _replay_job=job,
+                )
+                reenqueued += 1
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # Malformed spec or overflow: the job still resolves —
+                # a FAILED verdict is honest, dropping it is not.
+                self._finish_replayed(
+                    job, Status.FAILED, f"{type(e).__name__}: {e}"
+                )
+                failed += 1
+        self._logger.event(
+            {
+                "event": "journal_replay",
+                "replayed": len(rep.unfinished),
+                "reenqueued": reenqueued,
+                "expired": expired,
+                "failed": failed,
+                "torn": rep.torn,
+                "skipped": rep.skipped,
+                "results": rep.results,
+            }
+        )
+
+    def _finish_replayed(
+        self, job, status: Status, detail: str
+    ) -> None:
+        """Resolve one replayed job without re-running it (expired
+        deadline, unreplayable spec) through the normal finish funnel so
+        the journal, telemetry, and result store all agree."""
+        now = time.perf_counter()
+        p = PendingRequest(
+            request_id=-1,
+            name=str(job.spec.get("name") or "replayed"),
+            c=None, A=None, b=None,
+            tol=self.solver_config.tol,
+            future=Future(),
+            t_submit=now,
+            problem=None,
+            tenant=job.tenant,
+            priority=job.priority,
+            jid=job.jid,
+            jfp=job.fp,
+        )
+        with self._lock:
+            p.request_id = self._next_id
+            self._next_id += 1
+        fault = FaultRecord(
+            FaultKind.CRASH, -1, "journal", detail, action="give_up"
+        )
+        fault.at_time = time.time()
+        self._finish(
+            p,
+            RequestResult(
+                request_id=p.request_id,
+                name=p.name,
+                status=status,
+                objective=float("nan"),
+                x=None,
+                iterations=0,
+                rel_gap=_INF,
+                pinf=_INF,
+                dinf=_INF,
+                bucket=None,
+                queue_ms=0.0,
+                compile_ms=0.0,
+                solve_ms=0.0,
+                total_ms=0.0,
+                padding_waste=0.0,
+                faults=[fault],
+                t_submit=now,
+                t_done=now,
+            ),
+        )
+
+    def job_result(self, jid: str) -> tuple:
+        """Poll surface for durable job ids: ``("done", record)`` with
+        the stored result record, ``("pending", None)`` while the job is
+        queued or in flight (including replayed-but-unfinished), or
+        ``("unknown", None)`` — never minted here, or evicted past the
+        result-store bound."""
+        if self._journal is None or not jid:
+            return ("unknown", None)
+        rec = self._journal.result(jid)
+        if rec is not None:
+            return ("done", rec)
+        with self._lock:
+            fut = self._jobs.get(jid)
+        if (fut is not None and not fut.done()) or self._journal.is_pending(
+            jid
+        ):
+            return ("pending", None)
+        return ("unknown", None)
 
     # -- submission ------------------------------------------------------
 
@@ -515,6 +747,7 @@ class SolveService:
         name: Optional[str] = None,
         tenant: str = "default",
         priority: str = "normal",
+        _replay_job=None,
     ) -> Future:
         """Enqueue one LP; the Future resolves to a RequestResult.
 
@@ -530,6 +763,15 @@ class SolveService:
         structured verdict (reason + retry_after_s), the priority class
         shades the request's flush window, and deadlines order slot
         assignment (EDF) inside its bucket queue.
+
+        With a durable journal (``ServiceConfig.journal_dir``) the
+        request is write-ahead logged before it is queued, the returned
+        Future carries the durable job id as ``fut.jid`` (the poll
+        token that survives restarts), and a resubmit whose content
+        fingerprint matches a replayed-but-unfinished job attaches to
+        that job's Future instead of solving twice (crash-retry
+        idempotency). ``_replay_job`` is the journal's own re-enqueue
+        path — never pass it.
         """
         sf = standard_form(problem)
         fp = None
@@ -559,6 +801,17 @@ class SolveService:
             )
             else "ipm"
         )
+        # Durable journal: serialize the request OUTSIDE the lock (the
+        # spec encode is the expensive part), write-ahead log it inside.
+        job_spec = jfp = None
+        if self._journal is not None and _replay_job is None:
+            from distributedlpsolver_tpu.serve import journal as journal_mod
+
+            job_spec = journal_mod.request_spec(
+                problem, tol=tol, tenant=tenant, priority=priority,
+                name=name,
+            )
+            jfp = journal_mod.request_fingerprint(job_spec)
         p = PendingRequest(
             request_id=-1,
             name=name or problem.name,
@@ -579,13 +832,32 @@ class SolveService:
                 else 1.0
             ),
             engine=engine,
+            jid=_replay_job.jid if _replay_job is not None else None,
+            jfp=_replay_job.fp if _replay_job is not None else jfp,
         )
         with self._wake:
             if self._stopping:
                 raise RuntimeError("SolveService is shut down")
+            if self._draining and _replay_job is None:
+                raise ServiceOverloaded(
+                    "service is draining for shutdown",
+                    reason="draining",
+                    retry_after_s=max(1.0, self.config.flush_s * 10),
+                    tenant=tenant,
+                )
+            if jfp is not None:
+                # Crash-retry idempotency: a resubmit of a replayed
+                # pending job rides the existing Future — one solve,
+                # one journal entry, one verdict.
+                existing = self._replayed_by_fp.get(jfp)
+                if existing is not None:
+                    fut = self._jobs.get(existing)
+                    if fut is not None and not fut.done():
+                        return fut
+                    self._replayed_by_fp.pop(jfp, None)
             p.request_id = self._next_id
             self._next_id += 1
-            if self._admission is not None:
+            if self._admission is not None and _replay_job is None:
                 v = self._admission.admit(tenant, priority, now)
                 if not v.admitted:
                     self._log_reject(p, v.reason, v.retry_after_s)
@@ -603,6 +875,19 @@ class SolveService:
                 raise
             if self._admission is not None:
                 self._admission.on_admitted(tenant)
+            if self._journal is not None:
+                if _replay_job is not None:
+                    self._journal.readmit(_replay_job)
+                    self._replayed_by_fp[_replay_job.fp] = _replay_job.jid
+                else:
+                    p.jid = self._journal.admit(
+                        job_spec, jfp, tenant, priority,
+                        deadline_ts=(
+                            None if deadline is None
+                            else time.time() + deadline
+                        ),
+                    )
+                self._jobs[p.jid] = p.future
             # Request track opens on the submit thread; the nested queue
             # span (and later pack/solve) begin/end on whichever pipeline
             # thread handles them — same (cat, id) keeps the track
@@ -618,6 +903,10 @@ class SolveService:
             )
             self.tracer.async_begin("queue", p.request_id)
             self._wake.notify_all()
+        # The durable poll token rides the Future (None without a
+        # journal): the HTTP front-end issues it as the async id, so
+        # GET /v1/solve/{jid} keeps resolving across restarts.
+        p.future.jid = p.jid
         return p.future
 
     def _log_reject(
@@ -736,6 +1025,10 @@ class SolveService:
                     del self._pack_spans[:-128]
                 for p in job.live:
                     self.tracer.async_end("pack", p.request_id)
+            if self._journal is not None and job.pack_error is None:
+                for p in job.live:
+                    if p.jid is not None:
+                        self._journal.mark(p.jid, "packed")
             self._solve_q.put(job)
 
     def _pack_bucket(self, key: QueueKey, live: List[PendingRequest]) -> _Packed:
@@ -949,6 +1242,10 @@ class SolveService:
             )
         if not live:
             return
+        if self._journal is not None:
+            for p in live:
+                if p.jid is not None:
+                    self._journal.mark(p.jid, "dispatched")
         if live[0].A is None:  # general-form solo pseudo-bucket
             for p in live:
                 self._solo(p, key, now, [], retried=False)
@@ -1430,6 +1727,18 @@ class SolveService:
         )
         if self._admission is not None:
             self._admission.on_finished(p.tenant)
+        if self._journal is not None and p.jid is not None:
+            # Persist the verdict BEFORE resolving the future: a crash
+            # after set_result but before the WAL write would replay
+            # (and re-solve) a request its caller already saw finish.
+            rec = result.record()
+            if result.x is not None:
+                rec["x"] = [float(v) for v in result.x]
+            self._journal.finish(p.jid, rec, status=result.status.value)
+            with self._lock:
+                self._jobs.pop(p.jid, None)
+                if p.jfp is not None:
+                    self._replayed_by_fp.pop(p.jfp, None)
         with self._lock:
             # Stats only need the scalar fields; retaining every x would
             # grow a long-running service's memory without bound.
@@ -1757,5 +2066,12 @@ class SolveService:
                 self._admission.stats()
                 if self._admission is not None
                 else None
+            ),
+            # Crash-safe fabric: drain state + durable-journal counters
+            # (None without a journal) — the /readyz and recovery
+            # post-mortem surface.
+            "draining": self.draining,
+            "journal": (
+                self._journal.stats() if self._journal is not None else None
             ),
         }

@@ -1,0 +1,18 @@
+# Seeds for the crash-safe-fabric schema additions: jsonl-fields x2 (a
+# journal_replay payload carrying an uncatalogued tally, a misspelled
+# drain event type) and jsonl-stamp (a WAL record written without
+# stamp_record — the replay loader depends on the ts stamp for
+# deadline accounting).
+import json
+
+
+def emit(logger, wal, rec):
+    logger.event(
+        {
+            "event": "journal_replay",
+            "replayed": 3,
+            "resurrected": 1,  # jsonl-fields: not catalogued
+        }
+    )
+    logger.event({"event": "drain_started"})  # jsonl-fields: type
+    wal.write(json.dumps(rec) + "\n")  # jsonl-stamp: unstamped

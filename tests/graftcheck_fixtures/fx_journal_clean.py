@@ -1,0 +1,39 @@
+# Compliant twin of fx_journal_bad: the crash-safe fabric's three new
+# event types with catalogued fields only, and the WAL append routed
+# through stamp_record (exactly what serve/journal.py does).
+import json
+
+from distributedlpsolver_tpu.utils.logging import stamp_record
+
+
+def emit(logger, wal, rec):
+    logger.event(
+        {
+            "event": "journal_replay",
+            "replayed": 3,
+            "reenqueued": 2,
+            "expired": 1,
+            "torn": 1,
+            "skipped": 0,
+            "results": 5,
+        }
+    )
+    logger.event(
+        {
+            "event": "drain",
+            "phase": "begin",
+            "queue_depth": 4,
+            "inflight": 2,
+        }
+    )
+    logger.event(
+        {
+            "event": "registry_write",
+            "backend": "http://10.0.0.2:8080",
+            "ejected": True,
+            "fails": 3,
+            "generation": 17,
+            "writer": "host:123",
+        }
+    )
+    wal.write(json.dumps(stamp_record(rec)) + "\n")

@@ -1,0 +1,185 @@
+"""Deterministic chaos harness tests (README "Durability & graceful
+shutdown"): seeded schedule determinism, WAL-tail truncation and
+duplicate accounting helpers, the shared backend registry's consistency
+rules, and the probe_chaos.py tier-1 smoke — the multi-process
+acceptance run (2 routers + 2 backends, kill -9 / torn tail / restart /
+drain under a seeded fault schedule).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributedlpsolver_tpu.net.chaos import (
+    ChaosPlane,
+    ChaosSchedule,
+    journal_duplicate_solves,
+)
+from distributedlpsolver_tpu.net.registry import BackendRegistry
+from distributedlpsolver_tpu.obs.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.chaos
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- schedule ----------------------------------------------------------------
+
+
+def test_seeded_schedule_is_deterministic_and_ordered():
+    a = ChaosSchedule.seeded(7)
+    b = ChaosSchedule.seeded(7)
+    assert [(e.at_frac, e.kind, e.target) for e in a.events] == [
+        (e.at_frac, e.kind, e.target) for e in b.events
+    ]
+    assert ChaosSchedule.seeded(8).events != a.events
+    fracs = [e.at_frac for e in a.events]
+    assert fracs == sorted(fracs)
+    # The acceptance scenario's legs are all present.
+    kinds = [(e.kind, e.target) for e in a.events]
+    assert ("kill9", "backend-b") in kinds
+    assert ("restart", "backend-a") in kinds
+    assert ("torn_tail", "backend-a") in kinds
+    assert ("kill9", "router-2") in kinds
+
+
+def test_schedule_due_fires_each_event_once_in_order():
+    sched = ChaosSchedule.seeded(3)
+    fired = []
+    for frac in (0.0, 0.3, 0.3, 0.6, 1.0):
+        fired.extend(e.kind for e in sched.due(frac))
+    assert fired == [e.kind for e in ChaosSchedule.seeded(3).events]
+    assert sched.due(1.0) == []
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def test_torn_tail_truncates_wal(tmp_path):
+    d = str(tmp_path)
+    path = os.path.join(d, "journal.jsonl")
+    with open(path, "w") as fh:
+        fh.write('{"j": "meta", "nonce": "ab", "next_seq": 0}\n')
+        fh.write('{"j": "admitted", "jid": "jab-1"}\n')
+    size = os.path.getsize(path)
+    assert ChaosPlane.torn_tail(d, nbytes=9)
+    assert os.path.getsize(path) == size - 9
+    # The journal replays around it (torn counted, not raised).
+    from distributedlpsolver_tpu.serve.journal import JobJournal
+
+    j = JobJournal(d)
+    assert j.replay().torn == 1
+    j.close()
+
+
+def test_journal_duplicate_solves_counts_per_jid(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "journal.jsonl"), "w") as fh:
+        for jid, n in (("jx-1", 1), ("jx-2", 3), ("jx-3", 2)):
+            for _ in range(n):
+                fh.write(json.dumps({"j": "finished", "jid": jid}) + "\n")
+        fh.write("garbage-line\n")
+    assert journal_duplicate_solves(d) == 3  # (3-1) + (2-1)
+    assert journal_duplicate_solves(str(tmp_path / "absent")) == 0
+
+
+# -- shared registry consistency rules ---------------------------------------
+
+
+def _reg(tmp_path, name="r"):
+    return BackendRegistry(
+        str(tmp_path / "registry.json"),
+        writer_id=name,
+        metrics=MetricsRegistry(),
+    )
+
+
+def test_registry_ensure_and_atomic_generation(tmp_path):
+    r = _reg(tmp_path)
+    r.ensure(["http://b1:1/", "http://b2:2"])
+    data = r.load()
+    assert set(data["backends"]) == {"http://b1:1", "http://b2:2"}
+    g0 = data["generation"]
+    r.ensure(["http://b1:1"])  # no-op: no new URL
+    assert r.load()["generation"] == g0
+    assert r.version() > 0
+
+
+def test_registry_stale_writer_cannot_clobber(tmp_path):
+    r1, r2 = _reg(tmp_path, "r1"), _reg(tmp_path, "r2")
+    now = time.time()
+    assert r1.record("http://b:1", ejected=True, fails=3, observed_ts=now)
+    # A SLOWER router flushing an OLDER observation: dropped.
+    assert not r2.record(
+        "http://b:1", ejected=False, fails=0, observed_ts=now - 5.0
+    )
+    assert r2.load()["backends"]["http://b:1"]["ejected"] is True
+
+
+def test_registry_stale_probe_cannot_resurrect_ejected(tmp_path):
+    """The cross-process half of the PR 9 stale-probe guard: recovery
+    evidence observed BEFORE the ejection landed cannot re-admit."""
+    r1, r2 = _reg(tmp_path, "r1"), _reg(tmp_path, "r2")
+    t_eject = time.time()
+    r1.record(
+        "http://b:1", ejected=True, fails=2, observed_ts=t_eject,
+        ejected_at_ts=t_eject,
+    )
+    # r2's probe STARTED before the ejection: its 200 is stale.
+    assert not r2.record(
+        "http://b:1", ejected=False, fails=0, observed_ts=t_eject,
+    )
+    assert r2.load()["backends"]["http://b:1"]["ejected"] is True
+    # Genuinely fresh recovery evidence re-admits.
+    assert r2.record(
+        "http://b:1", ejected=False, fails=0, observed_ts=t_eject + 1.0
+    )
+    assert r2.load()["backends"]["http://b:1"]["ejected"] is False
+
+
+def test_registry_lease_breaks_stale_lock(tmp_path):
+    r = _reg(tmp_path)
+    # A crashed writer left an expired lease behind.
+    with open(r.lock_path, "w") as fh:
+        json.dump({"writer": "dead", "expires_ts": time.time() - 60}, fh)
+    assert r.record("http://b:1", ejected=True, fails=1,
+                    observed_ts=time.time())
+    assert not os.path.exists(r.lock_path)
+
+
+def test_registry_survives_corrupt_file(tmp_path):
+    r = _reg(tmp_path)
+    with open(r.path, "w") as fh:
+        fh.write("{not json")
+    assert r.load()["backends"] == {}
+    assert r.record("http://b:1", ejected=False, fails=0,
+                    observed_ts=time.time())
+
+
+# -- tier-1 smoke: the full multi-process chaos acceptance run ---------------
+
+
+def test_probe_chaos_smoke():
+    """CI satellite: the chaos acceptance probe — 200 requests /
+    2 tenants through 2 replicated routers + 2 journal-backed backends
+    under the seeded fault schedule (stall, backend kill -9 + restart,
+    front-end kill -9 + torn WAL tail + replay, router kill -9,
+    graceful drain) — runs on every tier-1 pass under a wall budget,
+    asserting zero lost acknowledged requests, zero duplicate solves,
+    and zero warm recompiles."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "probe_chaos.py"),
+         "--requests", "200", "--budget-s", "240"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    tail = "\n".join(proc.stdout.splitlines()[-30:])
+    assert proc.returncode == 0, (
+        f"probe_chaos failed (rc={proc.returncode}):\n{tail}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    assert "PASS" in proc.stdout

@@ -133,6 +133,19 @@ class TestRuleFamilies:
         rules, _ = _rules_hit("fx_schema_clean.py", "serve/fx.py")
         assert rules == []
 
+    def test_journal_schema_catches_seeded(self):
+        # Crash-safe fabric additions: an uncatalogued replay tally, a
+        # misspelled drain event type, an unstamped WAL append.
+        rules, findings = _rules_hit("fx_journal_bad.py", "serve/fx.py")
+        assert rules == ["jsonl-fields", "jsonl-stamp"]
+        assert sum(f.rule == "jsonl-fields" for f in findings) == 2
+
+    def test_journal_schema_clean_twin_silent(self):
+        # journal_replay / drain / registry_write with catalogued
+        # fields + a stamped WAL write: silent.
+        rules, _ = _rules_hit("fx_journal_clean.py", "serve/fx.py")
+        assert rules == []
+
 
 class TestSuppressions:
     SRC = "import jax.numpy as jnp\n\ndef f():\n    return jnp.zeros((2, 2))%s\n"

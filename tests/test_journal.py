@@ -1,0 +1,392 @@
+"""Durable job journal tests (README "Durability & graceful shutdown"):
+WAL round trip, torn-tail tolerance, compaction, result-store bounds,
+crash recovery through SolveService (honest TIMEOUT for dead deadlines,
+fingerprint-idempotent resubmits), graceful drain, and a REAL kill -9
+crash-restart of an HTTP front-end against the same journal directory.
+
+All CPU; the crash-restart test spawns actual `cli serve-http`
+processes on ephemeral ports.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.ipm import Status
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+from distributedlpsolver_tpu.models.problem import LPProblem
+from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+from distributedlpsolver_tpu.serve.journal import (
+    JobJournal,
+    request_fingerprint,
+    request_spec,
+)
+from distributedlpsolver_tpu.serve.scheduler import ServiceOverloaded
+
+pytestmark = pytest.mark.chaos
+
+
+def _spec(seed=0, tol=1e-8, tenant="acme", name=None):
+    p = random_dense_lp(8, 24, seed=seed)
+    return request_spec(
+        p, tol=tol, tenant=tenant, priority="normal",
+        name=name or f"j{seed}",
+    )
+
+
+# -- problem serialization ---------------------------------------------------
+
+
+def test_problem_dict_roundtrip_dense_and_bounds():
+    p = random_dense_lp(6, 15, seed=3)
+    q = LPProblem.from_dict(p.to_dict())
+    assert q.m == p.m and q.n == p.n
+    np.testing.assert_allclose(q.c, p.c)
+    np.testing.assert_allclose(q.A, p.A)
+    np.testing.assert_allclose(q.rlb, p.rlb)
+    # Infinities survive the strict-JSON encoding (string sentinels).
+    blob = json.dumps(p.to_dict())
+    r = LPProblem.from_dict(json.loads(blob))
+    assert np.all(np.isposinf(r.ub) == np.isposinf(p.ub))
+
+
+def test_problem_dict_roundtrip_sparse_stays_sparse():
+    import scipy.sparse as sp
+
+    A = sp.random(10, 20, density=0.15, random_state=0, format="csr")
+    p = LPProblem(
+        c=np.ones(20), A=A, rlb=np.zeros(10), rub=np.full(10, 5.0),
+        lb=np.zeros(20), ub=np.full(20, np.inf),
+    )
+    q = LPProblem.from_dict(json.loads(json.dumps(p.to_dict())))
+    assert sp.issparse(q.A)
+    np.testing.assert_allclose(q.A.toarray(), A.toarray())
+
+
+# -- WAL mechanics -----------------------------------------------------------
+
+
+def test_journal_admit_finish_replay_roundtrip(tmp_path):
+    d = str(tmp_path / "j")
+    j = JobJournal(d)
+    s1, s2 = _spec(1), _spec(2)
+    j1 = j.admit(s1, request_fingerprint(s1), "acme", "normal", None)
+    j2 = j.admit(s2, request_fingerprint(s2), "acme", "high", None)
+    j.mark(j1, "dispatched")
+    j.finish(j1, {"status": "optimal", "id": 1}, "optimal")
+    j.close()
+
+    j_r = JobJournal(d)
+    rep = j_r.replay()
+    assert [job.jid for job in rep.unfinished] == [j2]
+    assert rep.finished == 1 and rep.torn == 0
+    assert j_r.result(j1)["status"] == "optimal"
+    assert j_r.is_pending(j2)
+    # Sequence continues past the replayed max: no id reuse.
+    s3 = _spec(3)
+    j3 = j_r.admit(s3, request_fingerprint(s3), "acme", "normal", None)
+    assert j3 not in (j1, j2)
+    j_r.close()
+
+
+def test_journal_torn_tail_skipped_with_count(tmp_path):
+    d = str(tmp_path / "j")
+    j = JobJournal(d)
+    s = _spec(1)
+    jid = j.admit(s, request_fingerprint(s), "t", "normal", None)
+    j.close()
+    # Byte-truncate the final record: the crash-mid-write artifact.
+    path = os.path.join(d, "journal.jsonl")
+    with open(path, "ab") as fh:
+        fh.write(b'{"j": "admitted", "jid": "jto')
+    j_r = JobJournal(d)
+    rep = j_r.replay()
+    assert rep.torn == 1
+    assert [job.jid for job in rep.unfinished] == [jid]
+    j_r.close()
+
+
+def test_journal_result_file_outranks_torn_finished_record(tmp_path):
+    """A crash can tear off the `finished` WAL record after the result
+    file landed (rename is atomic): replay must treat the job as done —
+    re-enqueueing it would be the duplicate solve."""
+    d = str(tmp_path / "j")
+    j = JobJournal(d)
+    s = _spec(1)
+    jid = j.admit(s, request_fingerprint(s), "t", "normal", None)
+    j.finish(jid, {"status": "optimal"}, "optimal")
+    j.close()
+    # Cut the finished record off the WAL tail.
+    path = os.path.join(d, "journal.jsonl")
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    with open(path, "wb") as fh:
+        fh.writelines(lines[:-1])
+    j_r = JobJournal(d)
+    assert j_r.replay().unfinished == []
+    assert j_r.result(jid)["status"] == "optimal"
+    j_r.close()
+
+
+def test_journal_finish_idempotent(tmp_path):
+    j = JobJournal(str(tmp_path / "j"))
+    s = _spec(1)
+    jid = j.admit(s, request_fingerprint(s), "t", "normal", None)
+    assert j.finish(jid, {"status": "optimal", "try": 1}, "optimal")
+    assert not j.finish(jid, {"status": "optimal", "try": 2}, "optimal")
+    assert j.result(jid)["try"] == 1
+    j.close()
+
+
+def test_journal_compaction_bounds_the_wal(tmp_path):
+    d = str(tmp_path / "j")
+    j = JobJournal(d, compact_every=40)
+    keep = None
+    for k in range(30):
+        s = _spec(k)
+        jid = j.admit(s, request_fingerprint(s), "t", "normal", None)
+        if k == 29:
+            keep = jid  # left unfinished
+        else:
+            j.finish(jid, {"status": "optimal"}, "optimal")
+    path = os.path.join(d, "journal.jsonl")
+    n_lines = sum(1 for _ in open(path))
+    # Compaction rewrote: only meta + the unfinished admit (+ maybe a
+    # handful of post-compaction records) survive, not ~90 records.
+    assert n_lines < 30
+    j_r = JobJournal(d)
+    assert [job.jid for job in j_r.replay().unfinished] == [keep]
+    j_r.close()
+    j.close()
+
+
+def test_journal_result_store_evicts_resolved_only(tmp_path):
+    j = JobJournal(str(tmp_path / "j"), results_cap=5)
+    jids = []
+    for k in range(9):
+        s = _spec(k)
+        jid = j.admit(s, request_fingerprint(s), "t", "normal", None)
+        j.finish(jid, {"status": "optimal", "k": k}, "optimal")
+        jids.append(jid)
+    # Oldest resolved results evicted; newest kept; pending untouched.
+    assert j.result(jids[0]) is None
+    assert j.result(jids[-1])["k"] == 8
+    assert j.stats()["results"] == 5
+    j.close()
+
+
+def test_journal_write_fault_counts_and_degrades(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLPS_JOURNAL_FAIL_AFTER", "2")
+    j = JobJournal(str(tmp_path / "j"))
+    s = _spec(1)
+    jid = j.admit(s, request_fingerprint(s), "t", "normal", None)  # write 2 fails
+    assert j.write_errors == 1
+    # The journal keeps serving: later writes land.
+    j.finish(jid, {"status": "optimal"}, "optimal")
+    assert j.result(jid)["status"] == "optimal"
+    j.close()
+
+
+# -- service-level recovery --------------------------------------------------
+
+
+def _svc(journal_dir, **kw):
+    return SolveService(
+        ServiceConfig(
+            batch=4, flush_s=0.02, journal_dir=str(journal_dir), **kw
+        )
+    )
+
+
+def test_service_journal_roundtrip_and_poll_rebinding(tmp_path):
+    svc = _svc(tmp_path / "j")
+    try:
+        fut = svc.submit(random_dense_lp(8, 24, seed=1), name="a")
+        jid = fut.jid
+        assert jid is not None
+        assert fut.result(timeout=120).status is Status.OPTIMAL
+        kind, rec = svc.job_result(jid)
+        assert kind == "done" and rec["status"] == "optimal"
+        assert rec["x"] is not None and len(rec["x"]) == 24
+    finally:
+        svc.shutdown()
+    # A RESTARTED service against the same dir re-binds the poll id.
+    svc2 = _svc(tmp_path / "j")
+    try:
+        kind, rec = svc2.job_result(jid)
+        assert kind == "done" and rec["status"] == "optimal"
+        assert svc2.job_result("jnope-1")[0] == "unknown"
+    finally:
+        svc2.shutdown()
+
+
+def test_service_replays_unfinished_and_times_out_dead_deadlines(tmp_path):
+    d = tmp_path / "j"
+    # Forge a crashed service's WAL: one live job, one whose wall-clock
+    # deadline died with the process.
+    j = JobJournal(str(d))
+    s_live = _spec(5, name="live")
+    jid_live = j.admit(
+        s_live, request_fingerprint(s_live), "acme", "normal", None
+    )
+    s_dead = _spec(6, name="dead")
+    jid_dead = j.admit(
+        s_dead, request_fingerprint(s_dead), "acme", "normal",
+        time.time() - 30.0,
+    )
+    j.close()
+
+    svc = _svc(d)
+    try:
+        assert svc.drain(timeout=300)
+        kind, rec = svc.job_result(jid_live)
+        assert kind == "done" and rec["status"] == "optimal"
+        kind, rec = svc.job_result(jid_dead)
+        assert kind == "done" and rec["status"] == "timeout"
+        # Honest verdict carries the journal fault attribution.
+        assert any(f["backend"] == "journal" for f in rec["faults"])
+    finally:
+        svc.shutdown()
+
+
+def test_resubmit_attaches_to_replayed_job_fingerprint_idempotent(tmp_path):
+    d = tmp_path / "j"
+    j = JobJournal(str(d))
+    s = _spec(9, name="dup")
+    jid = j.admit(s, request_fingerprint(s), "acme", "normal", None)
+    j.close()
+
+    svc = SolveService(
+        ServiceConfig(batch=4, flush_s=0.05, journal_dir=str(d)),
+        auto_start=False,  # keep the replayed job queued
+    )
+    try:
+        # The client's crash-retry of the same request: SAME problem,
+        # tol, tenant, name — attaches to the replayed job's future
+        # instead of solving twice.
+        p = LPProblem.from_dict(s["problem"])
+        fut = svc.submit(
+            p, tol=1e-8, tenant="acme", priority="normal", name="dup"
+        )
+        assert fut.jid == jid
+        # A DIFFERENT request does not dedupe.
+        fut2 = svc.submit(random_dense_lp(8, 24, seed=77), name="other")
+        assert fut2.jid != jid
+        svc.start()
+        assert fut.result(timeout=120).status is Status.OPTIMAL
+        # Exactly one finished record for the deduped jid.
+        wal = os.path.join(str(d), "journal.jsonl")
+        finishes = [
+            r for r in map(json.loads, open(wal))
+            if r.get("j") == "finished" and r.get("jid") == jid
+        ]
+        assert len(finishes) == 1
+    finally:
+        svc.shutdown()
+
+
+def test_drain_for_shutdown_sheds_and_finishes(tmp_path):
+    svc = _svc(tmp_path / "j")
+    try:
+        futs = [
+            svc.submit(random_dense_lp(8, 24, seed=k)) for k in range(6)
+        ]
+        assert not svc.draining
+        svc.begin_draining()
+        assert svc.draining
+        with pytest.raises(ServiceOverloaded) as ei:
+            svc.submit(random_dense_lp(8, 24, seed=99))
+        assert ei.value.reason == "draining"
+        assert ei.value.retry_after_s > 0
+        assert svc.drain_for_shutdown(timeout=300)
+        assert all(
+            f.result(timeout=5).status is Status.OPTIMAL for f in futs
+        )
+        assert svc.stats()["draining"] is True
+    finally:
+        svc.shutdown(drain=False)
+
+
+# -- the real thing: kill -9 a front-end mid-stream, restart, recover --------
+
+
+def test_kill9_frontend_restart_resolves_every_poll_url(tmp_path):
+    """Crash-restart acceptance: a REAL serve-http process is
+    SIGKILLed mid-stream; a restart against the same journal_dir must
+    re-bind every issued poll URL, complete (or honestly time out) the
+    re-enqueued work, and never solve a journal-replayed request
+    twice."""
+    from distributedlpsolver_tpu.net.chaos import (
+        ChaosPlane,
+        free_port,
+        journal_duplicate_solves,
+    )
+
+    plane = ChaosPlane(str(tmp_path))
+    ladder = str(tmp_path / "ladder.json")
+    with open(ladder, "w") as fh:
+        fh.write(json.dumps([{"m": 8, "n": 24, "batch": 4}]))
+    proc = plane.spawn_backend(
+        "be", port=free_port(), buckets_json=ladder,
+        extra_flags=["--flush-ms", "20", "--batch", "4"],
+    )
+    try:
+        assert plane.wait_ready(proc, 180), "backend never came up"
+
+        def post(body):
+            req = urllib.request.Request(
+                proc.url + "/v1/solve",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        ids = []
+        for k in range(12):
+            code, out = post(
+                {"m": 8, "n": 24, "seed": k, "async": True,
+                 "id": f"crash-{k}"}
+            )
+            assert code == 202
+            ids.append(out["id"])
+        # Mid-stream: no drain, no flush courtesy — SIGKILL.
+        plane.kill9("be")
+        plane.restart("be")  # same port, same journal_dir
+
+        deadline = time.monotonic() + 120
+        unresolved = set(ids)
+        statuses = {}
+        while unresolved and time.monotonic() < deadline:
+            for rid in list(unresolved):
+                try:
+                    with urllib.request.urlopen(
+                        proc.url + f"/v1/solve/{rid}", timeout=10
+                    ) as r:
+                        code, out = r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    code, out = e.code, json.loads(e.read())
+                except (urllib.error.URLError, OSError):
+                    break  # restart still settling
+                if code != 202:
+                    statuses[out.get("status")] = (
+                        statuses.get(out.get("status"), 0) + 1
+                    )
+                    unresolved.discard(rid)
+            time.sleep(0.1)
+        assert not unresolved, (
+            f"acknowledged poll URLs lost across restart: {unresolved}"
+        )
+        # Honest verdicts only, and no journal-replayed double solves.
+        assert set(statuses) <= {"optimal", "timeout"}
+        assert statuses.get("optimal", 0) >= 1
+        assert journal_duplicate_solves(proc.journal_dir) == 0
+    finally:
+        plane.shutdown_all()

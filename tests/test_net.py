@@ -881,6 +881,223 @@ def test_cli_route_requires_backend():
 
 
 # ---------------------------------------------------------------------------
+# crash-safe fabric: readyz / drain endpoint / store eviction / backoff
+
+
+def test_readyz_ready_then_flips_on_drain(backend):
+    code, out = _http(backend.url + "/readyz")
+    assert code == 200 and out["status"] == "ready"
+    backend.service.begin_draining()
+    code, out = _http(backend.url + "/readyz")
+    assert code == 503 and out["draining"] is True
+    # Liveness is a separate axis: healthz stays 200 while draining.
+    code, _ = _http(backend.url + "/healthz")
+    assert code == 200
+    # Submits shed with the structured draining verdict (503, not 429).
+    code, out = _http(
+        backend.url + "/v1/solve", {"m": 8, "n": 24, "seed": 1}
+    )
+    assert code == 503 and out["reason"] == "draining"
+
+
+def test_quitquitquit_drains_resolves_and_closes_listener():
+    reg = MetricsRegistry()
+    svc = SolveService(
+        ServiceConfig(batch=4, flush_s=0.02), metrics=reg
+    )
+    front = SolveHTTPServer(
+        svc, NetConfig(healthz_cache_s=0.02), metrics=reg
+    ).start()
+    url = front.url
+    try:
+        futs = [
+            svc.submit(random_dense_lp(8, 24, seed=k)) for k in range(6)
+        ]
+        code, out = _http(url + "/quitquitquit", {})
+        assert code == 200 and out["draining"] is True
+        # Idempotent: a second call acknowledges without a second drain.
+        code, out2 = _http(url + "/quitquitquit", {})
+        assert code in (200, 599) and (
+            code != 200 or out2.get("started") in (False, True)
+        )
+        # Every accepted request resolves (graceful, not dropped).
+        assert all(
+            f.result(timeout=120).status is Status.OPTIMAL for f in futs
+        )
+        # The listener closes only AFTER the drain.
+        deadline = time.monotonic() + 60
+        closed = False
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(url + "/healthz", timeout=2)
+            except (urllib.error.URLError, OSError):
+                closed = True
+                break
+            time.sleep(0.05)
+        assert closed, "listener never closed after the drain"
+    finally:
+        svc.shutdown(drain=False)
+        front.shutdown()
+
+
+def test_async_store_evicts_resolved_only_with_metric():
+    """The PR's small fix: under cap pressure the async store must
+    never drop an unresolved entry (that silently loses an acknowledged
+    poll URL); evictions take resolved entries and count into
+    net_store_evictions_total{state}."""
+    from concurrent.futures import Future
+
+    reg = MetricsRegistry()
+    svc = SolveService(ServiceConfig(batch=4, flush_s=0.02), metrics=reg)
+    front = SolveHTTPServer(
+        svc, NetConfig(async_results_cap=4), metrics=reg
+    )
+    try:
+        pending = [Future() for _ in range(4)]
+        rids_pending = [front._register_async(f, True) for f in pending]
+        # 4 unresolved at cap: a 5th (resolved) entry must not evict
+        # any pending future.
+        done = Future()
+        done.set_result("r")
+        rid_done = front._register_async(done, True)
+        assert all(
+            front._lookup_async(r) is not None for r in rids_pending
+        )
+        # More resolved entries: eviction now takes the RESOLVED ones.
+        done2 = Future()
+        done2.set_result("r2")
+        front._register_async(done2, True)
+        assert front._lookup_async(rid_done) is None  # oldest resolved
+        assert all(
+            front._lookup_async(r) is not None for r in rids_pending
+        )
+        snap = reg.snapshot()
+        assert (
+            snap.get('net_store_evictions_total{state="resolved"}', 0) >= 1
+        )
+        assert (
+            snap.get('net_store_evictions_total{state="unresolved"}', 0)
+            == 0
+        )
+    finally:
+        svc.shutdown(drain=False)
+        front.shutdown()
+
+
+def test_router_probe_backoff_exponential_and_resets():
+    """Ejected backends are re-probed with exponential, deterministically
+    jittered backoff capped at the config ceiling — not hammered every
+    poll tick."""
+    cfg = RouterConfig(
+        poll_s=0.05, eject_after=1,
+        probe_backoff_base_s=0.2, probe_backoff_cap_s=1.0,
+    )
+    router = Router(["http://127.0.0.1:9"], cfg, metrics=MetricsRegistry())
+    try:
+        backoffs = []
+        for _ in range(6):
+            router.poll_once()
+            st = router.statusz()["backends"][0]
+            backoffs.append(st["backoff_s"])
+            with router._lock:
+                router._backends[st["url"]].next_probe = 0.0  # force re-probe
+        assert router.statusz()["backends"][0]["ejected"]
+        grown = [b for b in backoffs if b > 0]
+        assert grown and grown == sorted(grown)  # monotone growth
+        assert max(grown) <= cfg.probe_backoff_cap_s
+        # Deterministic: the same (url, fails) sequence reproduces.
+        router2 = Router(
+            ["http://127.0.0.1:9"], cfg, metrics=MetricsRegistry()
+        )
+        for _ in range(6):
+            router2.poll_once()
+            with router2._lock:
+                router2._backends["http://127.0.0.1:9"].next_probe = 0.0
+        assert (
+            router2.statusz()["backends"][0]["backoff_s"]
+            == router.statusz()["backends"][0]["backoff_s"]
+        )
+        router2.shutdown()
+        # Backoff actually paces: with next_probe in the future the
+        # sweep skips the backend entirely.
+        with router._lock:
+            st = router._backends["http://127.0.0.1:9"]
+            st.next_probe = time.perf_counter() + 60
+            probes_before = st.probes
+        router.poll_once()
+        with router._lock:
+            assert (
+                router._backends["http://127.0.0.1:9"].probes
+                == probes_before
+            )
+    finally:
+        router.shutdown()
+
+
+def test_router_stops_routing_to_draining_backend_without_eject():
+    reg = MetricsRegistry()
+    svc = SolveService(ServiceConfig(batch=4, flush_s=0.02), metrics=reg)
+    front = SolveHTTPServer(
+        svc, NetConfig(healthz_cache_s=0.02), metrics=reg
+    ).start()
+    router = Router(
+        [front.url], RouterConfig(poll_s=0.05), metrics=MetricsRegistry()
+    ).start()
+    try:
+        assert router.healthy_count() == 1
+        svc.begin_draining()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = router.statusz()["backends"][0]
+            if not st["ready"]:
+                break
+            time.sleep(0.05)
+        st = router.statusz()["backends"][0]
+        # Not ready (out of rotation) but NOT ejected: healthy, alive.
+        assert st["ready"] is False
+        assert st["ejected"] is False and st["healthy"] is True
+        assert router.pick() is None  # nothing routable
+        code, _, url = router.forward(
+            "/v1/solve",
+            json.dumps({"m": 8, "n": 24, "seed": 1}).encode(),
+            "application/json",
+        )
+        assert code == 503 and url is None
+    finally:
+        router.shutdown()
+        front.shutdown()
+        svc.shutdown(drain=False)
+
+
+def test_replicated_routers_share_ejections_via_registry(tmp_path):
+    """An ejection observed by one router is honored by its sibling
+    through the shared registry — and a restarted router warm-loads
+    the table instead of starting blind."""
+    rpath = str(tmp_path / "registry.json")
+    reg_cfg = RouterConfig(poll_s=30.0, registry_path=rpath)
+    r1 = Router(["http://127.0.0.1:9"], reg_cfg, metrics=MetricsRegistry())
+    r2 = Router(["http://127.0.0.1:9"], reg_cfg, metrics=MetricsRegistry())
+    try:
+        # r1 observes a forward failure -> ejects + publishes.
+        r1._note_forward_failure("http://127.0.0.1:9")
+        assert r1.statusz()["backends"][0]["ejected"]
+        # r2 adopts it on its next registry pull, without probing.
+        r2._sync_registry_pull()
+        assert r2.statusz()["backends"][0]["ejected"]
+        # A restarted router (fresh process, same registry) warm-loads
+        # the ejected state instead of routing into a dead backend.
+        r3 = Router([], reg_cfg, metrics=MetricsRegistry())
+        st = r3.statusz()["backends"][0]
+        assert st["url"] == "http://127.0.0.1:9" and st["ejected"]
+        assert r3.pick() is None
+        r3.shutdown()
+        # Generation advanced and the registry surface is reported.
+        assert r1.statusz()["registry"]["generation"] >= 1
+    finally:
+        r1.shutdown()
+        r2.shutdown()
+
+
 # tier-1 smoke: the full 200-request router/2-backend probe
 
 
