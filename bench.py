@@ -1137,6 +1137,123 @@ def _bench_sparse(args) -> list:
     return rows
 
 
+def _bench_scenario(args) -> list:
+    """Stochastic scenario tier rows (``--scenario``): the SAME
+    two-stage storm instance through each engine that can hold it —
+    the scenario-decomposed IPM (batched per-scenario Schur + arrow
+    linking solve), the sparse-iterative rung on the lowered
+    block-angular form (the degradation target, bordered-Woodbury
+    preconditioner), and the dense baseline on the lowered form where
+    its assembly fits. Columns carry K, the schur/link wall split, and
+    peak operand bytes so BENCH_SCENARIO.json tracks how the
+    decomposition scales in K across rounds."""
+    from distributedlpsolver_tpu.backends import scenario as scn
+    from distributedlpsolver_tpu.backends.base import get_backend
+    from distributedlpsolver_tpu.models.problem import to_interior_form
+    from distributedlpsolver_tpu.models.scenario import (
+        scenario_k_bucket,
+        two_stage_storm,
+    )
+
+    K = 16 if args.quick else 128
+    slp = two_stage_storm(
+        K, block_m=24, block_n=36, first_stage_n=24, first_stage_m=8,
+        seed=1,
+    )
+    lowered = slp.to_block_angular()
+    m, n = lowered.A.shape
+    base = {
+        "family": "scenario",
+        "instance": slp.name,
+        "K": K,
+        "scenario_bucket": scenario_k_bucket(K),
+        "m": m,
+        "n": n,
+        "nnz": int(lowered.A.nnz),
+    }
+    rows = []
+
+    def add(row):
+        row["platform"] = args.platform
+        rows.append(row)
+        _log(json.dumps(row))
+
+    # 1. Scenario-decomposed IPM (warm-up first so the timed figure is
+    # the warm-program number every later solve in the bucket pays).
+    be = get_backend("scenario")
+    r = _solve_timed(lowered, be, tol=1e-8)
+    rep = scn.last_solve_report()
+    add(
+        dict(
+            base,
+            engine="scenario",
+            tol=1e-8,
+            status=r.status.value,
+            iters=int(r.iterations),
+            time_s=round(r.solve_time, 4),
+            setup_s=round(r.setup_time, 4),
+            schur_ms=round(float(rep.get("schur_ms", 0.0)), 3),
+            link_ms=round(float(rep.get("link_ms", 0.0)), 3),
+            cg_iters=int(rep.get("cg_iters", 0)),
+            max_operand_mb=round(be.operand_nbytes() / 1e6, 2),
+        )
+    )
+
+    # 2. Lowered block-angular form through the matrix-free inexact IPM
+    # (the degradation rung; its bordered preconditioner consumes the
+    # same two_stage pattern).
+    be_si = get_backend("sparse-iterative")
+    r = _solve_timed(lowered, be_si, tol=1e-8, max_iter=200)
+    rep_si = be_si.cg_report()
+    add(
+        dict(
+            base,
+            engine="sparse-iterative(lowered)",
+            tol=1e-8,
+            status=r.status.value,
+            iters=int(r.iterations),
+            cg_iters=int(rep_si["cg_iters"]),
+            precond=rep_si["precond"],
+            time_s=round(r.solve_time, 4),
+            setup_s=round(r.setup_time, 4),
+            max_operand_mb=round(be_si.max_operand_nbytes() / 1e6, 2),
+        )
+    )
+
+    # 3. Dense baseline on the lowered form — only while the assembly
+    # fits; past that the row records WHY it is absent.
+    if m * n <= 1 << 25:
+        low2 = slp.to_block_angular()
+        low2.block_structure = None  # keep it off the scenario route
+        r = _solve_timed(low2, "cpu-native", tol=1e-8)
+        add(
+            dict(
+                base,
+                engine="dense(cpu-native,lowered)",
+                tol=1e-8,
+                status=r.status.value,
+                iters=int(r.iterations),
+                time_s=round(r.solve_time, 4),
+                setup_s=round(r.setup_time, 4),
+                max_operand_mb=round(m * m * 8 / 1e6, 2),
+            )
+        )
+    else:
+        add(
+            dict(
+                base,
+                engine="dense(cpu-native,lowered)",
+                tol=1e-8,
+                status="skipped",
+                skip_reason=(
+                    f"dense normal-equations assembly would be "
+                    f"{m * m * 8 / 1e9:.1f} GB at m={m}"
+                ),
+            )
+        )
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (smoke)")
@@ -1151,6 +1268,12 @@ def main() -> int:
                     help="huge-sparse tier rows (sparse-iterative vs "
                     "PDHG vs dense on one storm-profile instance; "
                     "density/nnz/cg_iters columns) -> BENCH_SPARSE.json")
+    ap.add_argument("--scenario", action="store_true",
+                    help="stochastic scenario tier rows (scenario-"
+                    "decomposed IPM vs lowered block-angular vs sparse-"
+                    "iterative on one two-stage storm instance; K + "
+                    "schur/link split + peak operand bytes) -> "
+                    "BENCH_SCENARIO.json")
     ap.add_argument("--serve-http", action="store_true",
                     help="serving rows incl. the HTTP network plane: the "
                     "in-process row plus a localhost POST /v1/solve row, "
@@ -1209,6 +1332,17 @@ def main() -> int:
         backend = args.backend = "tpu"
 
     _obs_enable()
+
+    if args.scenario:
+        rows = _bench_scenario(args)
+        for r in rows:
+            r.setdefault("metrics", _obs_row(args.platform))
+        out = os.path.join(_REPO, "BENCH_SCENARIO.json")
+        with open(out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+        _log(f"scenario rows -> {out}")
+        print(json.dumps(rows[0]))  # headline: the decomposed-IPM row
+        return 0  # scenario tier is its own run; no headline solve after
 
     if args.sparse:
         rows = _bench_sparse(args)

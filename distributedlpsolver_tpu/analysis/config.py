@@ -211,6 +211,15 @@ JSONL_FIELDS = {
     "engine",
     "cg_iters",
     "precond",
+    # stochastic scenario tier: scenario-request records carry the
+    # scenario count, the padded scenario-count bucket
+    # (models/scenario.scenario_k_bucket), and the decomposition's
+    # stage split — batched per-scenario Schur wall vs first-stage
+    # linking wall (serve/records.py, backends/scenario.py)
+    "n_scenarios",
+    "scenario_bucket",
+    "schur_ms",
+    "link_ms",
     # network serving plane (net/): http_request records (method/path/
     # code/ms), admission-verdict reject records (tenant/priority/
     # reason/retry_after_s), router route records (backend/padding/

@@ -16,4 +16,5 @@ import distributedlpsolver_tpu.backends.block_angular  # noqa: F401  (registers 
 import distributedlpsolver_tpu.backends.cpu_sparse  # noqa: F401  (registers cpu-sparse)
 import distributedlpsolver_tpu.backends.first_order  # noqa: F401  (registers pdlp/first-order)
 import distributedlpsolver_tpu.backends.sparse_iterative  # noqa: F401  (registers sparse-iterative/inexact-ipm)
+import distributedlpsolver_tpu.backends.scenario  # noqa: F401  (registers scenario)
 import distributedlpsolver_tpu.backends.auto  # noqa: F401  (registers auto)

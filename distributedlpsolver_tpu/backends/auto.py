@@ -51,6 +51,14 @@ _HUGE_SPARSE_ROWS = 20_000
 # beats abandoning the pod for a single device or the CPU.
 DEGRADATION_CHAIN = ("sharded", "tpu", "sparse-iterative", "cpu-sparse", "cpu")
 
+# The scenario-decomposed engine degrades onto the rungs that solve its
+# LOWERED block-angular form without the two_stage layout contract:
+# sparse-iterative (whose bordered-Woodbury preconditioner was built for
+# exactly this pattern) → cpu-sparse → cpu. The dense accelerator rungs
+# are deliberately skipped — a storm-scale lowered form would have to be
+# densified first, the failure class the sparse tier exists to end.
+_SCENARIO_CHAIN = ("sparse-iterative", "cpu-sparse", "cpu")
+
 
 def degradation_chain(name: str) -> list:
     """Fallback backend names strictly *after* ``name`` in the degradation
@@ -63,6 +71,8 @@ def degradation_chain(name: str) -> list:
     key = (name or "").lower()
     cls = _REGISTRY.get(key)
     primary = cls.name if cls is not None else key
+    if primary == "scenario":
+        return list(_SCENARIO_CHAIN)
     if primary in DEGRADATION_CHAIN:
         i = DEGRADATION_CHAIN.index(primary)
         return list(DEGRADATION_CHAIN[i + 1:])
@@ -89,6 +99,13 @@ def choose_backend_name(
     # densifying A (or ADAᵀ) at that scale is the 10 GB arena /
     # kernel-fault class this tier exists to end.
     hint0 = inf.block_structure or {}
+    # Stochastic scenario tier: an explicit two_stage hint (the
+    # ScenarioLP lowering, or a prior detection cached by the warm
+    # layer) routes to the scenario-decomposed IPM on every platform —
+    # the decomposition is the only rung that never assembles the
+    # lowered form's normal matrix AND batches the per-scenario work.
+    if hint0.get("kind") == "two_stage":
+        return "scenario", None
     if hint0.get("kind") == "bordered":
         return "sparse-iterative", None
     if (
@@ -97,6 +114,17 @@ def choose_backend_name(
         and inf.A.nnz / max(inf.m * inf.n, 1) < 0.1
     ):
         return "sparse-iterative", None
+    # Hint-less two-stage recovery (detect mode): a lowered ScenarioLP
+    # whose hint was stripped (MPS round-trip, external producers) still
+    # routes to the scenario engine off the sparsity pattern alone.
+    # After the huge-sparse gate so storm-scale instances keep the
+    # matrix-free rung's measured behavior.
+    if detect and sp.issparse(inf.A) and not hint0:
+        from distributedlpsolver_tpu.models.structure import detect_two_stage
+
+        ts = detect_two_stage(inf.A)
+        if ts is not None:
+            return "scenario", ts
     if platform == "cpu":
         return "cpu-native", None
     # Any accelerator (tpu/gpu/...): tiny problems still go to the CPU —

@@ -78,6 +78,39 @@ from distributedlpsolver_tpu.ops import sparse as sparse_ops
 # preconditioners hold the real count to O(10).
 _CG_CAP = 2048
 
+
+def _bordered_usable(hint: dict) -> bool:
+    """Whether a block-structure hint feeds the bordered-Woodbury
+    preconditioner: an explicit ``bordered`` hint, or a ``two_stage``
+    one (models/structure.detect_two_stage, the scenario lowering)
+    whose pattern has no first-stage rows and a contiguous layout —
+    then it IS the bordered tiling (scenario row blocks × leading
+    first-stage columns) BorderedPrecond was built for."""
+    kind = hint.get("kind")
+    if kind == "bordered":
+        return True
+    if kind != "two_stage":
+        return False
+    if int(hint.get("first_stage_m", 0)) != 0:
+        return False
+    rb = hint.get("row_block")
+    if rb is not None:
+        # Detection layouts must already be contiguous-tiled: block k
+        # owns rows [k·mb, (k+1)·mb).
+        rb = np.asarray(rb)
+        mb = int(hint.get("block_m", 0))
+        K = int(hint.get("num_blocks", 0))
+        if mb * K != rb.size:
+            return False
+        want = np.repeat(np.arange(K), mb)
+        if not np.array_equal(rb, want):
+            return False
+        cb = np.asarray(hint.get("col_block"))
+        n0 = int(hint.get("first_stage_n", 0))
+        if cb is None or not np.all(cb[:n0] == -1):
+            return False
+    return True
+
 # Forcing sequence: cg_tol = clip(_FORCE_FRAC · err, cfg.cg_tol,
 # _FORCE_MAX) — loose solves while the iterate is far (err ~ 1),
 # tightening with the KKT error so the last iterations solve nearly
@@ -191,7 +224,7 @@ class SparseIterativeBackend(SolverBackend):
         hint = inf.block_structure or {}
         kind = self._precond_req
         if kind == "auto":
-            kind = "bordered" if hint.get("kind") == "bordered" else "jacobi"
+            kind = "bordered" if _bordered_usable(hint) else "jacobi"
         if kind == "bordered":
             A_csr = A if sp.issparse(A) else sp.csr_matrix(np.asarray(A))
             self._prec = pcg_ops.BorderedPrecond(A_csr, hint, dtype=dtype)

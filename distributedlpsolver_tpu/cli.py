@@ -664,6 +664,19 @@ def cmd_generate(args) -> int:
         p = gen.random_dense_lp(args.m, args.n, seed=args.seed)
     elif args.kind == "general":
         p = gen.random_general_lp(args.m, args.n, seed=args.seed)
+    elif args.kind == "scenario":
+        # Lowered two-stage stochastic LP. The hint is not representable
+        # in MPS; for sparse-stored ingests (m·n > 200k) `solve
+        # --backend auto` recovers it from the sparsity pattern
+        # (models/structure.detect_two_stage) and routes back to the
+        # scenario engine — smaller files solve on the dense path,
+        # which beats device dispatch at that size anyway.
+        from distributedlpsolver_tpu.models.scenario import two_stage_storm
+
+        p = two_stage_storm(
+            args.scenarios, block_m=args.m, block_n=args.n,
+            seed=args.seed,
+        ).to_block_angular()
     else:
         p = gen.block_angular_lp(
             args.blocks, args.m, args.n, args.link, seed=args.seed
@@ -904,12 +917,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap_b.set_defaults(fn=cmd_backends)
 
     ap_g = sub.add_parser("generate", help="write a generated problem to MPS")
-    ap_g.add_argument("kind", choices=["dense", "general", "block"])
+    ap_g.add_argument("kind", choices=["dense", "general", "block", "scenario"])
     ap_g.add_argument("out")
     ap_g.add_argument("--m", type=int, default=100)
     ap_g.add_argument("--n", type=int, default=250)
     ap_g.add_argument("--blocks", type=int, default=4)
     ap_g.add_argument("--link", type=int, default=20)
+    ap_g.add_argument("--scenarios", type=int, default=8,
+                      help="scenario count K of the two-stage instance "
+                      "(kind=scenario; --m/--n are the recourse block "
+                      "shape)")
     ap_g.add_argument("--seed", type=int, default=0)
     ap_g.set_defaults(fn=cmd_generate)
 

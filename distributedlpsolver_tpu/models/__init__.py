@@ -7,10 +7,21 @@ from distributedlpsolver_tpu.models.generators import (
     random_general_lp,
 )
 from distributedlpsolver_tpu.models.presolve import presolve
-from distributedlpsolver_tpu.models.structure import detect_block_structure
+from distributedlpsolver_tpu.models.scenario import (
+    ScenarioLP,
+    scenario_delta_stream,
+    scenario_k_bucket,
+    two_stage_storm,
+)
+from distributedlpsolver_tpu.models.structure import (
+    detect_block_structure,
+    detect_two_stage,
+)
 
 __all__ = [
     "LPProblem", "InteriorForm", "to_interior_form", "BatchedLP",
     "random_dense_lp", "random_general_lp", "random_batched_lp", "block_angular_lp",
-    "presolve", "detect_block_structure",
+    "presolve", "detect_block_structure", "detect_two_stage",
+    "ScenarioLP", "two_stage_storm", "scenario_delta_stream",
+    "scenario_k_bucket",
 ]

@@ -131,8 +131,20 @@ class LPProblem:
         else:
             d["A"] = [[float(v) for v in row] for row in np.asarray(self.A)]
         if self.block_structure:
+            # Hints carry ints (block sizes), strings ("kind") and index
+            # arrays (detection's row_block/col_block) — all must survive
+            # the journal round-trip, not just the int fields.
+            def _hint_val(v):
+                if isinstance(v, str):
+                    return v
+                if isinstance(v, np.ndarray):
+                    return [int(x) for x in v.ravel()]
+                if isinstance(v, (list, tuple)):
+                    return [int(x) for x in v]
+                return int(v)
+
             d["block_structure"] = {
-                k: int(v) for k, v in self.block_structure.items()
+                k: _hint_val(v) for k, v in self.block_structure.items()
             }
         return d
 
@@ -152,6 +164,18 @@ class LPProblem:
             )
         else:
             A = np.asarray(d["A"], dtype=np.float64).reshape(m, n)
+        hint = d.get("block_structure")
+        if hint is not None:
+            # Index arrays were listified by to_dict; the block backends
+            # consume them as numpy arrays.
+            hint = {
+                k: (
+                    np.asarray(v, dtype=np.int64)
+                    if isinstance(v, list)
+                    else v
+                )
+                for k, v in hint.items()
+            }
         return cls(
             c=_vec(d["c"]),
             A=A,
@@ -162,7 +186,7 @@ class LPProblem:
             c0=float(d.get("c0", 0.0)),
             name=str(d.get("name", "LP")),
             maximize=bool(d.get("maximize", False)),
-            block_structure=d.get("block_structure"),
+            block_structure=hint,
         )
 
     def row_activity(self, x: np.ndarray) -> np.ndarray:

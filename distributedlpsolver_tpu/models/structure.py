@@ -169,6 +169,156 @@ def detect_block_structure(
     return {"num_blocks": int(best["num_blocks"]), "row_block": best["row_block"]}
 
 
+def detect_two_stage(
+    problem: Union[LPProblem, np.ndarray, sp.spmatrix],
+    min_scenarios: int = 2,
+    max_first_frac: float = 0.25,
+    max_pad_ratio: float = 1.5,
+    max_trials: int = 8,
+) -> Optional[dict]:
+    """Recover a TWO-STAGE (bordered / dual block-angular) structure from
+    the sparsity pattern: scenario row blocks that couple only through a
+    small set of shared first-stage COLUMNS (the transpose of the
+    primal block-angular arrow :func:`detect_block_structure` finds —
+    there the border is dense linking ROWS).
+
+    Method: candidate first-stage columns are the densest columns (a
+    first-stage column carries T-entries from every scenario; a
+    recourse column only its own block's). Trials sweep a decreasing
+    column-nnz threshold; for each trial the border columns are
+    stripped, connected components of the remaining (row, column)
+    bipartite graph are the candidate scenario blocks, and rows left
+    empty by the strip (they touch only first-stage columns) are the
+    first-stage rows. A border column whose rows all sit in ONE
+    component is really scenario-local and is reassigned (the exact
+    mirror of the linking-row refinement above).
+
+    Returns the generalized ``two_stage`` hint consumed by
+    backends/auto routing, the scenario engine's layout resolution,
+    and — on first-stage-row-free patterns — the bordered-Woodbury
+    preconditioner::
+
+        {"kind": "two_stage", "num_blocks": K,
+         "row_block": (m,) int array (-1 = first-stage row),
+         "col_block": (n,) int array (-1 = first-stage column),
+         "first_stage_n": n0, "first_stage_m": m0,
+         "block_m": max rows/block, "block_n": max cols/block}
+
+    Never raises on unsuitable inputs — returns ``None`` and callers
+    fall back to the other rungs.
+    """
+    A = problem.A if isinstance(problem, LPProblem) else problem
+    if not sp.issparse(A):
+        A = np.asarray(A)
+        if A.size > _DENSE_LIMIT:
+            return None
+        A = sp.csr_matrix(A)
+    C = A.tocsc()
+    m, n = C.shape
+    if m < min_scenarios or n < 2 * min_scenarios:
+        return None
+    nnz_col = np.diff(C.indptr)
+
+    qs = np.unique(
+        np.quantile(nnz_col, [1.0, 0.99, 0.97, 0.95, 0.9, 0.85, 0.8, 0.75])
+    )[::-1]
+    best = None
+    trials = 0
+    R = C.tocsr()
+    for thr in qs:
+        if trials >= max_trials:
+            break
+        trials += 1
+        border = nnz_col >= max(thr, 1)
+        n_border = int(border.sum())
+        if n_border == 0 or n_border > 0.5 * n:
+            continue
+        block_cols = np.flatnonzero(~border)
+        Csub = C[:, block_cols]  # (m, n_block)
+        G = sp.bmat([[None, Csub], [Csub.T, None]], format="csr")
+        _, labels = sp.csgraph.connected_components(G, directed=False)
+        row_labels = labels[:m]
+        # Rows with no non-border entries are first-stage rows (their
+        # singleton components are irrelevant).
+        nonempty = np.asarray(Csub.getnnz(axis=1)).ravel() > 0
+        uniq, packed = np.unique(row_labels[nonempty], return_inverse=True)
+        row_block = np.full(m, -1, dtype=np.int64)
+        row_block[nonempty] = packed
+        K = len(uniq)
+        if K < min_scenarios:
+            continue
+        col_labels = labels[m:]
+        pos = np.searchsorted(uniq, col_labels)
+        pos_c = np.minimum(pos, max(len(uniq) - 1, 0))
+        comp_of_sub = np.where(uniq[pos_c] == col_labels, pos_c, -1)
+        col_block = np.full(n, -1, dtype=np.int64)
+        col_block[block_cols] = comp_of_sub
+        # Refinement: a border column whose rows all sit in one
+        # component is scenario-local (an over-marked dense recourse
+        # column) — reassign it; true first-stage columns span blocks.
+        for j in np.flatnonzero(border):
+            rows = C.indices[C.indptr[j] : C.indptr[j + 1]]
+            comps = np.unique(row_block[rows])
+            comps = comps[comps >= 0]
+            if len(comps) == 1:
+                col_block[j] = comps[0]
+        # Consistency: a first-stage row must touch only first-stage
+        # columns. A -1 row whose (reassigned) columns sit in exactly
+        # one block is that block's row; one spanning several blocks
+        # breaks the arrow — the trial is not two-stage.
+        consistent = True
+        for i in np.flatnonzero(row_block == -1):
+            cols = R.indices[R.indptr[i] : R.indptr[i + 1]]
+            comps = np.unique(col_block[cols])
+            comps = comps[comps >= 0]
+            if len(comps) == 1:
+                row_block[i] = comps[0]
+            elif len(comps) > 1:
+                consistent = False
+                break
+        if not consistent:
+            continue
+        # Empty columns constrain nothing and belong to no block; park
+        # them with block 0 (a zero column in any W_k is inert) so the
+        # first-stage set stays the true border — the bordered-Woodbury
+        # preconditioner keys on its leading-contiguous layout.
+        col_block[nnz_col == 0] = 0
+        n0 = int((col_block == -1).sum())
+        if n0 == 0 or n0 > max_first_frac * n:
+            continue
+        # A first-stage ROW must touch only first-stage columns; a row
+        # assigned to block k must touch only first-stage + block-k
+        # columns. Components guarantee the latter for non-border
+        # columns; verify the refined assignment stayed consistent.
+        sizes = np.bincount(row_block[row_block >= 0], minlength=K)
+        csizes = np.bincount(col_block[col_block >= 0], minlength=K)
+        if sizes.min() == 0 or csizes.min() == 0:
+            continue
+        pad = K * sizes.max() / max(sizes.sum(), 1)
+        cpad = K * csizes.max() / max(csizes.sum(), 1)
+        if pad > max_pad_ratio or cpad > max_pad_ratio:
+            continue
+        cand = {
+            "kind": "two_stage",
+            "num_blocks": int(K),
+            "row_block": row_block,
+            "col_block": col_block,
+            "first_stage_n": n0,
+            "first_stage_m": int((row_block == -1).sum()),
+            "block_m": int(sizes.max()),
+            "block_n": int(csizes.max()),
+            "_n0": n0,
+        }
+        # Prefer the trial with the smallest first-stage column set —
+        # those columns are the dense linking work every solve pays for.
+        if best is None or n0 < best["_n0"]:
+            best = cand
+    if best is None:
+        return None
+    best.pop("_n0")
+    return best
+
+
 def column_block_ids(
     A_csc: sp.csc_matrix, row_block: np.ndarray, validate: bool = False
 ) -> np.ndarray:

@@ -260,7 +260,7 @@ class AdmissionController:
 
     def admit(
         self, tenant: str, priority: str = "normal",
-        now: Optional[float] = None,
+        now: Optional[float] = None, units: int = 1,
     ) -> Verdict:
         """Decide one submit. Does NOT yet count the request as
         in-system — the service confirms with :meth:`on_admitted` after
@@ -269,18 +269,27 @@ class AdmissionController:
         asymmetry is deliberate: a submit that reached the depth wall
         still consumed the tenant's rate budget, which is what keeps a
         depth-storming tenant from turning 429s into a free retry
-        loop)."""
+        loop).
+
+        ``units`` is the request's fair-share weight: a K-scenario
+        solve charges ``ceil(K / scenario_k_unit)`` units — more than
+        one plain request (its device footprint scales with K), far
+        fewer than K requests (the Schur batch amortizes) — against
+        both the token bucket and the in-system fair share."""
         now = self._clock() if now is None else now
+        units = max(1, int(units))
         q = self.quota_for(tenant)
         with self._lock:
             st = self._state(tenant)
             self._refill(st, q, now)
-            if st.tokens < 1.0:
-                wait = (1.0 - st.tokens) / q.rate if q.rate > 0 else _INF
+            if st.tokens < units:
+                wait = (
+                    (units - st.tokens) / q.rate if q.rate > 0 else _INF
+                )
                 return self._reject(
                     st, tenant, "quota", wait,
                     f"token bucket empty (rate={q.rate:g}/s, "
-                    f"burst={q.burst:g})",
+                    f"burst={q.burst:g}, units={units})",
                 )
             # Weighted-fair share, metered only under contention. The
             # share denominator counts every CONFIGURED tenant plus any
@@ -303,29 +312,29 @@ class AdmissionController:
                 ) or 1.0
                 share = q.weight / wsum
                 cap = max(1.0, share * self.max_depth)
-                if st.in_system + 1 > cap:
+                if st.in_system + units > cap:
                     return self._reject(
                         st, tenant, "fair", self.flush_s,
-                        f"{st.in_system} in system > fair share "
-                        f"{cap:.0f} of {self.max_depth} "
+                        f"{st.in_system} in system + {units} units > "
+                        f"fair share {cap:.0f} of {self.max_depth} "
                         f"(weight {q.weight:g}/{wsum:g})",
                     )
-            st.tokens -= 1.0
+            st.tokens -= float(units)
             st.admitted += 1
         return Verdict(admitted=True, tenant=tenant)
 
-    def on_admitted(self, tenant: str) -> None:
+    def on_admitted(self, tenant: str, units: int = 1) -> None:
         with self._lock:
-            self._state(tenant).in_system += 1
+            self._state(tenant).in_system += max(1, int(units))
             self._m_in_system.set(
                 sum(t.in_system for t in self._tenants.values())
             )
 
-    def on_finished(self, tenant: str) -> None:
+    def on_finished(self, tenant: str, units: int = 1) -> None:
         with self._lock:
             st = self._tenants.get(tenant)
             if st is not None and st.in_system > 0:
-                st.in_system -= 1
+                st.in_system = max(0, st.in_system - max(1, int(units)))
             self._m_in_system.set(
                 sum(t.in_system for t in self._tenants.values())
             )

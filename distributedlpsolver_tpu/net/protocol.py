@@ -7,10 +7,13 @@ response encoding (stdlib ``json`` only).
   inline — ``{"problem": {"c": [...], "A": [[...]], "b": [...]}}``
   (standard form min cᵀx, Ax=b, x≥0), a generated instance
   ``{"m": 8, "n": 24, "seed": 3}`` (the load-test surface — the same
-  feasible+bounded generator the JSONL debug loop uses), or an MPS
-  document inline as ``{"mps_text": "..."}`` — plus the request fields
-  ``tol``, ``deadline_ms``, ``tenant``, ``priority``, ``async``,
-  ``id``; or
+  feasible+bounded generator the JSONL debug loop uses), a two-stage
+  stochastic scenario set ``{"scenarios": {...}}`` (explicit base +
+  per-scenario T/W/b/c blocks, or generated ``n_scenarios``/``seed``
+  — routed to the scenario-decomposed engine, admission charged by
+  fair-share units of K), or an MPS document inline as
+  ``{"mps_text": "..."}`` — plus the request fields ``tol``,
+  ``deadline_ms``, ``tenant``, ``priority``, ``async``, ``id``; or
 - a raw MPS text body (any other content type), with the same request
   fields taken from the query string
   (``/v1/solve?tenant=acme&deadline_ms=500``).
@@ -62,7 +65,48 @@ class SolveRequest:
     include_x: bool = True
 
 
+def _scenario_problem(sc: dict) -> LPProblem:
+    """Build the lowered two-stage problem from a ``scenarios`` payload:
+    either a generated instance (``n_scenarios``/``seed`` + optional
+    block-shape fields — the load-test surface, same seeded generator
+    the tests use) or an explicit base + per-scenario blocks
+    (``ScenarioLP.to_dict`` form). The lowered LPProblem carries the
+    ``two_stage`` hint, so the service routes it to the
+    scenario-decomposed engine and charges fair-share units by K."""
+    from distributedlpsolver_tpu.models.scenario import (
+        ScenarioLP,
+        two_stage_storm,
+    )
+
+    if not isinstance(sc, dict):
+        raise ProtocolError("'scenarios' must be an object")
+    try:
+        if "n_scenarios" in sc and "A0" not in sc:
+            slp = two_stage_storm(
+                int(sc["n_scenarios"]),
+                block_m=int(sc.get("block_m", 8)),
+                block_n=int(sc.get("block_n", 12)),
+                first_stage_n=int(sc.get("first_stage_n", 8)),
+                first_stage_m=int(sc.get("first_stage_m", 2)),
+                seed=int(sc.get("seed", 0)),
+            )
+        elif "A0" in sc:
+            slp = ScenarioLP.from_dict(sc)
+        else:
+            raise ProtocolError(
+                "'scenarios' needs generated 'n_scenarios'/'seed' or an "
+                "explicit base ('A0'/'b0'/'c0' + 'T'/'W'/'b'/'c')"
+            )
+    except ProtocolError:
+        raise
+    except (KeyError, TypeError, ValueError) as e:
+        raise ProtocolError(f"bad scenarios payload: {e}")
+    return slp.to_block_angular()
+
+
 def _problem_from_spec(spec: dict) -> LPProblem:
+    if "scenarios" in spec:
+        return _scenario_problem(spec["scenarios"])
     if "mps_text" in spec:
         from distributedlpsolver_tpu.io.mps import read_mps_string
 
@@ -96,6 +140,7 @@ def _problem_from_spec(spec: dict) -> LPProblem:
         )
     raise ProtocolError(
         "request needs one of: 'problem' (inline c/A/b), 'mps_text', "
+        "'scenarios' (base + deltas or generated n_scenarios/seed), "
         "or generated 'm'/'n'/'seed'"
     )
 
@@ -224,6 +269,13 @@ def result_payload(result, include_x: bool = True) -> Tuple[int, dict]:
         "total_ms": round(result.total_ms, 3),
         "faults": [f.asdict() for f in result.faults],
     }
+    if getattr(result, "n_scenarios", None):
+        body["n_scenarios"] = int(result.n_scenarios)
+        body["scenario_bucket"] = (
+            int(result.scenario_bucket) if result.scenario_bucket else None
+        )
+        body["schur_ms"] = round(result.schur_ms, 3)
+        body["link_ms"] = round(result.link_ms, 3)
     if include_x and result.x is not None:
         body["x"] = [float(v) for v in result.x]
     return code, body
@@ -269,6 +321,14 @@ def payload_from_record(rec: dict) -> Tuple[int, dict]:
         "faults": rec.get("faults", []),
         "recovered": True,  # served from the durable store
     }
+    if rec.get("n_scenarios"):
+        # Scenario-tier fields survive the journal round-trip: a poll
+        # served from the durable store carries the same K/bucket/stage
+        # split a live-future response would.
+        body["n_scenarios"] = int(rec["n_scenarios"])
+        body["scenario_bucket"] = rec.get("scenario_bucket")
+        body["schur_ms"] = rec.get("schur_ms", 0.0)
+        body["link_ms"] = rec.get("link_ms", 0.0)
     if rec.get("x") is not None:
         body["x"] = [float(v) for v in rec["x"]]
     return code, body

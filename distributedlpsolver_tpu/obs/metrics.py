@@ -50,6 +50,12 @@ ITER_BUCKETS = (
     1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
     96.0, 128.0, 200.0,
 )
+# Scenario counts per scenario-tier request (the pow2 bucket ladder of
+# models/scenario.scenario_k_bucket, extended to pod-scale K).
+SCENARIO_K_BUCKETS = (
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+    1024.0, 4096.0, 16384.0,
+)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

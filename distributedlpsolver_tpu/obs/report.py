@@ -212,6 +212,36 @@ def build_report(
         "overhead_s_total": round(sum(overheads), 6),
     }
 
+    # -- scenario tier (stochastic two-stage requests) -------------------
+    scen_rs = [r for r in requests if r.get("n_scenarios")]
+    scen_buckets: Dict[str, dict] = {}
+    for r in scen_rs:
+        key = str(int(r.get("scenario_bucket") or 0))
+        row = scen_buckets.setdefault(
+            key, {"count": 0, "k_max": 0, "total_ms": [], "schur_ms": [],
+                  "link_ms": []}
+        )
+        row["count"] += 1
+        row["k_max"] = max(row["k_max"], int(r.get("n_scenarios", 0)))
+        row["total_ms"].append(float(r.get("total_ms", 0.0)))
+        row["schur_ms"].append(float(r.get("schur_ms", 0.0)))
+        row["link_ms"].append(float(r.get("link_ms", 0.0)))
+    report["scenario"] = {
+        "solves": len(scen_rs),
+        "by_bucket": {
+            key: {
+                "count": row["count"],
+                "k_max": row["k_max"],
+                "total_ms": summarize(row["total_ms"], quantiles=(50, 99)),
+                "schur_ms": summarize(row["schur_ms"], quantiles=(50,)),
+                "link_ms": summarize(row["link_ms"], quantiles=(50,)),
+            }
+            for key, row in sorted(
+                scen_buckets.items(), key=lambda kv: int(kv[0])
+            )
+        },
+    }
+
     # -- durability (crash-safe serving fabric) --------------------------
     replays = events.get("journal_replay", [])
     drains = events.get("drain", [])
@@ -344,6 +374,24 @@ def render(report: dict) -> str:
                 f"  {key:<16} {row['requests']:>8} {row['dispatches']:>10} "
                 f"{row['waste_mean']:>10.4f} {row['waste']['p95']:>10.4f} "
                 f"{row['total_ms']['p50']:>11.3f}"
+            )
+
+    scen = report.get("scenario") or {}
+    if scen.get("solves"):
+        out.append("")
+        out.append(f"scenario tier: {scen['solves']} solves")
+        out.append(
+            f"  {'k_bucket':<10} {'count':>6} {'k_max':>6} "
+            f"{'total_p50':>10} {'total_p99':>10} {'schur_p50':>10} "
+            f"{'link_p50':>10}"
+        )
+        for key, row in scen["by_bucket"].items():
+            out.append(
+                f"  {key:<10} {row['count']:>6} {row['k_max']:>6} "
+                f"{row['total_ms']['p50']:>10.3f} "
+                f"{row['total_ms']['p99']:>10.3f} "
+                f"{row['schur_ms']['p50']:>10.3f} "
+                f"{row['link_ms']['p50']:>10.3f}"
             )
 
     disp = report["dispatches"]

@@ -64,14 +64,22 @@ class RequestResult:
     # (and the starvation probe) split on.
     tenant: str = "default"
     priority: str = "normal"
-    # Solve engine of the tolerance-tiered ladder ("ipm" | "pdhg") —
-    # which compiled program family served this request.
+    # Solve engine of the tolerance-tiered ladder ("ipm" | "pdhg" |
+    # "scenario") — which compiled program family served this request.
     engine: str = "ipm"
+    # Stochastic scenario tier (None/0 for plain requests): scenario
+    # count, padded scenario-count bucket, and the decomposition's
+    # per-stage wall split — batched per-scenario Schur programs
+    # (schur_ms) vs the first-stage linking factor/solve (link_ms).
+    n_scenarios: Optional[int] = None
+    scenario_bucket: Optional[int] = None
+    schur_ms: float = 0.0
+    link_ms: float = 0.0
 
     def record(self) -> dict:
         """The JSONL record for this request (x is elided — solutions go
         back through the future, not the telemetry stream)."""
-        return {
+        rec = {
             "event": "request",
             "id": self.request_id,
             "name": self.name,
@@ -100,6 +108,20 @@ class RequestResult:
             "engine": self.engine,
             "faults": [f.asdict() for f in self.faults],
         }
+        if self.n_scenarios:
+            # Scenario requests only — plain request records stay
+            # byte-identical to the pre-scenario schema.
+            rec.update(
+                n_scenarios=int(self.n_scenarios),
+                scenario_bucket=(
+                    int(self.scenario_bucket)
+                    if self.scenario_bucket
+                    else None
+                ),
+                schur_ms=round(self.schur_ms, 3),
+                link_ms=round(self.link_ms, 3),
+            )
+        return rec
 
 
 def latency_summary(results: List[RequestResult]) -> dict:

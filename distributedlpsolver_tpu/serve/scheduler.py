@@ -100,6 +100,14 @@ class PendingRequest:
     # fingerprint (the crash-retry idempotency key). None = no journal.
     jid: Optional[str] = None
     jfp: Optional[str] = None
+    # Stochastic scenario tier: fair-share units this request charges
+    # against admission (ceil(K / scenario_k_unit) for a K-scenario
+    # solve, 1 otherwise), the scenario count, and the padded
+    # scenario-count bucket (models/scenario.scenario_k_bucket) — the
+    # scheduler's scenario queue dimension and the records' K-bucket.
+    units: int = 1
+    n_scenarios: Optional[int] = None
+    scenario_bucket: Optional[int] = None
 
     @property
     def m(self) -> int:
@@ -171,7 +179,15 @@ class Scheduler:
                 tenant=p.tenant,
             )
         if p.A is None:  # general form: solo pseudo-bucket (batch of 1)
-            key = (BucketSpec(p.m, p.n, 1), p.tol, "ipm")
+            # Scenario requests get a scenario-bucket queue dimension:
+            # same padded-K jobs queue (and compile) together, and the
+            # occupancy surface shows the K-bucket mix.
+            eng = (
+                f"scenario:k{p.scenario_bucket}"
+                if p.engine == "scenario"
+                else "ipm"
+            )
+            key = (BucketSpec(p.m, p.n, 1), p.tol, eng)
         else:
             key = (self.table.spec_for(p.m, p.n), p.tol, p.engine)
         self._queues.setdefault(key, deque()).append(p)

@@ -193,6 +193,12 @@ class ServiceConfig:
     # unfinished entries) and the on-disk result-store bound.
     journal_compact_every: int = 4096
     journal_results_cap: int = 4096
+    # Stochastic scenario tier: scenarios per admission fair-share
+    # unit. A K-scenario request charges ceil(K / scenario_k_unit)
+    # units against its tenant's token bucket and fair share — more
+    # than one plain request, far fewer than K (the batched Schur
+    # decomposition amortizes the per-scenario work).
+    scenario_k_unit: int = 16
 
 
 def standard_form(problem: LPProblem):
@@ -330,6 +336,22 @@ class SolveService:
         self._m_phase_iters: dict = {}  # engine -> counter (created lazily)
         # Tolerance-tiered ladder: dispatches by solve engine (ipm/pdhg).
         self._m_engine_dispatches: dict = {}  # engine -> counter (lazy)
+        # Stochastic scenario tier: solves by terminal engine (the
+        # degradation ladder may finish one on sparse-iterative), the
+        # K distribution, and the decomposition's stage split.
+        self._m_scenario_solves: dict = {}  # engine -> counter (lazy)
+        self._m_scenario_k = m.histogram(
+            "scenario_k", buckets=obs_metrics.SCENARIO_K_BUCKETS,
+            help="scenario count per scenario-tier request",
+        )
+        self._m_scenario_schur_ms = m.histogram(
+            "scenario_schur_ms",
+            help="batched per-scenario Schur program wall per solve",
+        )
+        self._m_scenario_link_ms = m.histogram(
+            "scenario_link_ms",
+            help="first-stage linking factor/solve wall per solve",
+        )
         self._m_phase_switches = m.counter(
             "serve_phase_switches_total",
             help="precision-phase transitions across bucket dispatches",
@@ -790,17 +812,41 @@ class SolveService:
         if deadline is None:
             deadline = self.config.default_deadline_s
         req_tol = tol if tol is not None else self.solver_config.tol
-        # Tolerance-tiered engine routing: loose standard-form requests
-        # ride the matrix-free PDHG engine, tight ones the IPM buckets.
-        engine = (
-            "pdhg"
-            if (
-                self.config.pdhg_routing
-                and sf is not None
-                and req_tol >= self.config.pdhg_tol
+        # Stochastic scenario tier: a lowered two-stage problem (the
+        # ScenarioLP lowering attaches the hint; sparse A keeps it off
+        # the bucketed path) routes to the scenario-decomposed engine
+        # and charges admission by its fair-share units.
+        hint = problem.block_structure or {}
+        n_scen = scen_bucket = None
+        units = 1
+        if hint.get("kind") == "two_stage":
+            from distributedlpsolver_tpu.models.scenario import (
+                scenario_k_bucket,
             )
-            else "ipm"
-        )
+
+            n_scen = int(hint.get("num_blocks", 1))
+            scen_bucket = scenario_k_bucket(n_scen)
+            units = max(
+                1, -(-n_scen // max(1, self.config.scenario_k_unit))
+            )
+            engine = "scenario"
+            # Always the solo route: a dense-stored lowered form would
+            # otherwise pass the standard_form gate and ride a bucket
+            # program mislabeled as scenario.
+            sf = None
+        else:
+            # Tolerance-tiered engine routing: loose standard-form
+            # requests ride the matrix-free PDHG engine, tight ones the
+            # IPM buckets.
+            engine = (
+                "pdhg"
+                if (
+                    self.config.pdhg_routing
+                    and sf is not None
+                    and req_tol >= self.config.pdhg_tol
+                )
+                else "ipm"
+            )
         # Durable journal: serialize the request OUTSIDE the lock (the
         # spec encode is the expensive part), write-ahead log it inside.
         job_spec = jfp = None
@@ -834,6 +880,9 @@ class SolveService:
             engine=engine,
             jid=_replay_job.jid if _replay_job is not None else None,
             jfp=_replay_job.fp if _replay_job is not None else jfp,
+            units=units,
+            n_scenarios=n_scen,
+            scenario_bucket=scen_bucket,
         )
         with self._wake:
             if self._stopping:
@@ -858,7 +907,7 @@ class SolveService:
             p.request_id = self._next_id
             self._next_id += 1
             if self._admission is not None and _replay_job is None:
-                v = self._admission.admit(tenant, priority, now)
+                v = self._admission.admit(tenant, priority, now, units=units)
                 if not v.admitted:
                     self._log_reject(p, v.reason, v.retry_after_s)
                     raise ServiceOverloaded(
@@ -874,7 +923,7 @@ class SolveService:
                 self._log_reject(p, e.reason, e.retry_after_s)
                 raise
             if self._admission is not None:
-                self._admission.on_admitted(tenant)
+                self._admission.on_admitted(tenant, units=units)
             if self._journal is not None:
                 if _replay_job is not None:
                     self._journal.readmit(_replay_job)
@@ -1607,6 +1656,13 @@ class SolveService:
                 lb=np.zeros(n), ub=np.full(n, _INF), name=p.name,
             )
         cfg = self.solver_config.replace(tol=p.tol)
+        # Scenario-tier requests pin the scenario-decomposed engine (the
+        # supervisor's ladder degrades it onto sparse-iterative /
+        # cpu-sparse on the same lowered form); everything else takes
+        # the configured solo backend.
+        backend_name = (
+            "scenario" if p.engine == "scenario" else self.config.solo_backend
+        )
         self._m_solo.inc()
         self.tracer.async_begin(
             "solo", p.request_id, args={"retried": retried}
@@ -1616,14 +1672,14 @@ class SolveService:
             if self.config.solo_recovery:
                 r = supervised_solve(
                     problem,
-                    backend=self.config.solo_backend,
+                    backend=backend_name,
                     config=cfg,
                     supervisor=SupervisorConfig(backoff_base=0.01),
                     warm_cache=self._warm_cache,
                 )
             else:
                 r = solve(
-                    problem, backend=self.config.solo_backend, config=cfg,
+                    problem, backend=backend_name, config=cfg,
                     warm_cache=self._warm_cache,
                 )
             status, faults = r.status, faults + list(r.faults)
@@ -1635,12 +1691,40 @@ class SolveService:
             r, status = None, Status.FAILED
             faults = faults + [
                 FaultRecord(
-                    FaultKind.CRASH, -1, self.config.solo_backend,
+                    FaultKind.CRASH, -1, backend_name,
                     f"{type(e).__name__}: {e}", action="give_up",
                 )
             ]
         done = time.perf_counter()
         self.tracer.async_end("solo", p.request_id)
+        schur_ms = link_ms = 0.0
+        if p.engine == "scenario":
+            # Per-solve decomposition telemetry: the solo path runs
+            # solves sequentially on this thread, so the module's
+            # last-solve report is this request's (a degraded solve
+            # that never entered the scenario backend reports zeros).
+            from distributedlpsolver_tpu.backends.scenario import (
+                last_solve_report,
+            )
+
+            rep = last_solve_report()
+            if rep.get("n_scenarios") == p.n_scenarios:
+                schur_ms = float(rep.get("schur_ms", 0.0))
+                link_ms = float(rep.get("link_ms", 0.0))
+            term_engine = (r.backend if r is not None else backend_name) or "?"
+            ctr = self._m_scenario_solves.get(term_engine)
+            if ctr is None:
+                ctr = self.metrics.counter(
+                    "scenario_solves_total",
+                    labels={"engine": term_engine},
+                    help="scenario-tier solves by terminal engine "
+                    "(degradations land on their actual rung)",
+                )
+                self._m_scenario_solves[term_engine] = ctr
+            ctr.inc()
+            self._m_scenario_k.observe(p.n_scenarios or 0)
+            self._m_scenario_schur_ms.observe(schur_ms)
+            self._m_scenario_link_ms.observe(link_ms)
         self._finish(
             p,
             RequestResult(
@@ -1666,6 +1750,11 @@ class SolveService:
                 m=p.m,
                 n=p.n,
                 warm=r.warm if r is not None else "cold",
+                engine=p.engine,
+                n_scenarios=p.n_scenarios,
+                scenario_bucket=p.scenario_bucket,
+                schur_ms=schur_ms,
+                link_ms=link_ms,
             ),
         )
 
@@ -1726,7 +1815,7 @@ class SolveService:
             result, tenant=p.tenant, priority=p.priority
         )
         if self._admission is not None:
-            self._admission.on_finished(p.tenant)
+            self._admission.on_finished(p.tenant, units=p.units)
         if self._journal is not None and p.jid is not None:
             # Persist the verdict BEFORE resolving the future: a crash
             # after set_result but before the WAL write would replay
@@ -2038,6 +2127,37 @@ class SolveService:
                     else round(self._last_idle_timeout * 1e3, 3)
                 ),
             }
+        # Scenario-tier aggregate: per-K-bucket latency percentiles —
+        # the table `cli report` reconciles against (same source
+        # records, same percentile implementation).
+        from distributedlpsolver_tpu.obs.stats import percentile as _pct
+
+        scen_rs = [r for r in results if r.n_scenarios]
+        by_bucket: dict = {}
+        for r in scen_rs:
+            by_bucket.setdefault(r.scenario_bucket or 0, []).append(r)
+        scenario = {
+            "solves": len(scen_rs),
+            "by_bucket": {
+                str(b): {
+                    "count": len(rs),
+                    "k_max": max(r.n_scenarios for r in rs),
+                    "total_ms_p50": round(
+                        _pct([r.total_ms for r in rs], 50), 3
+                    ),
+                    "total_ms_p99": round(
+                        _pct([r.total_ms for r in rs], 99), 3
+                    ),
+                    "schur_ms_p50": round(
+                        _pct([r.schur_ms for r in rs], 50), 3
+                    ),
+                    "link_ms_p50": round(
+                        _pct([r.link_ms for r in rs], 50), 3
+                    ),
+                }
+                for b, rs in sorted(by_bucket.items())
+            },
+        }
         return {
             **latency_summary(results),
             "queue_depth": depth,
@@ -2056,6 +2176,7 @@ class SolveService:
             "fused_iters": self.solver_config.fused_iters_resolved(platform),
             "phase_iters": phase_iters,
             "engine_dispatches": engine_dispatches,
+            "scenario": scenario,
             "idle": idle,
             "buckets": buckets,
             # Per-tenant admission accounting (None without the SLO

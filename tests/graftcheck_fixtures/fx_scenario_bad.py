@@ -1,0 +1,33 @@
+# Seeds: jit-nonhoisted x1 + dtype-explicit x2 + jsonl-fields x1 —
+# scenario-engine idioms written wrong. Checked with
+# pkg_path="backends/scenario_fx.py": the per-call jit around the Schur
+# batch re-traces every factorize (the exact warm-recompile class the
+# K-bucket ladder exists to prevent), the stacked-lane pad buffers must
+# pin their dtype, and a scenario record field outside the catalogued
+# schema (analysis/config.JSONL_FIELDS) is invisible to `cli report`.
+import jax
+import jax.numpy as jnp
+
+
+def schur_chunk(W, dK):
+    # a fresh jit per factorize call -> jit-nonhoisted
+    return jax.jit(lambda w, d: jnp.einsum("kmn,kn,kpn->kmp", w, d, w))(
+        W, dK
+    )
+
+
+def pad_lanes(k_pad, mb, nb):
+    W = jnp.zeros((k_pad, mb, nb))  # dtype-explicit
+    rowmask = jnp.ones((k_pad, mb))  # dtype-explicit
+    return W, rowmask
+
+
+def scenario_record(logger, n_scenarios, schur_ms):
+    logger.event(
+        {
+            "event": "request",
+            "n_scenarios": n_scenarios,
+            "schur_ms": schur_ms,
+            "scenario_lanes_used": 4,  # jsonl-fields: not catalogued
+        }
+    )

@@ -140,6 +140,21 @@ class TestRuleFamilies:
         assert rules == ["jsonl-fields", "jsonl-stamp"]
         assert sum(f.rule == "jsonl-fields" for f in findings) == 2
 
+    def test_scenario_catches_seeded(self):
+        # Stochastic scenario tier: a per-call jit around the Schur
+        # batch, unpinned pad-lane buffers, an uncatalogued record field.
+        rules, findings = _rules_hit(
+            "fx_scenario_bad.py", "backends/scenario_fx.py"
+        )
+        assert rules == ["dtype-explicit", "jit-nonhoisted", "jsonl-fields"]
+        assert sum(f.rule == "dtype-explicit" for f in findings) == 2
+
+    def test_scenario_clean_twin_silent(self):
+        rules, _ = _rules_hit(
+            "fx_scenario_clean.py", "backends/scenario_fx.py"
+        )
+        assert rules == []
+
     def test_journal_schema_clean_twin_silent(self):
         # journal_replay / drain / registry_write with catalogued
         # fields + a stamped WAL write: silent.

@@ -1,0 +1,669 @@
+"""Stochastic scenario tier tests (ISSUE 12): ScenarioLP model layer,
+the scenario-decomposed two-stage IPM vs the lowered oracle, two_stage
+structure detection/routing, and the scenario serve semantics —
+fair-share unit admission, delta-wave warm-cache amortization, journal
+round-trip, and the K-mixed zero-warm-recompile acceptance run."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from distributedlpsolver_tpu.ipm.driver import solve as ipm_solve
+from distributedlpsolver_tpu.models.problem import LPProblem, to_interior_form
+from distributedlpsolver_tpu.models.scenario import (
+    ScenarioLP,
+    scenario_delta_stream,
+    scenario_k_bucket,
+    two_stage_storm,
+)
+
+from tests.oracle import highs_on_general
+
+pytestmark = pytest.mark.scenario
+
+
+def _small_storm(K, seed=0):
+    return two_stage_storm(
+        K, block_m=6, block_n=10, first_stage_n=6, first_stage_m=2,
+        seed=seed,
+    )
+
+
+# -- model layer -------------------------------------------------------------
+
+
+class TestScenarioModel:
+    def test_strict_json_roundtrip(self):
+        slp = _small_storm(5, seed=3)
+        d = slp.to_dict()
+        text = json.dumps(d, allow_nan=False)  # strict JSON: no inf/nan
+        back = ScenarioLP.from_dict(json.loads(text))
+        for f in ("A0", "b0", "c0", "T", "W", "b", "c", "probs"):
+            np.testing.assert_array_equal(getattr(slp, f), getattr(back, f))
+        # Lowered forms agree exactly.
+        p1, p2 = slp.to_block_angular(), back.to_block_angular()
+        assert (p1.A != p2.A).nnz == 0
+        np.testing.assert_array_equal(p1.c, p2.c)
+        np.testing.assert_array_equal(p1.rlb, p2.rlb)
+
+    def test_lowering_shape_and_hint(self):
+        slp = _small_storm(4, seed=1)
+        p = slp.to_block_angular()
+        assert sp.issparse(p.A)  # sparse keeps it off the bucketed path
+        assert p.m == 2 + 4 * 6 and p.n == 6 + 4 * 10
+        h = p.block_structure
+        assert h["kind"] == "two_stage" and h["num_blocks"] == 4
+        assert h["first_stage_n"] == 6 and h["first_stage_m"] == 2
+
+    def test_lowered_problem_dict_roundtrip_keeps_hint(self):
+        # The PR 11 journal serializes requests via LPProblem.to_dict —
+        # a scenario job's hint (string kind + int sizes) must survive.
+        p = _small_storm(3, seed=2).to_block_angular()
+        d = p.to_dict()
+        json.dumps(d, allow_nan=False)
+        q = LPProblem.from_dict(d)
+        assert q.block_structure["kind"] == "two_stage"
+        assert int(q.block_structure["num_blocks"]) == 3
+        assert (p.A != q.A).nnz == 0
+
+    def test_detection_hint_arrays_survive_dict_roundtrip(self):
+        from distributedlpsolver_tpu.models.structure import detect_two_stage
+
+        p = _small_storm(4, seed=5).to_block_angular()
+        hint = detect_two_stage(p.A)
+        assert hint is not None
+        p.block_structure = hint
+        q = LPProblem.from_dict(p.to_dict())
+        np.testing.assert_array_equal(
+            np.asarray(q.block_structure["row_block"]), hint["row_block"]
+        )
+
+    def test_k_bucket_ladder(self):
+        assert [scenario_k_bucket(k) for k in (1, 2, 3, 4, 5, 8, 9, 33)] == [
+            1, 2, 4, 4, 8, 8, 16, 64,
+        ]
+        with pytest.raises(ValueError):
+            scenario_k_bucket(0)
+
+    def test_delta_stream_shares_structure(self):
+        from distributedlpsolver_tpu.utils.fingerprint import (
+            structural_fingerprint,
+        )
+
+        waves = list(scenario_delta_stream(3, num_scenarios=4, seed=7))
+        lows = [s.to_block_angular() for s in waves]
+        fps = {
+            structural_fingerprint(p.A, p.m, p.n, p.lb, p.ub) for p in lows
+        }
+        assert len(fps) == 1  # b/c-only deltas: one structural key
+        # ... but the instances really differ.
+        assert not np.array_equal(lows[0].c, lows[1].c)
+        # offset= continues the same stream deterministically.
+        again = list(
+            scenario_delta_stream(1, num_scenarios=4, seed=7, offset=2)
+        )[0]
+        np.testing.assert_array_equal(again.b, waves[2].b)
+
+
+# -- decomposed engine vs oracle ---------------------------------------------
+
+
+class TestScenarioEngine:
+    @pytest.mark.parametrize("K", [1, 4, 32])
+    def test_matches_lowered_oracle_1e8(self, K):
+        from distributedlpsolver_tpu.backends.scenario import solve_scenario
+
+        slp = _small_storm(K, seed=K + 10)
+        r = solve_scenario(slp, tol=1e-8)
+        assert r.status.value == "optimal"
+        lowered = slp.to_block_angular()
+        lowered.block_structure = None  # dense path oracle
+        rd = ipm_solve(lowered, backend="cpu", tol=1e-8)
+        assert rd.status.value == "optimal"
+        assert abs(r.objective - rd.objective) <= 1e-8 * (
+            1.0 + abs(rd.objective)
+        )
+        hg = highs_on_general(slp.to_block_angular())
+        assert hg.status == 0
+        assert abs(r.objective - hg.fun) <= 1e-6 * (1.0 + abs(hg.fun))
+        # The solution satisfies the original constraints.
+        assert slp.to_block_angular().max_violation(r.x) < 1e-6
+
+    def test_decomposed_solve_matches_dense_M(self):
+        """factorize/solve unit check: the two-level Schur elimination +
+        preconditioned CG reproduces a dense M⁻¹r at 1e-10."""
+        from distributedlpsolver_tpu.backends.scenario import ScenarioBackend
+        from distributedlpsolver_tpu.ipm.config import SolverConfig
+
+        slp = _small_storm(8, seed=21)
+        inf = to_interior_form(slp.to_block_angular())
+        be = ScenarioBackend()
+        be.setup(inf, SolverConfig(scale=False))
+        A = np.asarray(inf.A.todense())
+        rng = np.random.default_rng(0)
+        d = 10.0 ** rng.uniform(-3, 3, size=inf.n)
+        M = (A * d[None, :]) @ A.T
+        r = rng.standard_normal(inf.m)
+        got = be._solve(be._factorize(d, 1e-12), r)
+        ref = np.linalg.solve(M, r)
+        assert np.linalg.norm(got - ref) <= 1e-10 * np.linalg.norm(ref)
+
+    def test_chunked_k_bitwise_stability(self, monkeypatch):
+        """Chunked lane processing (K_pad > SCENARIO_CHUNK) is
+        deterministic: repeated solves of the same instance through the
+        chunked path produce bitwise-identical iterates/solutions."""
+        from distributedlpsolver_tpu.backends import scenario as scn
+
+        monkeypatch.setattr(scn, "SCENARIO_CHUNK", 4)
+        slp = _small_storm(16, seed=33)
+        r1 = scn.solve_scenario(slp, tol=1e-8)
+        r2 = scn.solve_scenario(slp, tol=1e-8)
+        assert r1.status.value == "optimal"
+        assert r1.iterations == r2.iterations
+        np.testing.assert_array_equal(r1.x, r2.x)
+        rep = scn.last_solve_report()
+        assert rep["chunks"] == 4  # 16 lanes / 4 per chunk
+
+    def test_chunked_matches_unchunked(self, monkeypatch):
+        from distributedlpsolver_tpu.backends import scenario as scn
+
+        slp = _small_storm(8, seed=34)
+        r_full = scn.solve_scenario(slp, tol=1e-8)
+        monkeypatch.setattr(scn, "SCENARIO_CHUNK", 2)
+        r_chunk = scn.solve_scenario(slp, tol=1e-8)
+        assert r_chunk.status.value == "optimal"
+        assert abs(r_full.objective - r_chunk.objective) <= 1e-8 * (
+            1.0 + abs(r_full.objective)
+        )
+
+    def test_zero_recompile_within_k_bucket(self):
+        from distributedlpsolver_tpu.backends.scenario import (
+            scenario_program_cache_size,
+            solve_scenario,
+        )
+
+        # Warm the bucket (K_pad = 8) once...
+        r = solve_scenario(_small_storm(8, seed=40), tol=1e-8)
+        assert r.status.value == "optimal"
+        size0 = scenario_program_cache_size()
+        # ...then every K in the bucket reuses the same executables.
+        for K in (5, 6, 7, 8):
+            r = solve_scenario(_small_storm(K, seed=40 + K), tol=1e-8)
+            assert r.status.value == "optimal"
+        assert scenario_program_cache_size() == size0
+
+    def test_mesh_sharded_lane_axis_matches_unsharded(self):
+        from distributedlpsolver_tpu.backends.scenario import ScenarioBackend
+        from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+        slp = _small_storm(8, seed=50)
+        lowered = slp.to_block_angular()
+        r0 = ipm_solve(lowered, backend="scenario", tol=1e-8)
+        import jax
+
+        mesh = mesh_lib.make_mesh(
+            (2,), axis_names=("batch",), devices=jax.devices()[:2]
+        )
+        r1 = ipm_solve(
+            slp.to_block_angular(), backend=ScenarioBackend(mesh=mesh),
+            tol=1e-8,
+        )
+        assert r1.status.value == "optimal"
+        assert abs(r0.objective - r1.objective) <= 1e-8 * (
+            1.0 + abs(r0.objective)
+        )
+
+    def test_operand_footprint_beats_dense(self):
+        from distributedlpsolver_tpu.backends.scenario import ScenarioBackend
+        from distributedlpsolver_tpu.ipm.config import SolverConfig
+
+        slp = _small_storm(32, seed=60)
+        inf = to_interior_form(slp.to_block_angular())
+        be = ScenarioBackend()
+        be.setup(inf, SolverConfig())
+        # The decomposition's stacked operands stay far under the m×m
+        # normal matrix the dense path would assemble.
+        assert be.operand_nbytes() < inf.m * inf.m * 8
+
+    def test_non_arrow_pattern_fails_setup(self):
+        from distributedlpsolver_tpu.backends.scenario import ScenarioBackend
+        from distributedlpsolver_tpu.ipm.config import SolverConfig
+        from distributedlpsolver_tpu.models.generators import random_sparse_lp
+
+        p = random_sparse_lp(24, 48, density=0.2, seed=1)
+        p.block_structure = {
+            "kind": "two_stage", "num_blocks": 4, "block_m": 6,
+            "block_n": 11, "first_stage_n": 4, "first_stage_m": 0,
+        }
+        inf = to_interior_form(p)
+        be = ScenarioBackend()
+        with pytest.raises(ValueError, match="arrow|two_stage"):
+            be.setup(inf, SolverConfig())
+
+
+# -- detection / routing / degradation ---------------------------------------
+
+
+class TestRoutingAndDegradation:
+    def test_detection_regression_auto_routes_hintless(self):
+        """Satellite: a lowered ScenarioLP whose hint was stripped still
+        auto-routes to the scenario engine off the pattern alone."""
+        from distributedlpsolver_tpu.backends.auto import choose_backend_name
+
+        slp = _small_storm(8, seed=70)
+        lowered = slp.to_block_angular()
+        lowered.block_structure = None
+        inf = to_interior_form(lowered)
+        for platform in ("cpu", "tpu"):
+            name, hint = choose_backend_name(inf, platform, detect=True)
+            assert name == "scenario"
+            assert hint["kind"] == "two_stage"
+            assert hint["num_blocks"] == 8
+        r = ipm_solve(lowered, backend="auto", tol=1e-8)
+        assert r.status.value == "optimal"
+        assert r.backend == "auto(scenario)"
+
+    def test_detection_no_false_positives(self):
+        from distributedlpsolver_tpu.models.generators import (
+            block_angular_lp,
+            random_sparse_lp,
+        )
+        from distributedlpsolver_tpu.models.structure import detect_two_stage
+
+        assert detect_two_stage(
+            random_sparse_lp(300, 600, density=0.01, seed=0).A
+        ) is None
+        # Primal block-angular (dense linking ROWS) is the other arrow.
+        assert detect_two_stage(
+            block_angular_lp(8, 16, 24, 8, seed=0, sparse=True).A
+        ) is None
+
+    def test_detection_feeds_bordered_precond(self):
+        """Satellite: a two_stage detection on a first-stage-row-free
+        storm pattern is consumed by the bordered-Woodbury
+        preconditioner of the sparse-iterative rung."""
+        from distributedlpsolver_tpu.backends.base import get_backend
+        from distributedlpsolver_tpu.backends.sparse_iterative import (
+            _bordered_usable,
+        )
+        from distributedlpsolver_tpu.models.generators import storm_sparse_lp
+        from distributedlpsolver_tpu.models.structure import detect_two_stage
+
+        p = storm_sparse_lp(16, 32, 48, 24, seed=3)
+        hint = detect_two_stage(p.A)
+        assert hint is not None and hint["kind"] == "two_stage"
+        assert hint["first_stage_m"] == 0
+        assert _bordered_usable(hint)
+        p.block_structure = hint
+        inf = to_interior_form(p)
+        be = get_backend("sparse-iterative")
+        from distributedlpsolver_tpu.ipm.config import SolverConfig
+
+        be.setup(inf, SolverConfig())
+        assert be.precond == "bordered"
+
+    def test_degradation_chain_scenario(self):
+        from distributedlpsolver_tpu.backends.auto import degradation_chain
+
+        assert degradation_chain("scenario") == [
+            "sparse-iterative", "cpu-sparse", "cpu",
+        ]
+
+    def test_supervised_degrades_on_broken_layout(self):
+        """A two_stage hint that lies about the pattern fails scenario
+        setup and the supervisor finishes the solve on a lower rung —
+        never a crash, never a wrong answer."""
+        from distributedlpsolver_tpu.models.generators import random_sparse_lp
+        from distributedlpsolver_tpu.supervisor import supervised_solve
+
+        p = random_sparse_lp(24, 48, density=0.2, seed=2)
+        p.block_structure = {
+            "kind": "two_stage", "num_blocks": 4, "block_m": 6,
+            "block_n": 11, "first_stage_n": 4, "first_stage_m": 0,
+        }
+        r = supervised_solve(p, backend="scenario", tol=1e-8)
+        assert r.status.value == "optimal"
+        hg = highs_on_general(p)
+        assert abs(r.objective - hg.fun) <= 1e-6 * (1.0 + abs(hg.fun))
+
+
+# -- serve semantics ---------------------------------------------------------
+
+
+class TestScenarioServe:
+    def test_delta_wave_warm_cache_amortization(self):
+        """Acceptance: across waves of b/c-only deltas the warm cache
+        hits (>0 ratio) and the median iterations/request drops
+        strictly below the cold median."""
+        from distributedlpsolver_tpu.serve.service import (
+            ServiceConfig,
+            SolveService,
+        )
+
+        svc = SolveService(ServiceConfig(flush_s=0.005))
+        try:
+            futs = [
+                svc.submit(s.to_block_angular(), tol=1e-8)
+                for s in scenario_delta_stream(
+                    10, num_scenarios=8, block_m=6, block_n=10,
+                    first_stage_n=6, first_stage_m=2, seed=11,
+                )
+            ]
+            res = [f.result(timeout=180) for f in futs]
+        finally:
+            svc.shutdown()
+        assert all(r.status.value == "optimal" for r in res)
+        assert all(r.engine == "scenario" for r in res)
+        assert all(r.n_scenarios == 8 and r.scenario_bucket == 8 for r in res)
+        warm = [r for r in res if r.warm == "warm"]
+        cold = [r for r in res if r.warm != "warm"]
+        assert warm and cold  # first request is cold, the wave warms
+        med = lambda v: float(np.median(v))
+        assert med([r.iterations for r in warm]) < med(
+            [r.iterations for r in cold]
+        )
+        # Decomposition telemetry rides the records.
+        assert all(r.schur_ms > 0 for r in res)
+
+    def test_admission_units_controller(self):
+        from distributedlpsolver_tpu.net.admission import (
+            AdmissionConfig,
+            AdmissionController,
+            TenantQuota,
+        )
+
+        ctl = AdmissionController(
+            AdmissionConfig(
+                quotas={"acme": TenantQuota(rate=0.001, burst=6.0)}
+            ),
+            max_depth=64,
+        )
+        # A K=32 job at k_unit=8 charges 4 units: 6-token burst admits
+        # one, rejects the second with reason=quota.
+        v1 = ctl.admit("acme", units=4)
+        assert v1.admitted
+        v2 = ctl.admit("acme", units=4)
+        assert not v2.admitted and v2.reason == "quota"
+        # in-system accounting is unit-weighted.
+        ctl.on_admitted("acme", units=4)
+        assert ctl.stats()["acme"]["in_system"] == 4
+        ctl.on_finished("acme", units=4)
+        assert ctl.stats()["acme"]["in_system"] == 0
+
+    def test_admission_units_under_flood(self):
+        """Acceptance: a flood of K-scenario submits is charged
+        ceil(K/K_unit) fair-share units each — the quota wall arrives
+        units-fast, not request-fast."""
+        from distributedlpsolver_tpu.net.admission import (
+            AdmissionConfig,
+            TenantQuota,
+        )
+        from distributedlpsolver_tpu.serve.scheduler import ServiceOverloaded
+        from distributedlpsolver_tpu.serve.service import (
+            ServiceConfig,
+            SolveService,
+        )
+
+        cfg = ServiceConfig(
+            flush_s=0.005,
+            scenario_k_unit=8,
+            admission=AdmissionConfig(
+                quotas={"acme": TenantQuota(rate=0.001, burst=8.0)}
+            ),
+        )
+        svc = SolveService(cfg)
+        try:
+            slp = _small_storm(32, seed=80)  # 32/8 = 4 units each
+            futs = []
+            rejected = None
+            for _ in range(3):
+                try:
+                    futs.append(
+                        svc.submit(
+                            slp.to_block_angular(), tol=1e-8, tenant="acme"
+                        )
+                    )
+                except ServiceOverloaded as e:
+                    rejected = e
+                    break
+            # 8-token burst / 4 units = exactly 2 admitted.
+            assert len(futs) == 2
+            assert rejected is not None and rejected.reason == "quota"
+            for f in futs:
+                assert f.result(timeout=180).status.value == "optimal"
+            adm = svc.stats()["admission"]["acme"]
+            assert adm["admitted"] == 2 and adm["in_system"] == 0
+        finally:
+            svc.shutdown()
+
+    def test_journal_roundtrip_scenario_job(self, tmp_path):
+        """Acceptance: a scenario job admitted to the durable journal by
+        a process that dies before solving is replayed by the next one —
+        the poll id resolves to an honest OPTIMAL verdict."""
+        from distributedlpsolver_tpu.net import protocol
+        from distributedlpsolver_tpu.serve.service import (
+            ServiceConfig,
+            SolveService,
+        )
+
+        jd = str(tmp_path / "journal")
+        cfg = ServiceConfig(flush_s=0.005, journal_dir=jd)
+        # Service A: admit (WAL write) but never start the pipeline —
+        # the in-process stand-in for kill -9 between ack and solve.
+        svc_a = SolveService(cfg, auto_start=False)
+        slp = _small_storm(4, seed=90)
+        fut = svc_a.submit(slp.to_block_angular(), tol=1e-8)
+        jid = fut.jid
+        assert jid
+        svc_a._journal.close()
+        # Service B on the same journal dir: replay re-enqueues and
+        # solves; the poll id survives the restart.
+        svc_b = SolveService(cfg)
+        try:
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                kind, rec = svc_b.job_result(jid)
+                if kind == "done":
+                    break
+                time.sleep(0.05)
+            assert kind == "done"
+            assert rec["status"] == "optimal"
+            assert rec["n_scenarios"] == 4
+            code, body = protocol.payload_from_record(rec)
+            assert code == 200 and body["status"] == "optimal"
+            # The durable-store payload carries the scenario fields a
+            # live-future response would (a restarted front-end's poll
+            # answer must not lose the K/bucket/stage split).
+            assert body["n_scenarios"] == 4
+            assert body["scenario_bucket"] == 4
+            assert body["recovered"] is True
+        finally:
+            svc_b.shutdown()
+
+    def test_kmixed_acceptance_zero_warm_recompiles(self):
+        """Acceptance: a 200-request K-mixed stream (buckets 4 and 8)
+        runs entirely on warm scenario programs — zero recompiles after
+        the two bucket warms — with every verdict OPTIMAL and fair-share
+        units stamped."""
+        from distributedlpsolver_tpu.backends.scenario import (
+            scenario_program_cache_size,
+            solve_scenario,
+        )
+        from distributedlpsolver_tpu.serve.service import (
+            ServiceConfig,
+            SolveService,
+        )
+
+        # Warm both K buckets (and the delta base's shape) up front —
+        # the serve analogue of warm_buckets for the solo scenario path.
+        for K in (4, 8):
+            solve_scenario(
+                two_stage_storm(
+                    K, block_m=4, block_n=7, first_stage_n=4,
+                    first_stage_m=1, seed=99,
+                ),
+                tol=1e-8,
+            )
+        svc = SolveService(ServiceConfig(flush_s=0.002))
+        try:
+            streams = {
+                K: scenario_delta_stream(
+                    50, num_scenarios=K, block_m=4, block_n=7,
+                    first_stage_n=4, first_stage_m=1, seed=100 + K,
+                )
+                for K in (3, 4, 6, 8)
+            }
+            # One cold solve per stream shape to settle program + cache.
+            first = {
+                K: svc.submit(next(s).to_block_angular(), tol=1e-8)
+                for K, s in streams.items()
+            }
+            for f in first.values():
+                assert f.result(timeout=180).status.value == "optimal"
+            size0 = scenario_program_cache_size()
+            futs = []
+            order = [3, 4, 6, 8]
+            for i in range(49):
+                for K in order:
+                    futs.append(
+                        svc.submit(
+                            next(streams[K]).to_block_angular(), tol=1e-8
+                        )
+                    )
+            res = [f.result(timeout=600) for f in futs]
+        finally:
+            svc.shutdown()
+        assert len(res) == 196  # + 4 warmers = 200 requests through serve
+        assert all(r.status.value == "optimal" for r in res)
+        assert scenario_program_cache_size() == size0  # ZERO recompiles
+        buckets = {r.scenario_bucket for r in res}
+        assert buckets == {4, 8}
+        # Warm-cache amortization at steady state.
+        warm_frac = sum(1 for r in res if r.warm == "warm") / len(res)
+        assert warm_frac > 0.5
+
+    def test_http_scenarios_payload(self):
+        from distributedlpsolver_tpu.net import protocol
+
+        # Generated form.
+        body = json.dumps(
+            {
+                "scenarios": {
+                    "n_scenarios": 4, "seed": 2, "block_m": 4,
+                    "block_n": 7, "first_stage_n": 4, "first_stage_m": 1,
+                },
+                "tol": 1e-6,
+                "tenant": "acme",
+            }
+        ).encode()
+        req = protocol.parse_solve_request(body)
+        assert req.problem.block_structure["kind"] == "two_stage"
+        assert req.problem.block_structure["num_blocks"] == 4
+        assert req.tol == 1e-6 and req.tenant == "acme"
+        # Explicit base + deltas (ScenarioLP.to_dict form).
+        slp = _small_storm(3, seed=4)
+        body = json.dumps({"scenarios": slp.to_dict()}).encode()
+        req2 = protocol.parse_solve_request(body)
+        assert req2.problem.m == slp.m and req2.problem.n == slp.n
+        # Malformed: 400 path.
+        with pytest.raises(protocol.ProtocolError):
+            protocol.parse_solve_request(
+                json.dumps({"scenarios": {"bogus": 1}}).encode()
+            )
+        with pytest.raises(protocol.ProtocolError):
+            protocol.parse_solve_request(
+                json.dumps({"scenarios": {"n_scenarios": 0}}).encode()
+            )
+
+    def test_http_end_to_end_scenario_solve(self):
+        from distributedlpsolver_tpu.net.server import (
+            NetConfig,
+            SolveHTTPServer,
+        )
+        from distributedlpsolver_tpu.serve.service import (
+            ServiceConfig,
+            SolveService,
+        )
+        import urllib.request
+
+        svc = SolveService(ServiceConfig(flush_s=0.005))
+        front = SolveHTTPServer(svc, NetConfig()).start()
+        try:
+            body = json.dumps(
+                {
+                    "scenarios": {
+                        "n_scenarios": 4, "seed": 5, "block_m": 4,
+                        "block_n": 7, "first_stage_n": 4,
+                        "first_stage_m": 1,
+                    }
+                }
+            ).encode()
+            req = urllib.request.Request(
+                front.url + "/v1/solve", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                payload = json.loads(resp.read())
+            assert payload["status"] == "optimal"
+            assert payload["n_scenarios"] == 4
+            assert payload["scenario_bucket"] == 4
+            assert payload["schur_ms"] >= 0
+        finally:
+            front.shutdown()
+            svc.shutdown()
+
+
+# -- obs wiring --------------------------------------------------------------
+
+
+class TestScenarioObs:
+    def test_metrics_and_report_reconcile_with_stats(self, tmp_path):
+        from distributedlpsolver_tpu.obs import metrics as obs_metrics
+        from distributedlpsolver_tpu.obs.report import report_from_paths
+        from distributedlpsolver_tpu.serve.service import (
+            ServiceConfig,
+            SolveService,
+        )
+
+        log = str(tmp_path / "serve.jsonl")
+        reg = obs_metrics.MetricsRegistry()
+        svc = SolveService(
+            ServiceConfig(flush_s=0.005, log_jsonl=log), metrics=reg
+        )
+        try:
+            futs = [
+                svc.submit(_small_storm(K, seed=K).to_block_angular(),
+                           tol=1e-8)
+                for K in (3, 4, 8)
+            ]
+            for f in futs:
+                assert f.result(timeout=180).status.value == "optimal"
+            stats = svc.stats()
+        finally:
+            svc.shutdown()
+        # Metrics: solves by terminal engine, K histogram, stage walls.
+        snap = reg.snapshot()
+        solves = sum(
+            v for k, v in snap.items()
+            if k.startswith("scenario_solves_total")
+        )
+        assert solves == 3
+        k_hist = snap.get("scenario_k")
+        assert k_hist and k_hist["count"] == 3
+        assert snap["scenario_schur_ms"]["sum"] > 0
+        # Report table reconciles with SolveService.stats().
+        rep = report_from_paths([log])
+        assert rep["scenario"]["solves"] == stats["scenario"]["solves"] == 3
+        for bucket, row in rep["scenario"]["by_bucket"].items():
+            srow = stats["scenario"]["by_bucket"][bucket]
+            assert row["count"] == srow["count"]
+            assert row["total_ms"]["p50"] == pytest.approx(
+                srow["total_ms_p50"], abs=1e-3
+            )
+        from distributedlpsolver_tpu.obs.report import render
+
+        text = render(rep)
+        assert "scenario tier: 3 solves" in text
