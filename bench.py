@@ -1254,6 +1254,71 @@ def _bench_scenario(args) -> list:
     return rows
 
 
+def _bench_multihost(args) -> list:
+    """Multi-host harness rows (``--multihost``): the SAME storm-class
+    instance solved by the sharded backend in 1-process and N-process
+    `jax.distributed` worlds (distributed/launcher — each world spawned
+    fresh, 2 virtual CPU devices per process on the harness, ICI/DCN on
+    a pod). Wall time is the slowest rank's in-process solve wall (the
+    SPMD program finishes in lockstep; process spawn/import is reported
+    separately as launch overhead). CPU-harness figures measure the
+    cross-process dataflow, not TPU speed — the TPU-pod measurement is
+    the ROADMAP follow-on, and ``--require-tpu`` aborts before any
+    fallback row here like everywhere else."""
+    import tempfile
+
+    from distributedlpsolver_tpu.distributed.launcher import run_world
+
+    K = 8 if args.quick else 24
+    spec = {
+        "instance": "storm",
+        "scenarios": K,
+        "block_m": 24,
+        "block_n": 36,
+        "first_stage_n": 24,
+        "seed": 1,
+        "tol": 1e-8,
+    }
+    m = K * 24
+    n = 24 + K * 36
+    worlds = [1, 2] if args.quick else [1, 2, 4]
+    rows = []
+    base_wall = None
+    for ws in worlds:
+        workdir = tempfile.mkdtemp(prefix=f"dlps-bench-mh-{ws}-")
+        t0 = time.perf_counter()
+        res = run_world(
+            "sharded_solve", spec, world_size=ws, workdir=workdir,
+            local_devices=2, timeout=600,
+        )
+        launch_wall = time.perf_counter() - t0
+        solve_wall = max(r["wall_s"] for r in res.values())
+        objs = sorted(r["objective"] for r in res.values())
+        statuses = {r["status"] for r in res.values()}
+        if ws == 1:
+            base_wall = solve_wall
+        row = {
+            "family": "multihost",
+            "instance": f"storm K={K} ({m}x{n})",
+            "m": m,
+            "n": n,
+            "world_size": ws,
+            "global_devices": 2 * ws,
+            "status": sorted(statuses)[0] if len(statuses) == 1 else "mixed",
+            "iters": int(next(iter(res.values()))["iterations"]),
+            "solve_wall_s": round(solve_wall, 3),
+            "launch_wall_s": round(launch_wall, 3),
+            "objective_spread": round(objs[-1] - objs[0], 12),
+            "speedup_vs_1proc": (
+                round(base_wall / solve_wall, 3) if base_wall else None
+            ),
+            "platform": args.platform,
+        }
+        rows.append(row)
+        _log(json.dumps(row))
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (smoke)")
@@ -1274,6 +1339,11 @@ def main() -> int:
                     "iterative on one two-stage storm instance; K + "
                     "schur/link split + peak operand bytes) -> "
                     "BENCH_SCENARIO.json")
+    ap.add_argument("--multihost", action="store_true",
+                    help="multi-host harness rows: the storm instance "
+                    "through 1 vs N jax.distributed processes "
+                    "(sharded backend, CPU harness; --require-tpu "
+                    "honored) -> BENCH_MULTIHOST.json")
     ap.add_argument("--serve-http", action="store_true",
                     help="serving rows incl. the HTTP network plane: the "
                     "in-process row plus a localhost POST /v1/solve row, "
@@ -1332,6 +1402,17 @@ def main() -> int:
         backend = args.backend = "tpu"
 
     _obs_enable()
+
+    if args.multihost:
+        rows = _bench_multihost(args)
+        for r in rows:
+            r.setdefault("metrics", _obs_row(args.platform))
+        out = os.path.join(_REPO, "BENCH_MULTIHOST.json")
+        with open(out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+        _log(f"multihost rows -> {out}")
+        print(json.dumps(rows[-1]))  # headline: the widest world's row
+        return 0  # multihost tier is its own run; no headline solve after
 
     if args.scenario:
         rows = _bench_scenario(args)

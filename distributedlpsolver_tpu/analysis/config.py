@@ -133,6 +133,15 @@ JSONL_EVENT_TYPES = {
     "journal_replay",
     "drain",
     "registry_write",
+    # Multi-host runtime (distributed/): one record per coordinator-
+    # level world re-initialization (launcher.WorldSupervisor — a dead
+    # rank kills the world as a unit, recovery relaunches a smaller
+    # one), per slice self-registration into the shared backend
+    # registry, and per registry liveness beat where a stream consumer
+    # wants them (cli serve-slice).
+    "world_reinit",
+    "slice_register",
+    "heartbeat",
 }
 
 # Every field a stamped JSONL record may carry, across all streams: the
@@ -259,6 +268,15 @@ JSONL_FIELDS = {
     "ejected",
     "generation",
     "writer",
+    # multi-host runtime (distributed/, cli serve-slice, supervisor
+    # probe-fault attribution): which process observed/emitted the
+    # record, the world it belonged to, and the logical slice — stamped
+    # on world_reinit / slice_register / heartbeat events and on
+    # supervisor fault records (probes only see addressable devices, so
+    # the rank scopes the evidence).
+    "rank",
+    "world_size",
+    "slice_id",
 }
 
 # ``X.write(json.dumps(...))`` record emission points that must stamp:

@@ -1432,26 +1432,35 @@ def solve_bucket(
         _NUMERR: Status.NUMERICAL_ERROR,
         _STALL: Status.STALLED,
     }
+    # Demux through the multi-process-safe fetch: on a single-process
+    # mesh this is np.asarray verbatim; on a multi-process (pod-slice)
+    # mesh the batch axis spans processes and every result field rides
+    # ONE replicating gather program all ranks reach together.
+    (status_h, pobj_h, x_h, iters_h, rel_gap_h, pinf_h, dinf_h, y_h,
+     s_h, w_h, z_h, warm_h) = mesh_lib.host_values(
+        (status, pobj, states.x, iters, rel_gap, pinf, dinf, states.y,
+         states.s, states.w, states.z, warm_used)
+    )
     status_arr = np.array(
-        [code_map[int(sc)] for sc in np.asarray(status)], dtype=object
+        [code_map[int(sc)] for sc in status_h], dtype=object
     )
     return BatchedResult(
         status=status_arr,
-        objective=np.asarray(pobj, dtype=np.float64),
-        x=np.asarray(states.x, dtype=np.float64),
-        iterations=np.asarray(iters),
-        rel_gap=np.asarray(rel_gap, dtype=np.float64),
-        pinf=np.asarray(pinf, dtype=np.float64),
-        dinf=np.asarray(dinf, dtype=np.float64),
+        objective=np.asarray(pobj_h, dtype=np.float64),
+        x=np.asarray(x_h, dtype=np.float64),
+        iterations=iters_h,
+        rel_gap=np.asarray(rel_gap_h, dtype=np.float64),
+        pinf=np.asarray(pinf_h, dtype=np.float64),
+        dinf=np.asarray(dinf_h, dtype=np.float64),
         solve_time=solve_time,
         setup_time=setup_time,
         phase_report=phase_report,
         fused_iters=fuse,
-        y=np.asarray(states.y, dtype=np.float64),
-        s=np.asarray(states.s, dtype=np.float64),
-        w=np.asarray(states.w, dtype=np.float64),
-        z=np.asarray(states.z, dtype=np.float64),
-        warm_used=np.asarray(warm_used),
+        y=np.asarray(y_h, dtype=np.float64),
+        s=np.asarray(s_h, dtype=np.float64),
+        w=np.asarray(w_h, dtype=np.float64),
+        z=np.asarray(z_h, dtype=np.float64),
+        warm_used=warm_h,
     )
 
 

@@ -137,9 +137,23 @@ def analyze_structure(inf: InteriorForm) -> Tuple[BlockLayout, dict]:
     }
 
 
-def build_tensors(inf: InteriorForm, dtype, shard_put=None) -> Tuple[BlockTensors, BlockLayout]:
+def build_tensors(
+    inf: InteriorForm, dtype, shard_put=None, pad_blocks: int = 0
+) -> Tuple[BlockTensors, BlockLayout]:
+    """``pad_blocks`` appends DEAD blocks to the K axis: all-sentinel
+    index maps (every row/column reads the padded zero slot) and zero
+    B/L tiles. A dead block's normal matrix gets the unit diagonal the
+    sentinel-row machinery already installs (``pad_diag`` at each
+    factorization site), so it factors cleanly, contributes nothing to
+    the linking Schur sum (G_k = 0), and scatters nothing back. This is
+    the ragged-tail layout that lets an ARBITRARY mesh width divide the
+    block axis: K blocks shard over ``axis_size`` devices as
+    ``ceil(K / axis_size)`` per device with the tail masked — survivor
+    counts after an elastic shrink no longer need to divide K."""
     layout, info = analyze_structure(inf)
     K, mb, nb, link, n0, n, m = layout
+    K = K + max(0, int(pad_blocks))
+    layout = layout._replace(K=K)
     # Slice per block straight out of the sparse matrix — densifying only
     # the (mb, nb_k) / (link, nb_k) tiles that exist. Never materialize the
     # full m×n dense A: for a Mittelmann-scale sparse problem that is the
@@ -818,6 +832,7 @@ class BlockAngularBackend(SolverBackend):
         self._dtype = dtype
 
         shard_put = None
+        pad_blocks = 0
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -825,14 +840,14 @@ class BlockAngularBackend(SolverBackend):
             # ICI×DCN mesh that's the DCN axis, which fits: diagonal blocks
             # exchange only the small linking system. Divisibility is
             # against that axis's size, not the whole device count.
+            # Arbitrary widths are accepted: a K not divisible by the
+            # axis is padded with DEAD blocks (ragged-tail layout, see
+            # build_tensors) — the elastic-shrink path re-shards onto
+            # ANY survivor count instead of degrading down the chain.
             axis = self._mesh.axis_names[0]
             axis_size = self._mesh.shape[axis]
             K_hint = int((inf.block_structure or {}).get("num_blocks", 0))
-            if K_hint % axis_size != 0:
-                raise ValueError(
-                    f"K={K_hint} blocks not divisible by mesh axis "
-                    f"{axis!r} of size {axis_size}"
-                )
+            pad_blocks = (-K_hint) % axis_size
 
             def shard_put(arr, kind):
                 spec = (
@@ -840,7 +855,9 @@ class BlockAngularBackend(SolverBackend):
                 )
                 return jax.device_put(arr, NamedSharding(self._mesh, spec))
 
-        self._tensors, self._lay = build_tensors(inf, dtype, shard_put)
+        self._tensors, self._lay = build_tensors(
+            inf, dtype, shard_put, pad_blocks=pad_blocks
+        )
         # Distributed linking-system factorization (VERDICT round-4 item
         # 7): with a mesh, the link×link Schur complement factors through
         # ops/dist_chol.py column-sharded over the LAST mesh axis (ICI on
@@ -926,6 +943,18 @@ class BlockAngularBackend(SolverBackend):
             return False
         self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
         return True
+
+    @property
+    def mesh(self) -> Optional[jax.sharding.Mesh]:
+        return self._mesh
+
+    def reshard(self, mesh: jax.sharding.Mesh) -> "BlockAngularBackend":
+        """Elastic-recovery seam (supervisor SHRINK rung): a fresh
+        instance on the survivor mesh. With the ragged-tail layout any
+        survivor count re-shards — K pads up to the next multiple of
+        the new mesh's block axis with dead blocks instead of pushing
+        the solve down the degradation chain (ROADMAP carried item)."""
+        return type(self)(mesh=mesh)
 
     def _get_tensors32(self) -> BlockTensors:
         if self._tensors32 is None:

@@ -209,8 +209,12 @@ def _tri_inv_mesh(L, prec_shard, panel: int = 512):
     the first cut of a distributed factorization (SURVEY.md §2.2;
     VERDICT round 2 item 5: "distribute panels over the mesh").
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec
+
+    from distributedlpsolver_tpu.parallel.mesh import (
+        pvary_compat,
+        shard_map_compat,
+    )
 
     mesh = prec_shard.mesh
     axis = next(a for a in prec_shard.spec if a is not None)
@@ -236,12 +240,10 @@ def _tri_inv_mesh(L, prec_shard, panel: int = 512):
         # slabs, via axis_index) — mark the zero init as varying over
         # the mesh axis or the slab loop's carry types mismatch under
         # shard_map.
-        init = jax.lax.pcast(
-            jnp.zeros((mp, w), Lfull.dtype), (axis,), to="varying"
-        )
+        init = pvary_compat(jnp.zeros((mp, w), Lfull.dtype), (axis,))
         return _trsm_slabs(Lfull, base, w, panel, init)
 
-    Linv = shard_map(
+    Linv = shard_map_compat(
         device_fn,
         mesh=mesh,
         in_specs=(PartitionSpec(None, None),),
@@ -1280,6 +1282,7 @@ class DenseJaxBackend(SolverBackend):
         b = self._put(np.asarray(inf.b, dtype=dtype), row_s)
         u = self._put(u_host.astype(dtype), col_s)
         self._col_sharding = col_s
+        self._row_sharding = row_s
 
         self._A = A
         self._data = core.make_problem_data(jnp, c, b, u, dtype)
@@ -2144,14 +2147,18 @@ class DenseJaxBackend(SolverBackend):
         )
 
     def to_host(self, state: IPMState) -> IPMState:
+        # host_values = np.asarray on single-process placements; on a
+        # multi-process mesh the column-sharded fields ride one
+        # replicating gather program (a collective — every rank runs
+        # the same driver, so every rank reaches each to_host together,
+        # and the host-canonical checkpoint contract holds world-wide).
+        from distributedlpsolver_tpu.parallel.mesh import host_values
+
         n = self._n_orig
-        return IPMState(
-            x=np.asarray(state.x)[:n],
-            y=np.asarray(state.y),
-            s=np.asarray(state.s)[:n],
-            w=np.asarray(state.w)[:n],
-            z=np.asarray(state.z)[:n],
+        x, y, s, w, z = host_values(
+            (state.x, state.y, state.s, state.w, state.z)
         )
+        return IPMState(x=x[:n], y=y, s=s[:n], w=w[:n], z=z[:n])
 
     def from_host(self, state: IPMState) -> IPMState:
         n_extra = self._data.c.shape[0] - self._n_orig
@@ -2163,8 +2170,18 @@ class DenseJaxBackend(SolverBackend):
             w = np.concatenate([w, np.ones(n_extra)])
             z = np.concatenate([z, np.zeros(n_extra)])
         col_s = self._col_sharding
+        row_s = getattr(self, "_row_sharding", None)
         put = lambda v: jax.device_put(v, col_s) if col_s is not None else jnp.asarray(v)
-        return IPMState(x=put(x), y=jnp.asarray(y), s=put(s), w=put(w), z=put(z))
+        # y rides the row (replicated-on-mesh) sharding: under a
+        # multi-process mesh an uncommitted single-device array cannot
+        # feed a global SPMD program — every input needs a concrete
+        # global placement.
+        put_y = (
+            (lambda v: jax.device_put(v, row_s))
+            if row_s is not None
+            else jnp.asarray
+        )
+        return IPMState(x=put(x), y=put_y(y), s=put(s), w=put(w), z=put(z))
 
     def block_until_ready(self, obj) -> None:
         jax.block_until_ready(obj)

@@ -793,22 +793,30 @@ def solve_pdhg_bucket(
     jax.block_until_ready(x)
     solve_time = _time.perf_counter() - t1
 
+    # Multi-process-safe demux (see backends/batched.solve_bucket): a
+    # batch axis spanning processes rides one replicating gather
+    # program; single-process meshes take the plain np.asarray path.
+    from distributedlpsolver_tpu.parallel.mesh import host_values
+
+    pinf, dinf, gap, act_h, pobj_h, x_h, it_host = host_values(
+        (pinf, dinf, gap, active, pobj, x, it)
+    )
     pinf = np.asarray(pinf, dtype=np.float64)
     dinf = np.asarray(dinf, dtype=np.float64)
     gap = np.asarray(gap, dtype=np.float64)
     ok = (gap <= cfg.tol) & (pinf <= cfg.tol) & (dinf <= cfg.tol)
     # Inactive (padding) slots report the same placeholder OPTIMAL as
     # solve_bucket — demux by slot and ignore them.
-    ok = ok | ~np.asarray(active, dtype=bool)
+    ok = ok | ~act_h.astype(bool)
     status = np.array(
         [Status.OPTIMAL if o else Status.ITERATION_LIMIT for o in ok],
         dtype=object,
     )
     return BatchedResult(
         status=status,
-        objective=np.asarray(pobj, dtype=np.float64),
-        x=np.asarray(x, dtype=np.float64),
-        iterations=np.asarray(it),
+        objective=np.asarray(pobj_h, dtype=np.float64),
+        x=np.asarray(x_h, dtype=np.float64),
+        iterations=it_host,
         rel_gap=gap,
         pinf=pinf,
         dinf=dinf,
@@ -816,7 +824,7 @@ def solve_pdhg_bucket(
         setup_time=setup_time,
         phase_report=[
             {"phase": 0, "engine": "pdhg", "tol": float(cfg.tol),
-             "iters": int(np.asarray(it).max(initial=0))}
+             "iters": int(it_host.max(initial=0))}
         ],
         fused_iters=40,  # check_every inner steps per while trip
     )

@@ -321,25 +321,37 @@ class ScenarioBackend(SolverBackend):
                 f"two_stage arrow pattern — not scenario-decomposable"
             )
 
+        from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
         sharding = None
         if self.mesh is not None and chunk % int(self.mesh.devices.size) == 0:
-            from distributedlpsolver_tpu.parallel.mesh import batch_sharding
+            sharding = mesh_lib.batch_sharding(self.mesh, 3)
+        # Under a MULTI-PROCESS mesh every program input needs a concrete
+        # global placement — the lane stacks shard over the batch axis,
+        # everything small rides replicated. Single-process keeps the
+        # classic default-device placement, byte for byte.
+        if mesh_lib.is_multiprocess(self.mesh):
+            rep = mesh_lib.replicated(self.mesh)
+            self._rep_put = lambda x: mesh_lib.put_global(
+                np.asarray(x, dtype=np.float64), rep
+            )
+        else:
+            self._rep_put = lambda x: jnp.asarray(x, dtype=jnp.float64)
 
-            sharding = batch_sharding(self.mesh, 3)
         def _place(x):
-            arr = jnp.asarray(x, dtype=jnp.float64)
+            arr = np.asarray(x, dtype=np.float64)
             if sharding is not None and arr.ndim == 3:
-                return jax.device_put(arr, sharding)
-            return jax.device_put(arr)
+                return mesh_lib.put_global(arr, sharding)
+            return self._rep_put(arr)
 
         csh = (nchunks, chunk)
         self._Wd = [_place(W.reshape(csh + (mb, nb))[i]) for i in range(nchunks)]
         self._Td = [_place(T.reshape(csh + (mb, n0))[i]) for i in range(nchunks)]
         self._rowmask_d = [
-            jnp.asarray(rowmask.reshape(csh + (mb,))[i], dtype=jnp.float64)
+            self._rep_put(rowmask.reshape(csh + (mb,))[i])
             for i in range(nchunks)
         ]
-        self._A0d = jnp.asarray(A0, dtype=jnp.float64)
+        self._A0d = self._rep_put(A0)
         self._rows0 = rows0
         self._cols0 = cols0
         self._rows_idx = rows_idx.reshape(csh + (mb,))
@@ -406,19 +418,19 @@ class ScenarioBackend(SolverBackend):
         regj = jnp.asarray(reg, dtype=jnp.float64)
         n0 = len(self._cols0)
         t0 = time.perf_counter()
-        C = jnp.zeros((n0, n0), dtype=jnp.float64)
+        C = self._rep_put(np.zeros((n0, n0)))
         Ls = []
         for ci in range(len(self._Wd)):
             L, C = _schur_factor_jit(
                 self._Wd[ci], self._Td[ci],
-                jnp.asarray(dK[ci], dtype=jnp.float64),
+                self._rep_put(dK[ci]),
                 self._rowmask_d[ci], regj, C,
             )
             Ls.append(L)
         jax.block_until_ready(C)
         t1 = time.perf_counter()
         LH, G, LF = _link_factor_jit(
-            C, jnp.asarray(d0, dtype=jnp.float64), self._A0d, regj
+            C, self._rep_put(d0), self._A0d, regj
         )
         jax.block_until_ready(LF)
         t2 = time.perf_counter()
@@ -483,15 +495,12 @@ class ScenarioBackend(SolverBackend):
 
     def _apply_decomp(self, factors, r: np.ndarray) -> np.ndarray:
         Ls, LH, G, LF = factors[:4]
-        r0 = jnp.asarray(r[self._rows0], dtype=jnp.float64)
+        r0 = self._rep_put(r[self._rows0])
         rK = r[self._rows_idx] * self._rowmask  # (nchunks, chunk, mb)
         n0 = len(self._cols0)
         t0 = time.perf_counter()
-        rKd = [
-            jnp.asarray(rK[ci], dtype=jnp.float64)
-            for ci in range(len(Ls))
-        ]
-        t = jnp.zeros((n0,), dtype=jnp.float64)
+        rKd = [self._rep_put(rK[ci]) for ci in range(len(Ls))]
+        t = self._rep_put(np.zeros((n0,)))
         for ci in range(len(Ls)):
             t = _solve_pre_jit(
                 Ls[ci], self._Td[ci], rKd[ci], self._rowmask_d[ci], t
@@ -509,7 +518,16 @@ class ScenarioBackend(SolverBackend):
         ]
         dy = np.zeros(r.shape[0], dtype=np.float64)
         dy[self._rows0] = np.asarray(dy0)
-        flat = np.concatenate([np.asarray(c).reshape(-1) for c in dyK])
+        # Lane-chunk fetch through the multi-process-safe path: with the
+        # lane axis sharded over a multi-host mesh each rank holds only
+        # its scenario lanes, and ALL chunks ride one replicating gather
+        # program every rank reaches (all ranks run the same
+        # decomposition in the same order).
+        from distributedlpsolver_tpu.parallel.mesh import host_values
+
+        flat = np.concatenate(
+            [c.reshape(-1) for c in host_values(dyK)]
+        )
         dy[self._dy_rows] = flat[self._dy_sel]
         t3 = time.perf_counter()
         _REPORT.add("schur_ms", (t1 - t0 + t3 - t2) * 1e3)

@@ -511,6 +511,204 @@ def cmd_serve_http(args) -> int:
     return 0
 
 
+def cmd_serve_slice(args) -> int:
+    """One-service-per-slice multi-host serving (README "Multi-host").
+
+    Without ``--rank``: SUPERVISOR mode — spawn ``--world-size`` rank
+    processes of this same command (the single-machine harness of a TPU
+    pod slice), watch them, and on world death relaunch a smaller world
+    on the same port + journal (coordinator-level recovery; emits
+    ``world_reinit`` events with ``recovery_overhead_s``).
+
+    With ``--rank`` (spawned by the supervisor; env contract set by the
+    launcher): rank 0 runs the HTTP front-end whose SolveService
+    dispatches onto the slice's GLOBAL mesh and self-registers into the
+    shared backend registry (``--registry``) with heartbeats; nonzero
+    ranks run the follower loop off the slice dispatch journal.
+    """
+    import os
+    import threading
+    import time
+
+    if args.rank is None:
+        # ---------------- supervisor mode ----------------------------
+        import sys as _sys
+
+        from distributedlpsolver_tpu.distributed.launcher import (
+            SupervisorConfig,
+            WorldSupervisor,
+        )
+
+        workdir = args.slice_workdir or os.path.join(
+            args.journal_dir or ".", f"slice-{args.slice_id}-world"
+        )
+        base_argv = [a for a in _sys.argv[1:]]
+
+        def argv_for_gen(generation, world_size, port):
+            def argv_for(rank):
+                return (
+                    [_sys.executable, "-m", "distributedlpsolver_tpu.cli"]
+                    + base_argv
+                    + ["--rank", str(rank)]
+                )
+
+            return argv_for
+
+        sup = WorldSupervisor(
+            argv_for_gen,
+            world_size=args.world_size,
+            workdir=workdir,
+            local_devices=args.local_devices,
+            config=SupervisorConfig(
+                min_world=1,
+                max_reforms=args.max_reforms,
+                # Own stream, never the ranks' net log: a relaunched
+                # rank re-opens (truncates) its log path, which would
+                # eat the very world_reinit record describing it.
+                log_jsonl=os.path.join(workdir, "world.jsonl"),
+            ),
+            slice_id=args.slice_id,
+        )
+        try:
+            sup.run(timeout=args.supervise_timeout_s)
+        except KeyboardInterrupt:
+            if sup.handle is not None:
+                sup.handle.kill_all()
+            print("slice supervisor: interrupted", file=sys.stderr)
+        return 0
+
+    # -------------------- rank mode ----------------------------------
+    from distributedlpsolver_tpu.distributed.slice import (
+        FileControlPlane,
+        SliceRunner,
+        canonical_bucket_config,
+        follower_loop,
+    )
+    from distributedlpsolver_tpu.distributed.world import (
+        WorldConfig,
+        init_world,
+    )
+
+    cfg = WorldConfig.from_env()
+    world = init_world(cfg)
+    world.start_heartbeat()
+    ctrl_dir = os.path.join(
+        args.control_dir
+        or os.path.join(os.environ.get("DLPS_HEARTBEAT_DIR", "."), ".."),
+        f"ctrl-gen{cfg.generation}",
+    )
+    solver_cfg = canonical_bucket_config(_config_from(args))
+    try:
+        if world.rank != 0:
+            n = follower_loop(world, FileControlPlane(ctrl_dir), solver_cfg)
+            print(
+                f"slice follower rank {world.rank}: executed {n} "
+                f"dispatches; exiting",
+                file=sys.stderr,
+            )
+            return 0
+
+        # ---- rank 0: front-end + scheduler + demux -------------------
+        from distributedlpsolver_tpu.net import NetConfig, SolveHTTPServer
+        from distributedlpsolver_tpu.obs import metrics as obs_metrics
+        from distributedlpsolver_tpu.serve import SolveService
+
+        _apply_jax_cache(args)
+        finalize_obs = _obs_setup(args)
+        runner = SliceRunner(world, FileControlPlane(ctrl_dir), solver_cfg)
+        svc_cfg = _service_config_from(args)
+        net_cfg = NetConfig(
+            host=args.host,
+            port=args.port,
+            max_wait_s=args.max_wait_s,
+            wedge_s=args.wedge_s,
+            log_jsonl=args.net_log_jsonl,
+        )
+        reg = obs_metrics.get_registry()
+        if not reg.enabled:
+            reg = obs_metrics.MetricsRegistry()
+        try:
+            svc = SolveService(
+                svc_cfg,
+                solver_config=solver_cfg,
+                metrics=reg,
+                auto_start=not args.warm_buckets,
+                slice_runner=runner,
+            )
+            if args.warm_buckets:
+                n = svc.warm_buckets(svc.scheduler.table.specs())
+                print(
+                    f"warmed {n} bucket programs across "
+                    f"{world.world_size} ranks",
+                    file=sys.stderr,
+                )
+            with svc:
+                server = SolveHTTPServer(svc, net_cfg).start()
+                stopped = threading.Event()
+                server.on_drained = lambda drained: stopped.set()
+
+                # Self-registration + heartbeats into the shared
+                # registry: routers adopt the slice with no manual
+                # config and TTL-eject it when the beats stop.
+                hb_stop = threading.Event()
+                if args.registry:
+                    from distributedlpsolver_tpu.net.registry import (
+                        BackendRegistry,
+                    )
+
+                    breg = BackendRegistry(
+                        args.registry,
+                        logger=svc._logger,
+                        metrics=reg,
+                    )
+                    breg.register(
+                        server.url,
+                        slice_id=args.slice_id,
+                        world_size=world.world_size,
+                    )
+
+                    def _beat():
+                        n_beats = 0
+                        while not hb_stop.wait(args.heartbeat_s):
+                            breg.heartbeat(server.url)
+                            n_beats += 1
+                            if n_beats % 60 == 0:
+                                # Sparse liveness trace: one heartbeat
+                                # event a minute-ish, not one per beat.
+                                svc._logger.event(
+                                    {
+                                        "event": "heartbeat",
+                                        "rank": 0,
+                                        "slice_id": args.slice_id,
+                                        "backend": server.url,
+                                    }
+                                )
+
+                    threading.Thread(
+                        target=_beat, daemon=True, name="dlps-slice-hb"
+                    ).start()
+                print(
+                    f"slice {args.slice_id} gen {cfg.generation} serving "
+                    f"on {server.url} (world {world.world_size}, "
+                    f"{world.describe()['global_devices']} global devices)",
+                    file=sys.stderr,
+                )
+                try:
+                    stopped.wait()
+                    print("slice drained; exiting", file=sys.stderr)
+                except KeyboardInterrupt:
+                    print("slice shutting down", file=sys.stderr)
+                finally:
+                    hb_stop.set()
+                    server.shutdown()
+                    runner.stop()  # followers exit their loop cleanly
+        finally:
+            finalize_obs()
+        return 0
+    finally:
+        world.close()
+
+
 def cmd_route(args) -> int:
     """Router tier: health-checked, shape/load-aware routing over
     serve-http backends (README "Network serving")."""
@@ -527,14 +725,22 @@ def cmd_route(args) -> int:
     reg = obs_metrics.get_registry()
     if not reg.enabled:
         reg = obs_metrics.MetricsRegistry()
+    if not args.backend and not args.registry:
+        print(
+            "route: need --backend URLs or a --registry slices register "
+            "into",
+            file=sys.stderr,
+        )
+        return 2
     router = Router(
-        args.backend,
+        args.backend or [],
         RouterConfig(
             poll_s=args.poll_s,
             eject_after=args.eject_after,
             log_jsonl=args.log_jsonl,
             registry_path=args.registry,
             probe_backoff_cap_s=args.probe_backoff_cap_s,
+            registry_ttl_s=args.registry_ttl_s,
         ),
         metrics=reg,
     )
@@ -543,8 +749,8 @@ def cmd_route(args) -> int:
         server = RouterHTTPServer(router, host=args.host, port=args.port)
         server.start()
         print(
-            f"routing on {server.url} over {len(args.backend)} backends "
-            f"({router.healthy_count()} healthy)",
+            f"routing on {server.url} over {len(args.backend or [])} "
+            f"configured backends ({router.healthy_count()} healthy)",
             file=sys.stderr,
         )
         try:
@@ -802,14 +1008,88 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_solver_flags(ap_http)
     ap_http.set_defaults(fn=cmd_serve_http, quiet=True)
 
+    ap_slice = sub.add_parser(
+        "serve-slice",
+        help="multi-host slice: N-process world serving one HTTP "
+        "front-end over the slice's global mesh, with coordinator-"
+        "level recovery (README 'Multi-host')",
+    )
+    ap_slice.add_argument(
+        "--world-size", type=int, default=2,
+        help="processes in the slice (harness: CPU processes; pod: "
+        "one per host)",
+    )
+    ap_slice.add_argument(
+        "--rank", type=int, default=None,
+        help="run ONE rank (spawned by the supervisor; env contract "
+        "from the launcher). Omit to run the slice supervisor.",
+    )
+    ap_slice.add_argument(
+        "--local-devices", type=int, default=2,
+        help="virtual CPU devices per rank process (harness only)",
+    )
+    ap_slice.add_argument(
+        "--slice-id", default="slice0",
+        help="logical slice name stamped into registry entries and "
+        "world_reinit events",
+    )
+    ap_slice.add_argument(
+        "--registry", default=None,
+        help="shared backend-registry file to self-register into "
+        "(routers adopt the slice with no manual config)",
+    )
+    ap_slice.add_argument(
+        "--heartbeat-s", type=float, default=1.0,
+        help="registry heartbeat cadence (routers TTL-eject a slice "
+        "whose beats stop)",
+    )
+    ap_slice.add_argument(
+        "--control-dir", default=None,
+        help="slice dispatch-journal directory (default: next to the "
+        "launcher's heartbeat dir)",
+    )
+    ap_slice.add_argument(
+        "--slice-workdir", default=None,
+        help="supervisor workdir (heartbeats, rank logs, xla cache)",
+    )
+    ap_slice.add_argument(
+        "--max-reforms", type=int, default=3,
+        help="world re-initializations before the supervisor gives up",
+    )
+    ap_slice.add_argument(
+        "--supervise-timeout-s", type=float, default=86400.0,
+        help="supervisor wall-clock budget",
+    )
+    ap_slice.add_argument("--host", default="127.0.0.1")
+    ap_slice.add_argument(
+        "--port", type=int, default=8080,
+        help="rank-0 HTTP port — must be explicit so a re-initialized "
+        "world rebinds the same poll URLs",
+    )
+    ap_slice.add_argument("--max-wait-s", type=float, default=300.0)
+    ap_slice.add_argument("--wedge-s", type=float, default=30.0)
+    ap_slice.add_argument(
+        "--net-log-jsonl", default=None,
+        help="http_request / world_reinit JSONL event stream",
+    )
+    ap_slice.add_argument(
+        "--warm-buckets", action="store_true",
+        help="pre-compile the bucket ladder on EVERY rank before the "
+        "listener binds",
+    )
+    _add_serving_flags(ap_slice)
+    _add_solver_flags(ap_slice)
+    ap_slice.set_defaults(fn=cmd_serve_slice, quiet=True)
+
     ap_rt = sub.add_parser(
         "route",
         help="router tier over serve-http backends: shape/load-aware "
         "routing, health-checked failover (README 'Network serving')",
     )
     ap_rt.add_argument(
-        "--backend", action="append", required=True,
-        help="backend base URL (repeatable), e.g. http://10.0.0.2:8080",
+        "--backend", action="append",
+        help="backend base URL (repeatable), e.g. http://10.0.0.2:8080; "
+        "optional when --registry is given (slices self-register)",
     )
     ap_rt.add_argument("--host", default="127.0.0.1")
     ap_rt.add_argument(
@@ -839,6 +1119,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--probe-backoff-cap-s", type=float, default=30.0,
         help="ceiling on the exponential re-probe backoff of ejected "
         "backends",
+    )
+    ap_rt.add_argument(
+        "--registry-ttl-s", type=float, default=0.0,
+        help="eject self-registered backends whose registry heartbeat "
+        "is older than this (0 = off; README 'Multi-host')",
     )
     ap_rt.add_argument("--metrics-path", default=None, help=argparse.SUPPRESS)
     ap_rt.add_argument("--trace-path", default=None, help=argparse.SUPPRESS)

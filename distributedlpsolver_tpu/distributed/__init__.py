@@ -1,0 +1,35 @@
+"""Multi-host runtime: `jax.distributed` process groups as first-class
+citizens of the solver and serving stack (README "Multi-host").
+
+Four layers:
+
+- :mod:`distributed.world` — the process-group runtime: env contract
+  (``DLPS_RANK`` / ``DLPS_WORLD_SIZE`` / ``DLPS_COORDINATOR``),
+  ``jax.distributed.initialize`` wiring (gloo CPU collectives on the
+  single-machine harness, TPU pod metadata on real slices), the global
+  mesh, barriers/allgathers, and the per-rank heartbeat files the
+  death detectors read.
+- :mod:`distributed.launcher` — single-machine N-process harness that
+  maps 1:1 onto real TPU pod slices: coordinator address/port
+  allocation, per-process ``JAX_PLATFORMS=cpu`` +
+  ``--xla_force_host_platform_device_count``, rank/world env, log
+  capture, and the coordinator-level recovery supervisor (a dead rank
+  kills the world as a unit — XLA's coordination service terminates
+  survivors — so recovery means relaunching a SMALLER world over the
+  surviving capacity and resuming from the checkpoint-v3 file).
+- :mod:`distributed.worker` — ``python -m …distributed.worker`` rank
+  entry with a small registry of world tasks (sharded/batched solves,
+  recompile probes) used by tests, bench, and the launcher.
+- :mod:`distributed.slice` — one-service-per-slice serving: the
+  rank-0 HTTP front-end dispatches bucket programs onto the slice's
+  global mesh while nonzero ranks run a follower loop off a shared
+  dispatch journal; the slice self-registers into the shared
+  BackendRegistry so routers load-balance across slices.
+"""
+
+from distributedlpsolver_tpu.distributed.world import (  # noqa: F401
+    World,
+    WorldConfig,
+    init_world,
+    world_from_env,
+)

@@ -1,0 +1,266 @@
+"""One-service-per-slice serving: SPMD bucket dispatch across a world.
+
+The serving plane's multi-host unit is a SLICE: one world (N processes
+over one TPU pod slice, or N CPU harness processes) running ONE
+SolveService. Rank 0 owns the HTTP front-end, the scheduler, and the
+demux; every rank — rank 0 included — executes the bucket programs,
+which are compiled against the slice's GLOBAL mesh, so one dispatch
+drives every device of every process (pjit's multi-process contract,
+SNIPPETS.md [2]/[3]).
+
+The control plane is a shared-directory DISPATCH JOURNAL
+(:class:`FileControlPlane`): rank 0 publishes each dispatch — bucket
+meta + the padded host batch + warm lanes — as one atomic ``.npz``;
+followers poll the directory and execute the same
+``solve_bucket``/``solve_pdhg_bucket`` call with identical static
+arguments (their solver config comes from the same CLI flags). The
+collective inside the program is the synchronization point: rank 0
+blocks in XLA until every follower reaches the same dispatch. A
+file-based control plane is deliberate: followers between dispatches
+sit in a cheap poll loop, NOT parked inside a collective — best-effort
+transports time out on collectives held open across an idle serving
+lull, and a real pod's control plane (TCP from worker 0) has the same
+shape. On the single-machine harness the directory is the slice's
+workdir; on a pod it is the slice's shared scratch.
+
+Why rank 0 publishes the whole padded batch: followers must trace and
+execute byte-identical programs, and the payload (a few hundred KB at
+serve shapes) is small against a dispatch. Device placement happens
+per-process (`place_bucket` over the global mesh materializes only the
+process's addressable shards), so no host broadcast of device arrays
+is needed.
+
+Failure semantics: any rank death kills the whole world (see
+distributed/world.py) — the front-end dies WITH its followers, its
+poll URLs survive in the job journal (PR 11), the router ejects the
+slice (heartbeat TTL + failed probes), and the slice supervisor
+relaunches a smaller world on the same port + journal, which replays
+and re-registers. No half-alive slice ever serves.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from distributedlpsolver_tpu.distributed.world import World
+
+# Control-plane record kinds.
+KIND_BUCKET = "bucket"
+KIND_STOP = "stop"
+
+
+def canonical_bucket_config(cfg):
+    """The solver-config normalization the SolveService applies before
+    bucket dispatch — ONE definition so rank 0 (inside the service) and
+    followers (from the same CLI flags) derive byte-identical static
+    arguments for the shared SPMD programs."""
+    return cfg.replace(
+        verbose=False,
+        log_jsonl=None,
+        checkpoint_path=None,
+        checkpoint_every=0,
+        profile_dir=None,
+    )
+
+
+class FileControlPlane:
+    """Atomic-rename dispatch journal under ``dir`` (see module doc).
+
+    Writer (rank 0): ``publish(meta, arrays)`` → strictly increasing
+    sequence numbers. Readers (followers): ``next_dispatch(after)``
+    polls for the next sequence. Records are never mutated; a reader
+    can lag and still replay the exact order.
+    """
+
+    def __init__(self, path: str, poll_s: float = 0.002):
+        self.path = path
+        self.poll_s = poll_s
+        os.makedirs(path, exist_ok=True)
+        self._seq = 0
+
+    def _fname(self, seq: int) -> str:
+        return os.path.join(self.path, f"d{seq:08d}.npz")
+
+    def publish(self, meta: dict, arrays: Optional[dict] = None) -> int:
+        seq = self._seq
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            __meta__=np.frombuffer(
+                json.dumps(meta).encode("utf-8"), dtype=np.uint8
+            ),
+            **(arrays or {}),
+        )
+        tmp = self._fname(seq) + f".{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(buf.getvalue())
+            fh.flush()
+        os.replace(tmp, self._fname(seq))
+        self._seq = seq + 1
+        return seq
+
+    def publish_stop(self) -> int:
+        return self.publish({"kind": KIND_STOP})
+
+    def read(self, seq: int):
+        with np.load(self._fname(seq), allow_pickle=False) as data:
+            meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+            arrays = {
+                k: np.array(data[k]) for k in data.files if k != "__meta__"
+            }
+        return meta, arrays
+
+    def next_dispatch(self, after: int, timeout_s: Optional[float] = None):
+        """Block-poll for sequence ``after + 1``; returns (seq, meta,
+        arrays) or None on timeout. Sequences are dense, so waiting for
+        exactly the next one preserves the dispatch order no matter how
+        far a follower lags."""
+        want = after + 1
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        path = self._fname(want)
+        while not os.path.exists(path):
+            if deadline is not None and time.monotonic() > deadline:
+                return None
+            time.sleep(self.poll_s)
+        # The writer renames atomically, so existence implies integrity.
+        meta, arrays = self.read(want)
+        return want, meta, arrays
+
+
+def execute_dispatch(mesh, solver_config, meta: dict, arrays: dict):
+    """Run one published dispatch — the ONE code path rank 0 and every
+    follower share, so the jit cache key (shapes, shardings, schedule
+    statics) cannot diverge across the world. Returns the
+    BatchedResult (followers drop it; rank 0 demuxes it)."""
+    from distributedlpsolver_tpu.backends.batched import solve_bucket
+    from distributedlpsolver_tpu.backends.first_order import (
+        solve_pdhg_bucket,
+    )
+    from distributedlpsolver_tpu.ipm.state import IPMState
+    from distributedlpsolver_tpu.models.generators import BatchedLP
+
+    cfg = solver_config.replace(tol=float(meta["tol"]))
+    kwargs = {}
+    if meta.get("max_iter"):
+        kwargs["max_iter"] = int(meta["max_iter"])
+    batch = BatchedLP(
+        c=arrays["c"], A=arrays["A"], b=arrays["b"],
+        name=str(meta.get("name", "slice-bucket")),
+    )
+    active = arrays["active"].astype(bool)
+    if meta["engine"] == "pdhg":
+        return solve_pdhg_bucket(batch, active, cfg, mesh=mesh, **kwargs)
+    warm = warm_mask = None
+    if "wx" in arrays:
+        warm = IPMState(
+            x=arrays["wx"], y=arrays["wy"], s=arrays["ws"],
+            w=arrays["ww"], z=arrays["wz"],
+        )
+        warm_mask = arrays["wm"].astype(bool)
+    return solve_bucket(
+        batch, active, cfg, mesh=mesh, warm=warm, warm_mask=warm_mask,
+        **kwargs,
+    )
+
+
+class SliceRunner:
+    """Rank 0's dispatch seam: the SolveService hands every bucket
+    dispatch here instead of placing/solving locally; publish-then-
+    execute keeps the followers in lockstep."""
+
+    def __init__(self, world: World, control: FileControlPlane, solver_config):
+        self.world = world
+        self.control = control
+        self.solver_config = canonical_bucket_config(solver_config)
+        self._mesh = world.mesh(axis="batch")
+        self._lock = threading.Lock()  # publish order == execute order
+        self.dispatches = 0  # guarded-by: _lock
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def dispatch(
+        self,
+        spec,
+        tol: float,
+        engine: str,
+        batch_host,
+        active_host,
+        warm_host=None,
+        warm_mask=None,
+        max_iter: Optional[int] = None,
+    ):
+        """Publish one bucket dispatch and execute it on the global
+        mesh. ``batch_host`` is the padded host BatchedLP, ``warm_host``
+        the host warm-lane IPMState (or None for cold/PDHG)."""
+        meta = {
+            "kind": KIND_BUCKET,
+            "m": int(spec.m),
+            "n": int(spec.n),
+            "batch": int(spec.batch),
+            "tol": float(tol),
+            "engine": engine,
+            "max_iter": int(max_iter) if max_iter else 0,
+            "name": getattr(batch_host, "name", "slice-bucket"),
+        }
+        arrays = {
+            "c": np.asarray(batch_host.c, dtype=np.float64),
+            "A": np.asarray(batch_host.A, dtype=np.float64),
+            "b": np.asarray(batch_host.b, dtype=np.float64),
+            "active": np.asarray(active_host, dtype=bool),
+        }
+        if engine != "pdhg" and warm_host is not None:
+            arrays.update(
+                wx=np.asarray(warm_host.x, dtype=np.float64),
+                wy=np.asarray(warm_host.y, dtype=np.float64),
+                ws=np.asarray(warm_host.s, dtype=np.float64),
+                ww=np.asarray(warm_host.w, dtype=np.float64),
+                wz=np.asarray(warm_host.z, dtype=np.float64),
+                wm=np.asarray(warm_mask, dtype=bool),
+            )
+        with self._lock:
+            meta_out = dict(meta)
+            self.control.publish(meta_out, arrays)
+            self.dispatches += 1
+            return execute_dispatch(
+                self._mesh, self.solver_config, meta, arrays
+            )
+
+    def stop(self) -> None:
+        with self._lock:
+            self.control.publish_stop()
+
+
+def follower_loop(
+    world: World,
+    control: FileControlPlane,
+    solver_config,
+    idle_timeout_s: Optional[float] = None,
+) -> int:
+    """Nonzero ranks' serving loop: execute every published dispatch in
+    order until a stop record (clean shutdown), the idle timeout, or
+    rank-0 death (the world heartbeat monitor exits the process).
+    Returns the number of dispatches executed."""
+    cfg = canonical_bucket_config(solver_config)
+    mesh = world.mesh(axis="batch")
+    seq = -1
+    executed = 0
+    while True:
+        nxt = control.next_dispatch(seq, timeout_s=idle_timeout_s)
+        if nxt is None:
+            return executed
+        seq, meta, arrays = nxt
+        if meta.get("kind") == KIND_STOP:
+            return executed
+        execute_dispatch(mesh, cfg, meta, arrays)
+        executed += 1

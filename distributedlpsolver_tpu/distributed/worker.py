@@ -1,0 +1,210 @@
+"""Rank entry for world tasks: ``python -m …distributed.worker``.
+
+Every rank of a launched world runs this entry with the same argv; the
+env contract (distributed/world.py) tells it who it is. The task
+registry is deliberately small and test/bench-facing — serving ranks
+use ``cli serve-slice`` instead. Each rank writes its result JSON to
+``<out>/rank<k>.json`` (atomic rename) so the launcher can collect and
+cross-check per-rank views (e.g. the bucket program-cache agreement).
+
+Tasks:
+
+``sharded_solve``
+    One dense LP through the sharded backend on the GLOBAL mesh —
+    the ``mpirun -np N`` analogue of the reference run. The variable
+    axis spans every device of every process; the per-iteration Schur
+    contraction's all-reduce crosses the process boundary (gloo on the
+    CPU harness, ICI/DCN on a pod). Convergence tests are computed
+    inside the same SPMD program (psum-reduced norms), so every rank
+    sees identical StepStats and the solve terminates in lockstep.
+    ``checkpoint``/``checkpoint_every`` in the spec exercise the
+    host-canonical checkpoint path (a collective gather per save —
+    every rank writes the same bytes through an atomic rename), which
+    is what the coordinator-level recovery resumes from.
+
+``bucket_probe``
+    The serving fast path's cross-process invariants: place a bucket
+    over the global batch-axis mesh, dispatch it twice with different
+    payloads, and assert ZERO warm recompiles on every rank plus
+    world-wide agreement of ``bucket_cache_size()`` (a rank whose
+    program cache diverged compiled something its peers did not — the
+    one-program-per-bucket contract would be silently broken on a pod).
+
+``scenario_lanes``
+    The scenario backend's Schur lane axis sharded over the global
+    mesh via its existing ``mesh=`` seam (PR 12 follow-on): solves a
+    two-stage instance with the vmapped per-scenario blocks spanning
+    processes and returns the objective for equivalence checks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict
+
+from distributedlpsolver_tpu.distributed.world import (
+    World,
+    world_from_env,
+)
+
+TASKS: Dict[str, Callable[[World, dict], dict]] = {}
+
+
+def task(name: str):
+    def deco(fn):
+        TASKS[name] = fn
+        return fn
+
+    return deco
+
+
+@task("sharded_solve")
+def sharded_solve(world: World, spec: dict) -> dict:
+    from distributedlpsolver_tpu.ipm import solve
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.models.generators import (
+        random_dense_lp,
+        storm_sparse_lp,
+    )
+
+    if spec.get("instance") == "storm":
+        # The bench row's instance: storm-class bordered two-stage
+        # profile (densified by the sharded backend at setup).
+        problem = storm_sparse_lp(
+            int(spec.get("scenarios", 8)),
+            block_m=int(spec.get("block_m", 24)),
+            block_n=int(spec.get("block_n", 36)),
+            first_stage_n=int(spec.get("first_stage_n", 24)),
+            seed=int(spec.get("seed", 0)),
+        )
+    else:
+        problem = random_dense_lp(
+            int(spec.get("m", 48)),
+            int(spec.get("n", 128)),
+            seed=int(spec.get("seed", 0)),
+        )
+    cfg = SolverConfig(
+        tol=float(spec.get("tol", 1e-8)),
+        max_iter=int(spec.get("max_iter", 200)),
+        verbose=False,
+        checkpoint_path=spec.get("checkpoint") or None,
+        checkpoint_every=int(spec.get("checkpoint_every", 0)),
+    )
+    t0 = time.perf_counter()
+    result = solve(problem, backend=spec.get("backend", "sharded"), config=cfg)
+    wall = time.perf_counter() - t0
+    return {
+        "status": result.status.value,
+        "objective": result.objective,
+        "iterations": result.iterations,
+        "rel_gap": result.rel_gap,
+        "pinf": result.pinf,
+        "dinf": result.dinf,
+        "wall_s": round(wall, 3),
+    }
+
+
+@task("bucket_probe")
+def bucket_probe(world: World, spec: dict) -> dict:
+    import numpy as np
+
+    from distributedlpsolver_tpu.backends.batched import (
+        bucket_cache_size,
+        place_bucket,
+        solve_bucket,
+    )
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.models.generators import random_batched_lp
+
+    m = int(spec.get("m", 8))
+    n = int(spec.get("n", 24))
+    B = int(spec.get("batch", 8))
+    cfg = SolverConfig(tol=float(spec.get("tol", 1e-8)), verbose=False)
+    mesh = world.mesh(axis="batch")
+    active = np.ones(B, dtype=bool)
+
+    objectives = []
+    cache_after_first = 0
+    for i, seed in enumerate((int(spec.get("seed", 7)), int(spec.get("seed", 7)) + 1)):
+        batch = random_batched_lp(B, m, n, seed=seed)
+        placed, act = place_bucket(batch, active, cfg, mesh=mesh)
+        res = solve_bucket(placed, act, cfg, mesh=mesh)
+        objectives.append([float(v) for v in res.objective])
+        if i == 0:
+            cache_after_first = bucket_cache_size()
+    compiled_warm = bucket_cache_size() - cache_after_first
+    # Cross-process zero-warm-recompile check: the cache must not have
+    # grown on the SECOND dispatch on any rank, and every rank's total
+    # must agree (rank-0 gather; collective — raises on disagreement).
+    sizes = world.agree(bucket_cache_size(), what="bucket_cache_size")
+    return {
+        "objectives_first": objectives[0],
+        "objectives_second": objectives[1],
+        "warm_recompiles": int(compiled_warm),
+        "bucket_cache_sizes": sizes,
+    }
+
+
+@task("scenario_lanes")
+def scenario_lanes(world: World, spec: dict) -> dict:
+    from distributedlpsolver_tpu.backends.scenario import ScenarioBackend
+    from distributedlpsolver_tpu.ipm.driver import solve
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.models.scenario import two_stage_storm
+
+    slp = two_stage_storm(
+        int(spec.get("scenarios", 8)),
+        block_m=int(spec.get("m", 6)),
+        block_n=int(spec.get("n", 14)),
+        seed=int(spec.get("seed", 3)),
+    )
+    cfg = SolverConfig(tol=float(spec.get("tol", 1e-8)), verbose=False)
+    # The Schur lane axis rides the existing mesh= seam — here over the
+    # GLOBAL mesh, so the vmapped per-scenario blocks span processes.
+    be = ScenarioBackend(mesh=world.mesh(axis="batch"))
+    result = solve(slp.to_block_angular(), backend=be, config=cfg)
+    return {
+        "status": result.status.value,
+        "objective": result.objective,
+        "iterations": result.iterations,
+    }
+
+
+def _write_result(out_dir: str, rank: int, payload: dict) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"rank{rank}.json")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dlps-world-worker")
+    ap.add_argument("--task", required=True, choices=sorted(TASKS))
+    ap.add_argument("--spec-json", default="{}")
+    ap.add_argument("--out", required=True, help="per-rank result dir")
+    args = ap.parse_args(argv)
+
+    world = world_from_env()
+    world.start_heartbeat()
+    try:
+        spec = json.loads(args.spec_json)
+        result = TASKS[args.task](world, spec)
+        result.update(world.describe())
+        # Completion barrier BEFORE results land: a rank must not
+        # declare success while a peer can still fail the collective
+        # program they shared.
+        world.barrier("task-done")
+        _write_result(args.out, world.rank, result)
+    finally:
+        world.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

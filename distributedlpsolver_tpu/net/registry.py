@@ -236,6 +236,67 @@ class BackendRegistry:
 
         return self.update(_mutate)
 
+    def register(
+        self,
+        url: str,
+        slice_id: Optional[str] = None,
+        world_size: Optional[int] = None,
+    ) -> bool:
+        """A SERVING process announces itself: ensure the entry exists,
+        stamp its slice identity, and write the first heartbeat. Never
+        clears an ejection (the resurrection rule — a restarted slice
+        re-enters rotation through a router's own fresh probe), so a
+        crash-looping process can't bounce itself back in. Returns True
+        iff the write applied; emits a ``slice_register`` event."""
+        u = url.rstrip("/")
+
+        def _mutate(backends: dict) -> bool:
+            e = backends.get(u)
+            if e is None:
+                e = {
+                    "ejected": False,
+                    "fails": 0,
+                    "ejected_at_ts": 0.0,
+                    "observed_ts": time.time(),
+                }
+                backends[u] = e
+            if slice_id is not None:
+                e["slice_id"] = str(slice_id)
+            if world_size is not None:
+                e["world_size"] = int(world_size)
+            e["last_heartbeat_ts"] = time.time()
+            return True
+
+        applied = self.update(_mutate) is not None
+        if applied and self._logger is not None:
+            self._logger.event(
+                {
+                    "event": "slice_register",
+                    "backend": u,
+                    "slice_id": slice_id,
+                    "world_size": world_size,
+                }
+            )
+        return applied
+
+    def heartbeat(self, url: str) -> bool:
+        """Refresh the serving process's liveness stamp. Routers treat
+        an entry whose ``last_heartbeat_ts`` is older than their
+        ``registry_ttl_s`` as ejected — the deterministic exit from
+        rotation for a kill -9'd slice that never answers another
+        probe. Entries that never heartbeat (classic backends started
+        without registration) are exempt from TTL ejection."""
+        u = url.rstrip("/")
+
+        def _mutate(backends: dict) -> bool:
+            e = backends.get(u)
+            if e is None:
+                return False
+            e["last_heartbeat_ts"] = time.time()
+            return True
+
+        return self.update(_mutate) is not None
+
     def record(
         self,
         url: str,
@@ -263,16 +324,22 @@ class BackendRegistry:
                     # Re-admission evidence predating the ejection —
                     # the cross-process stale-probe guard.
                     return False
-            entry = {
-                "ejected": bool(ejected),
-                "fails": int(fails),
-                "ejected_at_ts": float(
-                    ejected_at_ts
-                    if ejected_at_ts
-                    else (e or {}).get("ejected_at_ts", 0.0)
-                ),
-                "observed_ts": float(observed_ts),
-            }
+            # Update in place over the stored entry: serving-side fields
+            # (slice_id / world_size / last_heartbeat_ts) must survive a
+            # router's observation push.
+            entry = dict(e or {})
+            entry.update(
+                {
+                    "ejected": bool(ejected),
+                    "fails": int(fails),
+                    "ejected_at_ts": float(
+                        ejected_at_ts
+                        if ejected_at_ts
+                        else (e or {}).get("ejected_at_ts", 0.0)
+                    ),
+                    "observed_ts": float(observed_ts),
+                }
+            )
             if ejected and not entry["ejected_at_ts"]:
                 entry["ejected_at_ts"] = observed_ts
             backends[url] = entry

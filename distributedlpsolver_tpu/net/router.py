@@ -84,6 +84,13 @@ class RouterConfig:
     # hammered, a flapping one can't oscillate the registry each tick.
     probe_backoff_base_s: float = 0.5
     probe_backoff_cap_s: float = 30.0
+    # Heartbeat TTL over registry entries that REGISTERED themselves
+    # (cli serve-slice stamps last_heartbeat_ts every beat): an entry
+    # whose heartbeat is older than this leaves rotation as an ejection
+    # (counted in registry_expired_total) even if no probe has failed
+    # yet — the deterministic exit for a kill -9'd slice. 0 disables;
+    # entries that never heartbeat are exempt either way.
+    registry_ttl_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -127,6 +134,10 @@ class BackendState:
     # moment the next probe is allowed.
     backoff_s: float = 0.0
     next_probe: float = 0.0
+    # Last heartbeat the serving process itself wrote into the shared
+    # registry (0 = this backend never registered/heartbeat — exempt
+    # from TTL ejection). Wall clock, adopted on registry pulls.
+    last_heartbeat_ts: float = 0.0
 
 
 class Router:
@@ -179,7 +190,11 @@ class Router:
         else:
             self._registry = None
             self._registry_version = 0
-        if not self._backends:
+        if not self._backends and self._registry is None:
+            # With a shared registry the table may legitimately start
+            # empty: slices self-register as they come up (cli
+            # serve-slice) and the pull adopts them — zero manual
+            # backend config is the multi-host contract.
             raise ValueError(
                 "router needs at least one backend URL (from the "
                 "constructor or the shared registry)"
@@ -236,6 +251,7 @@ class Router:
         that is the re-admission path, paced by their backoff window)
         + /readyz, and refresh /statusz for the healthy ones."""
         self._sync_registry_pull()
+        self._expire_stale_heartbeats()
         now = time.perf_counter()
         with self._lock:
             urls = [
@@ -264,6 +280,50 @@ class Router:
                 stz = self._fetch_json(url + "/statusz")
             self._record_probe(url, ok, stz, t_start, ready=ready)
 
+    def _expire_stale_heartbeats(self) -> None:
+        """Heartbeat-TTL ejection (registry satellite): a backend whose
+        serving process registered itself but whose last heartbeat is
+        older than ``registry_ttl_s`` leaves rotation NOW — kill -9'd
+        slices exit deterministically at the TTL instead of whenever
+        ``eject_after`` probes happen to have failed. Runs on the
+        CACHED heartbeat stamps: a dead slice stops moving the registry
+        version, so the pull path alone would never re-examine it."""
+        ttl = self.config.registry_ttl_s
+        if ttl <= 0:
+            return
+        now_wall = time.time()
+        expired = []
+        with self._lock:
+            for url, st in self._backends.items():
+                if st.ejected or st.last_heartbeat_ts <= 0.0:
+                    continue
+                if now_wall - st.last_heartbeat_ts <= ttl:
+                    continue
+                st.fails += 1
+                st.healthy = False
+                st.ejected = True
+                st.ejected_at = time.perf_counter()
+                st.ejected_at_ts = now_wall
+                st.observed_ts = now_wall
+                self._bump_backoff(st, time.perf_counter())
+                self._gauge_for(url).set(0.0)
+                expired.append((url, self._snapshot_for_registry(st)))
+        if expired:
+            self.metrics.counter(
+                "registry_expired_total",
+                help="backends ejected because their registry heartbeat "
+                "aged past registry_ttl_s",
+            ).inc(len(expired))
+        for url, push in expired:
+            self._logger.event(
+                {
+                    "event": "backend_ejected",
+                    "backend": url,
+                    "reason": "heartbeat_ttl",
+                }
+            )
+            self._registry_push(push)
+
     # -- shared-registry sync ---------------------------------------------
 
     def _sync_registry_pull(self) -> None:
@@ -285,6 +345,12 @@ class Router:
                 if st is None:
                     st = BackendState(url=url)
                     self._backends[url] = st
+                # Heartbeats are liveness, not eject-state observations:
+                # adopt the freshest stamp unconditionally (the serving
+                # process writes it; no router ever competes on it).
+                hb = float(entry.get("last_heartbeat_ts", 0.0))
+                if hb > st.last_heartbeat_ts:
+                    st.last_heartbeat_ts = hb
                 obs = float(entry.get("observed_ts", 0.0))
                 if obs <= st.observed_ts:
                     continue  # our own view is as fresh or fresher

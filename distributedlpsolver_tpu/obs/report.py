@@ -192,9 +192,12 @@ def build_report(
         by_kind[f.get("kind", "?")] = by_kind.get(f.get("kind", "?"), 0) + 1
         a = f.get("action") or "?"
         by_action[a] = by_action.get(a, 0) + 1
+    # world_reinit events (distributed/launcher: coordinator-level
+    # world re-initializations) carry recovery_overhead_s exactly like
+    # resume events — the multi-host rung joins the same summary.
     overheads = [
         float(r["recovery_overhead_s"])
-        for r in resumes
+        for r in resumes + events.get("world_reinit", [])
         if r.get("recovery_overhead_s") is not None
     ]
     report["faults"] = {
@@ -208,6 +211,7 @@ def build_report(
     }
     report["recovery"] = {
         "resumes": len(resumes),
+        "world_reinits": len(events.get("world_reinit", [])),
         "overhead_s": summarize(overheads),
         "overhead_s_total": round(sum(overheads), 6),
     }

@@ -64,7 +64,10 @@ def chol_tri_inv_mesh(Ms, shard: NamedSharding, panel: int = 256):
     pad carries an identity tail, sliced off at the end) so every panel
     lies inside one device's slab.
     """
-    from jax import shard_map
+    from distributedlpsolver_tpu.parallel.mesh import (
+        pvary_compat,
+        shard_map_compat,
+    )
 
     mesh = shard.mesh
     axis = _axis_of(shard)
@@ -125,9 +128,7 @@ def chol_tri_inv_mesh(Ms, shard: NamedSharding, panel: int = 256):
             Lpan = jnp.where(mine > 0, Lpan, cur)  # non-owners keep slab
             return jax.lax.dynamic_update_slice(Lloc, Lpan, (0, lc))
 
-        init = jax.lax.pcast(
-            jnp.zeros((mp, w), Msloc.dtype), (axis,), to="varying"
-        )
+        init = pvary_compat(jnp.zeros((mp, w), Msloc.dtype), (axis,))
         Lloc = jax.lax.fori_loop(0, P, factor_panel, init)
 
         # ---- distributed inversion: solve L·X = I_slab for this
@@ -157,7 +158,7 @@ def chol_tri_inv_mesh(Ms, shard: NamedSharding, panel: int = 256):
 
         return jax.lax.fori_loop(0, P, subst_panel, X0)
 
-    Linv = shard_map(
+    Linv = shard_map_compat(
         device_fn,
         mesh=mesh,
         in_specs=(PartitionSpec(None, axis),),

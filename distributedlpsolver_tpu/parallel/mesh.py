@@ -111,6 +111,167 @@ def reform_mesh(
     return Mesh(np.array(survivors), (name,))
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` across jax generations (HiOp-style portability —
+    the harness must not be hostage to one jax release): newer jax
+    exports ``jax.shard_map`` with the varying-types system; 0.4.x has
+    ``jax.experimental.shard_map.shard_map``, where device-varying
+    outputs need ``check_rep=False`` instead of explicit pcast/pvary
+    marks."""
+    try:
+        from jax import shard_map as _sm  # jax >= 0.6 surface
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
+def pvary_compat(x, axes):
+    """Mark ``x`` device-varying over ``axes`` inside a shard_map body.
+    Newer jax requires the explicit cast (``jax.lax.pcast``); on 0.4.x
+    the experimental shard_map runs with ``check_rep=False`` (see
+    :func:`shard_map_compat`) and needs no mark."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axes, to="varying")
+
+
+def is_multiprocess(mesh: Optional[Mesh]) -> bool:
+    """True iff ``mesh`` spans devices of more than one process — the
+    predicate every placement/fetch helper keys multi-host behavior on
+    (single-process meshes keep the classic device_put/np.asarray
+    paths, byte for byte)."""
+    if mesh is None:
+        return False
+    procs = {d.process_index for d in mesh.devices.flat}
+    return len(procs) > 1
+
+
+def put_global(x, sharding: NamedSharding):
+    """Place host data onto a (possibly multi-process) sharding.
+
+    Every process calls this with the SAME host value (the multi-host
+    SPMD contract — the world/slice control plane replicates the host
+    batch before placement); each process materializes only its
+    addressable shards, so no cross-process traffic happens here. On a
+    single-process sharding this is exactly ``jax.device_put``.
+
+    jax's ``device_put`` accepts numpy + cross-process shardings on the
+    versions this repo supports, but routes through a slow generic path
+    on some; ``make_array_from_callback`` is the documented per-shard
+    construction and is used whenever the sharding is not fully
+    addressable.
+    """
+    import numpy as _np
+
+    if all(
+        d.process_index == jax.process_index()
+        for d in sharding.mesh.devices.flat
+    ):
+        return jax.device_put(x, sharding)
+    arr = _np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def _needs_gather(arr) -> bool:
+    return isinstance(arr, jax.Array) and not (
+        arr.is_fully_addressable or arr.is_fully_replicated
+    )
+
+
+# One replicating gather program per replicated target sharding (i.e.
+# per mesh); jax's own dispatch cache keys the shapes. The program
+# flattens every operand to (lead, -1) float64 and CONCATENATES before
+# replicating, so it contains exactly ONE collective: XLA CPU executes
+# independent collectives of one program concurrently, and best-effort
+# transports (gloo) have been observed cross-pairing those concurrent
+# ops (mismatched message sizes, whole-world abort) — a single fused
+# all-gather leaves nothing to race.
+_GATHER_JITS: dict = {}
+
+
+def _gather_fn(rep: NamedSharding):
+    fn = _GATHER_JITS.get(rep)
+    if fn is None:
+        import jax.numpy as jnp
+
+        def _fused(*xs):
+            flat = [
+                x.reshape(x.shape[0], -1).astype(jnp.float64) for x in xs
+            ]
+            return jnp.concatenate(flat, axis=1)
+
+        # Memoized per replicated sharding in the module-level dict
+        # above — out_shardings is part of the jit construction, so a
+        # module-level single jit cannot express the per-mesh target;
+        # the wrapper (and its trace cache) lives for the process.
+        fn = jax.jit(_fused, out_shardings=rep)  # graftcheck: disable=jit-nonhoisted (memoized per mesh)
+        _GATHER_JITS[rep] = fn
+    return fn
+
+
+def host_values(arrays: Sequence) -> list:
+    """Fetch a BATCH of arrays to host numpy regardless of placement.
+
+    ``np.asarray`` handles numpy inputs, single-process device arrays,
+    and fully-replicated global arrays. Arrays sharded over a
+    multi-process mesh are not fully addressable and must be gathered:
+    same-sharding same-leading-dim groups ride ONE single-collective
+    program each (see ``_gather_fn``), forced to completion before the
+    next group launches, so a demux of a dozen result fields costs one
+    ordered collective instead of a dozen racing ones. Every rank
+    reaches the fetch at the same point (they just ran the same SPMD
+    program) — the collective is safe by the module's SPMD contract.
+
+    float64 round-trip: gathered values are cast to f64 on device and
+    back to their dtype on host — exact for every dtype the solver
+    demuxes (f64/f32 floats, small int32 counters, bools).
+    """
+    import numpy as _np
+
+    arrs = list(arrays)
+    idx = [i for i, a in enumerate(arrs) if _needs_gather(a)]
+    if idx:
+        groups: dict = {}
+        for i in idx:
+            a = arrs[i]
+            groups.setdefault((a.sharding, a.shape[0]), []).append(i)
+        for (shd, _lead), pos in groups.items():
+            rep = NamedSharding(shd.mesh, PartitionSpec())
+            widths = [
+                int(_np.prod(arrs[i].shape[1:], dtype=_np.int64))
+                if arrs[i].ndim > 1
+                else 1
+                for i in pos
+            ]
+            packed = _np.asarray(_gather_fn(rep)(*(arrs[i] for i in pos)))
+            off = 0
+            for i, w in zip(pos, widths):
+                a = arrs[i]
+                arrs[i] = (
+                    packed[:, off : off + w]
+                    .reshape(a.shape)
+                    .astype(a.dtype)
+                )
+                off += w
+    return [_np.asarray(a) for a in arrs]
+
+
+def host_value(arr):
+    """Fetch one array to host numpy regardless of placement — see
+    :func:`host_values` (prefer it when fetching several at once: one
+    collective program for the whole batch)."""
+    return host_values([arr])[0]
+
+
 def batch_sharding(mesh: Mesh, ndim: int, axis: str = "batch") -> NamedSharding:
     """Leading-axis sharding for an ``ndim``-dim array — the data-parallel
     placement of the batched and serving paths: the batch axis is split

@@ -177,9 +177,21 @@ def probe_devices(
 
     ``devices=None`` probes every local device. The supervisor feeds the
     unhealthy set to ``reform_mesh(exclude=...)`` to rebuild the mesh
-    over the survivors."""
+    over the survivors.
+
+    Multi-process guard: only ADDRESSABLE (process-local) devices are
+    ever pinged. Under a ``jax.distributed`` world, ``mesh.devices``
+    spans every process, and a ``device_put`` onto another process's
+    device from here either fails or — worse — enters a collective no
+    other rank is running and hangs the probe thread past any deadline.
+    Remote devices are silently skipped: they appear in NEITHER list
+    (this rank has no evidence about them; rank-death detection is the
+    world heartbeat's job, distributed/world.py)."""
     devs = list(devices if devices is not None else jax.local_devices())
+    my_proc = jax.process_index()
     healthy, unhealthy = [], []
     for d in devs:
+        if getattr(d, "process_index", my_proc) != my_proc:
+            continue  # not addressable from this rank — no evidence
         (healthy if probe_device(d, deadline) else unhealthy).append(d)
     return healthy, unhealthy

@@ -267,6 +267,8 @@ class SolveService:
         auto_start: bool = True,
         metrics: Optional[obs_metrics.MetricsRegistry] = None,
         tracer=None,
+        mesh=None,
+        slice_runner=None,
     ):
         self.config = config or ServiceConfig()
         # The bucket path solves raw standard form — presolve/scaling and
@@ -396,7 +398,28 @@ class SolveService:
         # Read-only surface for the HTTP front-end (shared tenant
         # labeler) and introspection; None without the SLO layer.
         self.admission = self._admission
-        self._mesh = self._build_mesh(self.config.mesh_devices)  # guarded-by: _lock
+        # Multi-host slice mode (distributed/slice.py): an explicit
+        # slice_runner routes every bucket dispatch through the slice
+        # control plane so follower ranks execute the same programs; an
+        # explicit mesh (usually the runner's global mesh) overrides the
+        # local mesh_devices construction. Bucket batch divisibility is
+        # enforced against the GLOBAL device count.
+        self._slice = slice_runner
+        if slice_runner is not None and mesh is None:
+            mesh = slice_runner.mesh
+        if slice_runner is not None and self.config.solo_backend == "auto":
+            # Solo fallbacks run on rank 0 ONLY (no follower mirrors a
+            # solo solve): pin them to the single-device dense backend —
+            # "auto" could pick a mesh backend over the GLOBAL device
+            # set and enter a collective no other rank is running.
+            self.config = dataclasses.replace(
+                self.config, solo_backend="dense"
+            )
+        self._mesh = (  # guarded-by: _lock
+            mesh
+            if mesh is not None
+            else self._build_mesh(self.config.mesh_devices)
+        )
         n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
         self.scheduler = Scheduler(  # guarded-by: _lock
             BucketTable(
@@ -1119,6 +1142,24 @@ class SolveService:
         with self._lock:
             mesh = self._mesh
         cfg = self.solver_config.replace(tol=tol)
+        if self._slice is not None:
+            # Slice mode: the batch stays HOST-side — the dispatch seam
+            # publishes it to the follower ranks and every rank (0
+            # included) places its own addressable shards at execute
+            # time. The pad/stack/mask work above is still the pack
+            # stage's overlap win; only the device transfer moves.
+            pack_ms = (time.perf_counter() - t0) * 1e3
+            return _Packed(
+                batch=batch,
+                active=active,
+                waste=padding_waste(sum(p.m * p.n for p in live), spec),
+                pack_ms=pack_ms,
+                mesh=mesh,
+                warm=None,
+                warm_mask=warm_mask,  # host (B,) bool mask
+                warm_hits=warm_hits,
+                warm_host=warm_states,
+            )
         placed, act = place_bucket(batch, active, cfg, mesh=mesh)
         warm_placed = mask_placed = None
         if warm_states is not None:
@@ -1210,6 +1251,12 @@ class SolveService:
             return
         wm = np.zeros(spec.batch, dtype=bool)
         wm[: len(hits)] = hits
+        if self._slice is not None:
+            # Slice mode keeps host lanes; the dispatch seam publishes
+            # the patched warm_host + mask — re-placement happens on
+            # every rank at execute time.
+            packed.warm_mask = wm
+            return
         packed.warm, packed.warm_mask = place_warm(
             st, wm, (spec.batch, spec.m, spec.n),
             self.solver_config.replace(tol=tol), mesh=mesh,
@@ -1361,9 +1408,18 @@ class SolveService:
                         f"compile {spec.m}x{spec.n}x{spec.batch}/{engine}",
                         cat="pipeline",
                     ):
-                        solve_engine_fn(
-                            batch, active, cfg, mesh=mesh, max_iter=1
-                        )
+                        if self._slice is not None:
+                            # Every rank of the slice must compile this
+                            # program: the warm-up rides the dispatch
+                            # seam like any other bucket call.
+                            self._slice.dispatch(
+                                spec, tol, engine, batch, active,
+                                max_iter=1,
+                            )
+                        else:
+                            solve_engine_fn(
+                                batch, active, cfg, mesh=mesh, max_iter=1
+                            )
                     compile_ms = (time.perf_counter() - t0) * 1e3
                     new_programs = bucket_cache_size() - size0
                     self._m_compiles.inc(new_programs)
@@ -1372,6 +1428,14 @@ class SolveService:
                         self._compiles += new_programs
 
                 def _solve():
+                    if self._slice is not None:
+                        return self._slice.dispatch(
+                            spec, tol, engine, batch, active,
+                            warm_host=(
+                                None if engine == "pdhg" else packed.warm_host
+                            ),
+                            warm_mask=packed.warm_mask,
+                        )
                     if engine == "pdhg":
                         return solve_pdhg_bucket(batch, active, cfg, mesh=mesh)
                     return solve_bucket(
@@ -1866,6 +1930,16 @@ class SolveService:
         in-flight and future dispatches stay shardable; at 1 the mesh is
         dropped and dispatch continues unsharded. Batches already packed
         on the old mesh finish there. Returns the new device count."""
+        if self._slice is not None:
+            # A slice's mesh spans PROCESSES: losing part of it kills
+            # the world as a unit (distributed/world.py), and recovery
+            # is the launcher-level world re-initialization — there is
+            # no live re-shard seam inside a dead world.
+            raise RuntimeError(
+                "reshard() is not available in slice mode — multi-host "
+                "device loss is recovered by the world supervisor "
+                "(relaunch a smaller world; see README 'Multi-host')"
+            )
         with self._lock:
             mesh = self._mesh
         if mesh is None:
@@ -2026,15 +2100,22 @@ class SolveService:
                 # shape: max_iter is traced, so this max_iter=1 call
                 # compiles the same executable real dispatches reuse.
                 dummy = random_batched_lp(spec.batch, spec.m, spec.n, seed=0)
-                placed, act = place_bucket(
-                    dummy, np.ones(spec.batch, dtype=bool), cfg, mesh=mesh
-                )
+                act_host = np.ones(spec.batch, dtype=bool)
                 fn = solve_pdhg_bucket if engine == "pdhg" else solve_bucket
                 size0 = bucket_cache_size()
                 cache_dir, entries0 = self._cache_dir_snapshot()
                 t0 = time.perf_counter()
                 try:
-                    fn(placed, act, cfg, mesh=mesh, max_iter=1)
+                    if self._slice is not None:
+                        # Warm every RANK of the slice: the warm-up is a
+                        # published dispatch, so followers compile the
+                        # same executable before live traffic arrives.
+                        self._slice.dispatch(
+                            spec, tol, engine, dummy, act_host, max_iter=1
+                        )
+                    else:
+                        placed, act = place_bucket(dummy, act_host, cfg, mesh=mesh)
+                        fn(placed, act, cfg, mesh=mesh, max_iter=1)
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception as e:  # warm-up failure: traffic pays later

@@ -591,6 +591,8 @@ def _emit_fault(events: Optional[IterLogger], fault: FaultRecord) -> None:
     )
     if events is None:
         return
+    import jax
+
     events.event(
         {
             "event": "fault",
@@ -601,6 +603,11 @@ def _emit_fault(events: Optional[IterLogger], fault: FaultRecord) -> None:
             "devices": list(fault.devices),
             "detail": fault.detail[:300],
             "t": fault.at_time,
+            # Which PROCESS observed/attributed this fault: device probes
+            # only ever ping addressable devices (parallel/runtime.py), so
+            # under a multi-process world the device list above is this
+            # rank's local evidence, not a global verdict.
+            "rank": jax.process_index(),
         }
     )
 

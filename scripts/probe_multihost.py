@@ -1,0 +1,381 @@
+"""Multi-host acceptance probe: a router over TWO SLICES — each a
+2-process `jax.distributed` world serving one HTTP front-end over its
+global mesh — with one slice killed mid-run and recovered by a
+coordinator-level world re-initialization (README "Multi-host").
+
+Topology (all on this machine — the single-machine harness maps 1:1
+onto two TPU pod slices):
+
+    router (cli route --registry R --registry-ttl-s T)   ← no --backend!
+      ├─ slice A: cli serve-slice --world-size 2  (self-registers in R)
+      └─ slice B: cli serve-slice --world-size 2  (self-registers in R)
+
+Checks:
+  - the router adopts both slices from the shared registry with ZERO
+    manual backend config (slice self-registration);
+  - requests routed through both slices solve OPTIMAL on the slices'
+    multi-process meshes;
+  - mid-run, one rank of slice B is SIGKILLed: the whole world dies as
+    a unit (coordination-service semantics), the router ejects B
+    (failed probe and/or registry heartbeat TTL), traffic keeps
+    flowing through A with ZERO lost acknowledged requests;
+  - slice B's supervisor re-initializes a SMALLER world (size 1) on
+    the same port + journal (a `world_reinit` event with
+    `recovery_overhead_s` lands in its world.jsonl), the router
+    re-admits it, and it serves again;
+  - every async poll URL minted BEFORE the kill resolves honestly
+    after recovery (journal replay; router async fan-out);
+  - zero warm recompiles at steady state on every surviving front-end
+    (programs_compiled flat across a verification wave).
+
+Run: python scripts/probe_multihost.py [--requests N] [--budget-s S]
+Exit 0 iff every check passes.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SHAPE = (8, 24)  # one bucket; process startup, not solving, is the cost
+BUCKET = {"m": 8, "n": 24, "batch": 8}
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_json(url, body=None, timeout=60.0):
+    req = urllib.request.Request(
+        url,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:
+            return e.code, {}
+    except (urllib.error.URLError, OSError, ConnectionError, ValueError):
+        return None, {}
+
+
+def wait_200(url, budget, alive=lambda: True):
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        st, _ = http_json(url, timeout=2.0)
+        if st == 200:
+            return True
+        if not alive():
+            return False
+        time.sleep(0.2)
+    return False
+
+
+def spawn_slice(workdir, name, port, registry, ladder):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each rank pins its own device count
+    log = open(os.path.join(workdir, f"{name}.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distributedlpsolver_tpu.cli",
+            "serve-slice",
+            "--world-size", "2",
+            "--local-devices", "2",
+            "--port", str(port),
+            "--slice-id", name,
+            "--registry", registry,
+            "--heartbeat-s", "0.25",
+            "--slice-workdir", os.path.join(workdir, f"{name}-world"),
+            "--journal-dir", os.path.join(workdir, f"{name}-journal"),
+            "--buckets", ladder,
+            "--warm-buckets",
+            "--batch", "8",
+            "--flush-ms", "20",
+            "--quiet",
+        ],
+        stdout=log, stderr=subprocess.STDOUT, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return proc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--budget-s", type=float, default=420.0)
+    ap.add_argument("--keep", action="store_true")
+    args = ap.parse_args()
+    t_start = time.monotonic()
+    workdir = tempfile.mkdtemp(prefix="dlps-probe-multihost-")
+    registry = os.path.join(workdir, "registry.json")
+    ladder = os.path.join(workdir, "ladder.json")
+    with open(ladder, "w") as fh:
+        fh.write(json.dumps([BUCKET]))
+    procs = {}
+    failures = []
+
+    def check(ok, what):
+        tag = "ok" if ok else "FAIL"
+        print(f"  [{tag}] {what}")
+        if not ok:
+            failures.append(what)
+
+    try:
+        pa, pb, pr = free_port(), free_port(), free_port()
+        ua, ub = f"http://127.0.0.1:{pa}", f"http://127.0.0.1:{pb}"
+        procs["sliceA"] = spawn_slice(workdir, "sliceA", pa, registry, ladder)
+        procs["sliceB"] = spawn_slice(workdir, "sliceB", pb, registry, ladder)
+        for name, url in (("sliceA", ua), ("sliceB", ub)):
+            ok = wait_200(
+                url + "/healthz", 180,
+                alive=lambda n=name: procs[n].poll() is None,
+            )
+            check(ok, f"{name} world up and serving on its global mesh")
+        if failures:
+            return 1
+
+        # Router learns both slices from the registry alone.
+        rlog = open(os.path.join(workdir, "router.log"), "ab")
+        procs["router"] = subprocess.Popen(
+            [
+                sys.executable, "-m", "distributedlpsolver_tpu.cli",
+                "route",
+                "--registry", registry,
+                "--registry-ttl-s", "2.0",
+                "--poll-s", "0.25",
+                "--port", str(pr),
+            ],
+            stdout=rlog, stderr=subprocess.STDOUT, env=dict(os.environ),
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        router = f"http://127.0.0.1:{pr}"
+        deadline = time.monotonic() + 60
+        adopted = False
+        while time.monotonic() < deadline:
+            st, o = http_json(router + "/statusz", timeout=2.0)
+            if st == 200:
+                healthy = [
+                    b for b in (o.get("backends") or [])
+                    if b.get("healthy")
+                ]
+                if len(healthy) >= 2:
+                    adopted = True
+                    break
+            time.sleep(0.25)
+        check(adopted, "router adopted both slices from the registry "
+                       "(no --backend config)")
+        if not adopted:
+            return 1
+
+        # ---- request stream with a mid-run slice-B kill --------------
+        n = args.requests
+        kill_at = n // 2
+        sync_ok = 0
+        rejects = 0
+        async_ids = []
+        killed_ts = None
+        m, nn = SHAPE
+        for i in range(n):
+            if i == kill_at:
+                # SIGKILL one RANK of slice B: the whole world must die
+                # as a unit; the supervisor then re-initializes a
+                # world of 1 on the same port + journal.
+                hb = json.load(
+                    open(os.path.join(
+                        workdir, "sliceB-world", "hb-gen0", "rank1.hb"
+                    ))
+                )
+                os.kill(hb["pid"], signal.SIGKILL)
+                killed_ts = time.monotonic()
+                print(f"  -- killed sliceB rank1 (pid {hb['pid']}) "
+                      f"at request {i}")
+            body = {"m": m, "n": nn, "seed": 100 + i, "tol": 1e-8}
+            # Honest rejects are NOT lost acks: while a slice world
+            # re-initializes the router may 503 (empty rotation) — the
+            # contract is that a retrying client is never LIED to, so
+            # each request retries until acknowledged (200/202) within
+            # its own window.
+            is_async = i % 6 == 5
+            if is_async:
+                body["async"] = True
+            acked = False
+            deadline_i = time.monotonic() + 90
+            while time.monotonic() < deadline_i:
+                st, o = http_json(router + "/v1/solve", body, timeout=120)
+                if is_async and st == 202 and o.get("id"):
+                    async_ids.append(o["id"])
+                    acked = True
+                    break
+                if st == 200 and o.get("status") == "optimal":
+                    sync_ok += 1
+                    acked = True
+                    break
+                if st in (503, None) or (st == 429):
+                    rejects += 1
+                    time.sleep(0.5)
+                    continue
+                break  # anything else is a hard failure for this request
+            if not acked:
+                check(False, f"request {i}: {st} {o.get('status')}")
+        check(sync_ok == n - len(async_ids),
+              f"zero lost acknowledged sync requests across the kill "
+              f"({sync_ok} optimal, {rejects} honest rejects retried)")
+
+        # ---- slice B ejected, then re-initialized + re-admitted ------
+        deadline = time.monotonic() + 180
+        readmitted = False
+        while time.monotonic() < deadline:
+            st, o = http_json(router + "/statusz", timeout=2.0)
+            if st == 200:
+                b = next(
+                    (x for x in (o.get("backends") or [])
+                     if x.get("url") == ub),
+                    {},
+                )
+                if b.get("healthy"):
+                    readmitted = True
+                    break
+            time.sleep(0.3)
+        check(
+            readmitted,
+            "slice B re-initialized (smaller world) and re-admitted "
+            + (f"({time.monotonic() - killed_ts:.1f}s after kill)"
+               if killed_ts else ""),
+        )
+        wr_path = os.path.join(workdir, "sliceB-world", "world.jsonl")
+        reinits = []
+        if os.path.exists(wr_path):
+            reinits = [
+                json.loads(line)
+                for line in open(wr_path)
+                if '"world_reinit"' in line
+            ]
+        check(
+            bool(reinits)
+            and reinits[0].get("world_size") == 1
+            and reinits[0].get("recovery_overhead_s", -1) >= 0,
+            f"world_reinit event with recovery_overhead_s "
+            f"({[ (r.get('world_size'), r.get('recovery_overhead_s')) for r in reinits ]})",
+        )
+
+        # ---- every pre/post-kill async poll URL resolves honestly ----
+        resolved = 0
+        for jid in async_ids:
+            got = None
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                st, o = http_json(
+                    f"{router}/v1/solve/{jid}", timeout=5.0
+                )
+                if st == 200 and o.get("status"):
+                    got = o["status"]
+                    break
+                if st == 404:
+                    break
+                time.sleep(0.4)
+            if got in ("optimal", "timeout"):
+                resolved += 1
+        check(
+            resolved == len(async_ids),
+            f"all {len(async_ids)} async poll URLs resolve honestly "
+            f"after recovery ({resolved} resolved)",
+        )
+
+        # ---- zero warm recompiles at steady state --------------------
+        snaps = {}
+        for name, url in (("sliceA", ua), ("sliceB", ub)):
+            st, o = http_json(url + "/statusz", timeout=5.0)
+            if st == 200:
+                snaps[name] = int(
+                    (o.get("stats") or {}).get("programs_compiled", -1)
+                )
+        for i in range(4):
+            http_json(
+                router + "/v1/solve",
+                {"m": m, "n": nn, "seed": 900 + i, "tol": 1e-8},
+                timeout=120,
+            )
+        flat = True
+        for name, url in (("sliceA", ua), ("sliceB", ub)):
+            st, o = http_json(url + "/statusz", timeout=5.0)
+            after = int(
+                (o.get("stats") or {}).get("programs_compiled", -2)
+            ) if st == 200 else -2
+            if after != snaps.get(name):
+                flat = False
+        check(flat, f"zero warm recompiles at steady state ({snaps})")
+
+        # Registry TTL machinery was live for the whole run.
+        reg = json.load(open(registry))
+        hb_entries = [
+            e for e in reg.get("backends", {}).values()
+            if e.get("last_heartbeat_ts")
+        ]
+        check(
+            len(hb_entries) >= 2,
+            "both slices heartbeat into the shared registry",
+        )
+
+        wall = time.monotonic() - t_start
+        print(
+            f"probe_multihost: {len(failures)} failures, "
+            f"{n} requests, wall {wall:.1f}s"
+        )
+        if args.budget_s and wall > args.budget_s:
+            print(f"FAIL: wall {wall:.1f}s exceeded budget {args.budget_s}s")
+            return 1
+        return 1 if failures else 0
+    finally:
+        for p in procs.values():
+            try:
+                p.send_signal(signal.SIGINT)
+            except Exception:
+                pass
+        time.sleep(1.0)
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(timeout=15)
+            except Exception:
+                pass
+        # Rank children are not in our process table: kill via their
+        # heartbeat pids so nothing lingers after the probe.
+        for side in ("sliceA-world", "sliceB-world"):
+            base = os.path.join(workdir, side)
+            if os.path.isdir(base):
+                for d in os.listdir(base):
+                    if d.startswith("hb-gen"):
+                        for f in os.listdir(os.path.join(base, d)):
+                            try:
+                                hb = json.load(
+                                    open(os.path.join(base, d, f))
+                                )
+                                os.kill(int(hb["pid"]), signal.SIGKILL)
+                            except Exception:
+                                pass
+        if not args.keep:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"kept workdir: {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+# Seeds: jsonl-fields x2 + guarded-by x1 — multi-host runtime idioms
+# written wrong. Checked with pkg_path="distributed/fx.py": a
+# world_reinit record carrying an uncatalogued tally (invisible to
+# `cli report`'s recovery summary), a heartbeat event misspelling the
+# rank field, and the slice runner's dispatch counter read without the
+# lock its guarded-by annotation names (the publish-order invariant the
+# lock exists for).
+import threading
+
+
+class SliceState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dispatches = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self.dispatches += 1
+
+    def snapshot(self):
+        return self.dispatches  # guarded-by violation: read unlocked
+
+
+def reinit_record(logger, generation, overhead_s):
+    logger.event(
+        {
+            "event": "world_reinit",
+            "generation": generation,
+            "recovery_overhead_s": overhead_s,
+            "ranks_lost_count": 1,  # jsonl-fields: not catalogued
+        }
+    )
+
+
+def heartbeat_record(logger, rank):
+    logger.event(
+        {
+            "event": "heartbeat",
+            "beat_rank": rank,  # jsonl-fields: not catalogued ("rank")
+        }
+    )

@@ -1,0 +1,42 @@
+# Compliant twin of fx_multihost_bad: the dispatch counter is read
+# under its annotated lock, and the world_reinit / heartbeat records
+# carry only catalogued fields (generation / world_size / slice_id /
+# recovery_overhead_s / rank — analysis/config.JSONL_FIELDS). Checked
+# with pkg_path="distributed/fx.py".
+import threading
+
+
+class SliceState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dispatches = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self.dispatches += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.dispatches
+
+
+def reinit_record(logger, generation, overhead_s):
+    logger.event(
+        {
+            "event": "world_reinit",
+            "generation": generation,
+            "world_size": 3,
+            "slice_id": "slice0",
+            "recovery_overhead_s": overhead_s,
+        }
+    )
+
+
+def heartbeat_record(logger, rank):
+    logger.event(
+        {
+            "event": "heartbeat",
+            "rank": rank,
+            "slice_id": "slice0",
+        }
+    )

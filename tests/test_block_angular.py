@@ -98,12 +98,25 @@ def test_block_sharded_over_mesh():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
-def test_block_mesh_divisibility_check():
+def test_block_mesh_ragged_tail_accepts_indivisible_K():
+    # The K-divisible-by-mesh constraint is GONE (ISSUE 13 satellite):
+    # K=6 blocks over an 8-device axis pad up with dead blocks
+    # (all-sentinel index maps, unit pad diagonal) and solve to the
+    # unsharded optimum — the layout arbitrary survivor counts re-shard
+    # onto after an elastic shrink.
     p = block_angular_lp(6, 8, 16, 4, seed=0, sparse=False)  # 6 % 8 != 0
     mesh = make_mesh(axis_names=("blocks",))
     be = BlockAngularBackend(mesh=mesh)
-    with pytest.raises(ValueError, match="not divisible"):
-        be.setup(to_interior_form(p), SolverConfig())
+    be.setup(to_interior_form(p), SolverConfig())
+    assert be._lay.K == 8  # padded to the mesh axis
+    from distributedlpsolver_tpu.ipm.driver import solve as drv_solve
+
+    cfg = SolverConfig(tol=1e-8, verbose=False)
+    ref = drv_solve(p, backend="block", config=cfg)
+    res = drv_solve(p, backend=BlockAngularBackend(mesh=mesh), config=cfg)
+    assert res.status.value == "optimal"
+    rel = abs(res.objective - ref.objective) / max(1.0, abs(ref.objective))
+    assert rel <= 1e-8
 
 
 def test_two_phase_matches_single_phase():

@@ -161,6 +161,21 @@ class TestRuleFamilies:
         rules, _ = _rules_hit("fx_journal_clean.py", "serve/fx.py")
         assert rules == []
 
+    def test_multihost_catches_seeded(self):
+        # Multi-host runtime: an unlocked read of a guarded-by counter
+        # and uncatalogued world_reinit / heartbeat record fields.
+        rules, findings = _rules_hit(
+            "fx_multihost_bad.py", "distributed/fx.py"
+        )
+        assert rules == ["guarded-by", "jsonl-fields"]
+        assert sum(f.rule == "jsonl-fields" for f in findings) == 2
+
+    def test_multihost_clean_twin_silent(self):
+        rules, _ = _rules_hit(
+            "fx_multihost_clean.py", "distributed/fx.py"
+        )
+        assert rules == []
+
 
 class TestSuppressions:
     SRC = "import jax.numpy as jnp\n\ndef f():\n    return jnp.zeros((2, 2))%s\n"

@@ -1,0 +1,375 @@
+"""Multi-host world runtime tests (ISSUE 13 acceptance).
+
+Real ``jax.distributed`` worlds — N spawned CPU processes × K virtual
+devices each, gloo cross-process collectives — driven through
+distributed/launcher. The CPU harness maps 1:1 onto TPU pod slices:
+everything above the launcher env contract is identical there.
+
+Budgeted for tier-1: tiny shapes (process startup and compiles dominate,
+not solving), one shared world per check where possible, and the
+launcher's one-retry tolerance for the harness transport's best-effort
+failure mode (a transport flake kills a world by design; relaunching IS
+the recovery model).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedlpsolver_tpu.distributed.launcher import (
+    SupervisorConfig,
+    WorldSupervisor,
+    run_world,
+    worker_argv,
+)
+
+pytestmark = pytest.mark.multihost
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _single_process_reference(m, n, seed, tol=1e-8):
+    from distributedlpsolver_tpu.ipm import solve
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.models.generators import random_dense_lp
+
+    p = random_dense_lp(m, n, seed=seed)
+    return solve(
+        p, backend="dense", config=SolverConfig(tol=tol, verbose=False)
+    )
+
+
+def test_sharded_solve_matches_single_process(tmp_path):
+    """The acceptance equivalence: 2- and 4-process sharded solves
+    (variable axis spanning every device of every process, Schur
+    all-reduce over the process boundary) match the single-process
+    solve to 1e-8. One reference solve serves both worlds."""
+    m, n, seed = 32, 96, 5
+    ref = _single_process_reference(m, n, seed)
+    assert ref.status.value == "optimal"
+    for world_size in (2, 4):
+        res = run_world(
+            "sharded_solve",
+            {"m": m, "n": n, "seed": seed, "tol": 1e-8},
+            world_size=world_size,
+            workdir=str(tmp_path / f"w{world_size}"),
+            local_devices=2,
+            timeout=240,
+        )
+        assert set(res) == set(range(world_size))
+        for rank, out in res.items():
+            assert out["status"] == "optimal", (rank, out)
+            assert out["world_size"] == world_size
+            assert out["global_devices"] == 2 * world_size
+            rel = abs(out["objective"] - ref.objective) / max(
+                1.0, abs(ref.objective)
+            )
+            assert rel <= 1e-8, (rank, out["objective"], ref.objective)
+        # Every rank ran the SAME SPMD program: identical iterations.
+        iters = {out["iterations"] for out in res.values()}
+        assert len(iters) == 1
+
+
+def test_bucket_zero_warm_recompile_across_processes(tmp_path):
+    """Serving fast path over a 4-process global mesh: second dispatch
+    of a warm bucket compiles NOTHING on any rank, and the program-cache
+    size agrees world-wide (the rank-0-gather agreement check)."""
+    res = run_world(
+        "bucket_probe",
+        {"m": 8, "n": 24, "batch": 8, "tol": 1e-8},
+        world_size=4,
+        workdir=str(tmp_path / "bw"),
+        local_devices=2,
+        timeout=240,
+    )
+    # Cross-check the multi-process bucket objectives against a
+    # single-process solve of the same seeded batch.
+    from distributedlpsolver_tpu.backends.batched import solve_bucket
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.models.generators import random_batched_lp
+
+    batch = random_batched_lp(8, 8, 24, seed=7)
+    local = solve_bucket(
+        batch,
+        np.ones(8, dtype=bool),
+        SolverConfig(tol=1e-8, verbose=False),
+    )
+    for rank, out in res.items():
+        assert out["warm_recompiles"] == 0, (rank, out)
+        sizes = out["bucket_cache_sizes"]
+        assert len(set(sizes)) == 1, sizes  # world-wide agreement
+        np.testing.assert_allclose(
+            out["objectives_first"], local.objective, rtol=1e-8, atol=1e-10
+        )
+
+
+def test_rank_kill_world_reinit_checkpoint_resume(tmp_path):
+    """Coordinator-level recovery: SIGKILL one rank mid-solve — the
+    world dies as a unit — and the supervisor re-initializes a SMALLER
+    world whose solve resumes from the checkpoint-v3 file and finishes
+    OPTIMAL at the reference objective. The world_reinit event carries
+    recovery_overhead_s."""
+    m, n, seed = 32, 96, 11
+    ref = _single_process_reference(m, n, seed)
+    workdir = str(tmp_path / "sup")
+    ckpt = str(tmp_path / "state.ckpt.npz")
+    spec = {
+        "m": m, "n": n, "seed": seed, "tol": 1e-8,
+        "checkpoint": ckpt, "checkpoint_every": 2,
+    }
+    out_dir = os.path.join(workdir, "out")
+
+    def argv_for_gen(generation, world_size, port):
+        return worker_argv("sharded_solve", spec, out_dir)
+
+    sup = WorldSupervisor(
+        argv_for_gen,
+        world_size=3,
+        workdir=workdir,
+        local_devices=2,
+        config=SupervisorConfig(
+            min_world=1,
+            max_reforms=2,
+            log_jsonl=os.path.join(workdir, "world.jsonl"),
+        ),
+    )
+    box = {}
+
+    def _run():
+        try:
+            box["results"] = sup.run(timeout=300)
+        except Exception as e:  # surfaced by the main thread's asserts
+            box["error"] = e
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    # Wait for the first checkpoint, then kill rank 1 via its heartbeat
+    # pid (the authoritative pid record). Read the LATEST generation's
+    # heartbeat: should the harness transport have already cost a world
+    # (launcher relaunches by design), the stale gen's pid is dead.
+    def _latest_hb(rank):
+        gens = sorted(
+            (d for d in os.listdir(workdir) if d.startswith("hb-gen")),
+            key=lambda d: int(d[6:]),
+        )
+        for d in reversed(gens):
+            p = os.path.join(workdir, d, f"rank{rank}.hb")
+            if os.path.exists(p):
+                return p
+        return None
+
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if os.path.exists(ckpt) and _latest_hb(1):
+            break
+        time.sleep(0.1)
+    assert os.path.exists(ckpt), "no checkpoint appeared before budget"
+    killed = False
+    while time.monotonic() < deadline and not killed:
+        hb = _latest_hb(1)
+        try:
+            os.kill(json.load(open(hb))["pid"], signal.SIGKILL)
+            killed = True
+        except (ProcessLookupError, OSError, ValueError):
+            time.sleep(0.2)
+    assert killed, "could not kill a live rank-1 process"
+    t.join(timeout=300)
+    assert not t.is_alive(), "supervision did not finish in budget"
+    assert "error" not in box, box.get("error")
+    results = box["results"]
+    # The completing generation is a 2-process world (3 - 1 lost).
+    assert results, "no results from the completing world"
+    for rank, out in results.items():
+        assert out["status"] == "optimal", (rank, out)
+        assert out["world_size"] == 2
+        rel = abs(out["objective"] - ref.objective) / max(
+            1.0, abs(ref.objective)
+        )
+        assert rel <= 1e-8
+    assert sup.reinit_events, "no world_reinit event emitted"
+    assert all(
+        e["event"] == "world_reinit" and e["recovery_overhead_s"] >= 0.0
+        for e in sup.reinit_events
+    )
+    # Our kill produced the shrink-to-2 re-initialization (a transport
+    # flake may add same-size relaunches around it).
+    assert any(e["world_size"] == 2 for e in sup.reinit_events)
+    # And the event stream is stamped JSONL on disk.
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(workdir, "world.jsonl"))
+    ]
+    assert any(
+        r.get("event") == "world_reinit" and "recovery_overhead_s" in r
+        for r in lines
+    )
+
+
+def test_registry_heartbeat_ttl_ejects(tmp_path):
+    """Registry satellite: a self-registered backend whose heartbeats
+    stop is ejected deterministically at the TTL (registry_expired_total
+    counts it) even though no probe ever failed — and the stale-probe
+    guard rules still hold for the push."""
+    from distributedlpsolver_tpu.net.registry import BackendRegistry
+    from distributedlpsolver_tpu.net.router import Router, RouterConfig
+    from distributedlpsolver_tpu.obs.metrics import MetricsRegistry
+
+    path = str(tmp_path / "reg.json")
+    reg = BackendRegistry(path)
+    url = "http://127.0.0.1:1"  # nothing listens: probes would fail too
+    assert reg.register(url, slice_id="sX", world_size=2)
+    assert reg.heartbeat(url)
+    doc = reg.load()
+    entry = doc["backends"][url]
+    assert entry["slice_id"] == "sX"
+    assert entry["world_size"] == 2
+    assert entry["last_heartbeat_ts"] > 0
+
+    metrics = MetricsRegistry()
+    router = Router(
+        [],
+        RouterConfig(
+            registry_path=path,
+            registry_ttl_s=0.4,
+            eject_after=100,  # probes alone must NOT eject in this test
+        ),
+        metrics=metrics,
+    )
+    # Adopted from the registry with no manual config.
+    assert url in {b["url"] for b in router.statusz()["backends"]}
+    router._sync_registry_pull()
+    router._expire_stale_heartbeats()
+    st = next(b for b in router.statusz()["backends"] if b["url"] == url)
+    assert not st["ejected"]  # heartbeat still fresh
+    time.sleep(0.6)
+    router._expire_stale_heartbeats()
+    st = next(b for b in router.statusz()["backends"] if b["url"] == url)
+    assert st["ejected"], "stale heartbeat did not eject"
+    snap = metrics.snapshot()
+    assert snap.get("registry_expired_total") == 1
+    # The ejection was pushed to the shared registry (siblings honor it).
+    doc = reg.load()
+    assert doc["backends"][url]["ejected"] is True
+    # A fresh heartbeat alone must NOT resurrect it (resurrection rule:
+    # only a successful probe after the ejection re-admits).
+    assert reg.heartbeat(url)
+    router._sync_registry_pull()
+    st = next(b for b in router.statusz()["backends"] if b["url"] == url)
+    assert st["ejected"]
+
+
+def test_record_preserves_slice_fields(tmp_path):
+    """A router observation push must not wipe the serving-side fields
+    (slice_id / world_size / last_heartbeat_ts)."""
+    from distributedlpsolver_tpu.net.registry import BackendRegistry
+
+    path = str(tmp_path / "reg.json")
+    reg = BackendRegistry(path)
+    url = "http://127.0.0.1:2"
+    reg.register(url, slice_id="sY", world_size=4)
+    assert reg.record(
+        url, ejected=True, fails=3, observed_ts=time.time() + 1
+    )
+    entry = reg.load()["backends"][url]
+    assert entry["ejected"] is True
+    assert entry["slice_id"] == "sY"
+    assert entry["world_size"] == 4
+    assert entry["last_heartbeat_ts"] > 0
+
+
+def test_block_angular_ragged_tail(tmp_path):
+    """Block-angular shrink satellite: K blocks NOT divisible by the
+    mesh axis re-shard onto the ragged-tail (dead-block-padded) layout
+    and match the unsharded solve to 1e-8 — including a shrunk
+    'survivor' width."""
+    import jax
+
+    from distributedlpsolver_tpu.backends.block_angular import (
+        BlockAngularBackend,
+    )
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.ipm.driver import solve
+    from distributedlpsolver_tpu.models.generators import block_angular_lp
+    from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+    p = block_angular_lp(5, 12, 30, 8, seed=3)  # K=5: indivisible by 4, 3
+    cfg = SolverConfig(tol=1e-8, verbose=False)
+    ref = solve(p, backend="block", config=cfg)
+    assert ref.status.value == "optimal"
+    for width in (4, 3):
+        mesh = mesh_lib.make_mesh(
+            (width,), axis_names=("blocks",),
+            devices=jax.devices()[:width],
+        )
+        be = BlockAngularBackend(mesh=mesh)
+        res = solve(p, backend=be, config=cfg)
+        assert res.status.value == "optimal"
+        rel = abs(res.objective - ref.objective) / max(
+            1.0, abs(ref.objective)
+        )
+        assert rel <= 1e-8, (width, res.objective, ref.objective)
+        # The reshard seam the SHRINK rung uses.
+        be2 = be.reshard(
+            mesh_lib.make_mesh(
+                (2,), axis_names=("blocks",), devices=jax.devices()[:2]
+            )
+        )
+        assert isinstance(be2, BlockAngularBackend)
+
+
+def test_probe_devices_skips_non_addressable():
+    """runtime satellite: probes never ping devices another process
+    owns — they land in NEITHER list (no evidence), instead of a
+    device_put into a collective nobody else runs."""
+    import jax
+
+    from distributedlpsolver_tpu.parallel import runtime as rt
+
+    class _Remote:
+        id = 9999
+        process_index = jax.process_index() + 1
+
+    healthy, unhealthy = rt.probe_devices(
+        [jax.local_devices()[0], _Remote()], deadline=5.0
+    )
+    assert jax.local_devices()[0] in healthy
+    assert all(getattr(d, "id", None) != 9999 for d in healthy + unhealthy)
+
+
+def test_probe_multihost_smoke(tmp_path):
+    """The router-over-2-slices acceptance probe: one slice killed
+    mid-run, world re-init, zero lost acks, poll URLs honest, zero
+    warm recompiles (scripts/probe_multihost.py)."""
+    env = dict(os.environ)
+    # One retry: the harness transport (gloo over localhost TCP) is
+    # best-effort — a transient pairing failure kills a world by
+    # design, and relaunching IS the recovery model (the same contract
+    # run_world gives the equivalence tests).
+    last = None
+    for _ in range(2):
+        res = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "probe_multihost.py"),
+                "--requests", "18",
+                "--budget-s", "300",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=330,
+            env=env,
+            cwd=REPO,
+        )
+        last = res
+        if res.returncode == 0:
+            break
+    assert last.returncode == 0, (
+        f"probe_multihost failed:\n{last.stdout[-4000:]}\n{last.stderr[-2000:]}"
+    )

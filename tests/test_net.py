@@ -873,11 +873,24 @@ def test_cli_serve_surfaces_admission_and_backoffs(tmp_path, capsys):
     assert summary["admission"]["only"]["rejected"].get("quota", 0) >= 1
 
 
-def test_cli_route_requires_backend():
+def test_cli_route_requires_backend_or_registry(tmp_path):
     from distributedlpsolver_tpu.cli import main
 
-    with pytest.raises(SystemExit):
-        main(["route"])  # --backend is required
+    # No --backend and no --registry: nothing could ever enter rotation.
+    assert main(["route"]) == 2
+    # With a shared registry the table may start EMPTY — slices
+    # self-register and the router adopts them (README "Multi-host");
+    # constructing the Router must not raise.
+    from distributedlpsolver_tpu.net.router import Router, RouterConfig
+    from distributedlpsolver_tpu.obs.metrics import MetricsRegistry
+
+    Router(
+        [],
+        RouterConfig(registry_path=str(tmp_path / "reg.json")),
+        metrics=MetricsRegistry(),
+    )
+    with pytest.raises(ValueError):
+        Router([], RouterConfig(), metrics=MetricsRegistry())
 
 
 # ---------------------------------------------------------------------------
