@@ -1,7 +1,7 @@
 """graftcheck: the repo's static-analysis suite, wired into tier-1 as a
 CI gate (``cli check distributedlpsolver_tpu/`` must exit 0).
 
-Four rule families enforce the invariants the runtime tests can only
+Six rule families enforce the invariants the runtime tests can only
 spot-check (README "Static analysis" has the catalogue and suppression
 syntax):
 
@@ -10,23 +10,43 @@ syntax):
 - dtype discipline — ``dtype-explicit``, ``dtype-narrow`` (rules_dtype)
 - lock discipline — ``guarded-by`` (rules_locks), paired with the
   dynamic :mod:`~distributedlpsolver_tpu.analysis.lockorder` recorder
+- static deadlock analysis — ``lock-order`` (cross-method acquisition
+  cycles) and ``blocking-under-lock`` (rules_locks, graftcheck v2)
+- SPMD discipline — ``spmd-divergent-collective``,
+  ``spmd-unordered-dispatch``, ``spmd-uncommitted-input`` (rules_spmd,
+  graftcheck v2): the multi-host every-rank-runs-the-same-programs
+  contract of distributed/world.py, gated statically
 - JSONL schema conformance — ``jsonl-fields``, ``jsonl-stamp``
   (rules_schema)
 
-Stdlib-only on purpose: the gate runs on CPU CI in well under a second,
-with no jax import.
+The v2 families are *interprocedural*: they run over a package-wide
+call graph with taint/reach summaries (analysis/callgraph.py) exposed
+to rules as a :class:`~distributedlpsolver_tpu.analysis.core.
+ProjectContext`. Still stdlib-only on purpose: the gate runs on CPU CI
+in a few seconds, with no jax import.
+
+Incremental gating: ``cli check --baseline <json>`` fails only on
+findings not present in a committed baseline (``--write-baseline``
+produces one), so downstream consumers get a cheap diff-gate; this
+repo's own tier-1 gate runs against the empty committed baseline
+(BASELINE_GRAFTCHECK.json) — zero tolerated findings.
 """
 
 from distributedlpsolver_tpu.analysis.core import (
     FileContext,
     Finding,
+    ProjectContext,
     all_rules,
+    baseline_key,
     check_file,
     check_paths,
+    diff_baseline,
     iter_py_files,
+    project_rule,
     render_json,
     render_text,
     rule,
+    write_baseline,
 )
 from distributedlpsolver_tpu.analysis.lockorder import (
     LockOrderRecorder,
@@ -38,11 +58,16 @@ __all__ = [
     "Finding",
     "LockOrderRecorder",
     "LockOrderViolation",
+    "ProjectContext",
     "all_rules",
+    "baseline_key",
     "check_file",
     "check_paths",
+    "diff_baseline",
     "iter_py_files",
+    "project_rule",
     "render_json",
     "render_text",
     "rule",
+    "write_baseline",
 ]

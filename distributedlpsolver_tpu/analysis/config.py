@@ -277,10 +277,171 @@ JSONL_FIELDS = {
     "rank",
     "world_size",
     "slice_id",
+    # graftcheck v2 catalogue-drift audit: jsonl-fields now also checks
+    # literal payloads routed through stamp_record(...), which brought
+    # two stamped streams the lexical rule never saw into coverage —
+    # the job-journal WAL (serve/journal.py: the "j" lifecycle
+    # discriminator and its admitted-record fields) and the per-rank
+    # heartbeat files (distributed/world.py: writer pid, merged into
+    # the world's JSONL view post-mortem).
+    "j",
+    "jid",
+    "fp",
+    "spec",
+    "nonce",
+    "next_seq",
+    "stage",
+    "deadline_ts",
+    "pid",
 }
 
 # ``X.write(json.dumps(...))`` record emission points that must stamp:
 # every JSONL stream a consumer merges needs schema_version/ts/t_mono.
 # (Chrome-trace and metric-snapshot files use ``json.dump(obj, fh)`` and
 # are whole-file JSON, not JSONL records — the pattern doesn't match
-# them, by design.)
+# them, by design. HTTP response bodies are ``json.dumps(...).encode()``
+# bytes and exempt by the same token: they are replies, not stream
+# records.)
+
+# -- SPMD rules (rules_spmd) -------------------------------------------------
+# The multi-host contract (distributed/world.py): every rank of a world
+# executes a bit-identical program sequence. Three statically visible
+# ways to break it, each with its own rule family below.
+
+# Environment keys whose values differ per rank (distributed/world.py
+# env contract) — reading one is a rank-taint source exactly like
+# ``jax.process_index()`` or ``world.rank``.
+RANK_ENV_KEYS = {"DLPS_RANK"}
+
+# Calls that are (or dispatch) world collectives: every rank must reach
+# them in the same order with the same static arguments. A rank-derived
+# branch guarding a path into one of these is the
+# every-follower-hangs-in-XLA bug class PR 13 debugged by hand.
+COLLECTIVE_CALLS = {
+    "barrier",
+    "allgather",
+    "agree",
+    "sync_global_devices",
+    "process_allgather",
+    "psum",
+    "pmean",
+    "put_global",
+    "host_values",
+    "host_value",
+    # bucket-program dispatch: the collective lives inside the compiled
+    # program, so dispatching it IS reaching a collective
+    "solve_bucket",
+    "solve_pdhg_bucket",
+    "execute_dispatch",
+}
+
+# Deliberate rank-divergence seams — the rank-0-publish /
+# follower-execute architecture (distributed/slice.py): both sides of
+# the branch execute the SAME dispatch sequence, one via the
+# SolveService, one via the control-plane journal, so the divergence is
+# the design, not a bug. Entries are (pkg_path, qualname).
+SPMD_SANCTIONED = {
+    # cli serve-slice: rank 0 runs the HTTP front-end + SliceRunner,
+    # followers run follower_loop — the two sides reach the collectives
+    # through the one shared execute_dispatch path, in journal order.
+    ("cli.py", "cmd_serve_slice"),
+}
+
+# Order-insensitive consumers: a directory scan wrapped in one of these
+# never feeds iteration order anywhere, so it is exempt from
+# spmd-unordered-dispatch.
+ORDER_SAFE_CONSUMERS = {
+    "sorted",
+    "set",
+    "frozenset",
+    "len",
+    "sum",
+    "min",
+    "max",
+    "any",
+    "all",
+}
+
+# Order-sensitive sinks: a call reaching one of these from inside a
+# loop over an unordered collection publishes the iteration order to
+# the rest of the world (dispatch journals, JSONL streams, registry
+# merges, jit cache warm order).
+ORDER_SINKS = {
+    "publish",
+    "publish_stop",
+    "event",
+    "dispatch",
+    "execute_dispatch",
+    "solve_bucket",
+    "solve_pdhg_bucket",
+    "warm_buckets",
+    "put_global",
+    "record",
+    "register",
+}
+
+# Committed-placement helpers (spmd-uncommitted-input): host data enters
+# a multi-process program ONLY through these — they materialize each
+# process's addressable shards against the global mesh. A bare
+# ``jax.device_put(x)`` / ``jnp.asarray(x)`` commits to the default
+# device instead and breaks the program's sharding contract on a pod.
+COMMITTED_PLACERS = {
+    "put_global",
+    "place_bucket",
+    "place_warm",
+    "batch_sharding",
+    "col_sharding",
+    "vec_sharding",
+    "make_array_from_callback",
+}
+
+# Calls that take a ``mesh=`` keyword and compile/execute against it —
+# the sinks the uncommitted-input rule guards.
+MESH_PROGRAM_SINKS = {
+    "solve_bucket",
+    "solve_pdhg_bucket",
+    "execute_dispatch",
+    "solve_batched",
+}
+
+# -- deadlock rules (rules_locks) --------------------------------------------
+# Blocking operations that must not run while a lock is held: a
+# collective blocks until EVERY rank arrives (seconds to forever), an
+# HTTP round-trip or fsync blocks on I/O, subprocess waits on another
+# process, Future.result on another thread. Any of them under a lock
+# extends the lock's hold time from nanoseconds to unbounded — the
+# pipeline-stall / deadlock-feeding class. Terminal call names.
+BLOCKING_CALLS = COLLECTIVE_CALLS | {
+    "urlopen",
+    "fsync",
+    "sleep",
+    "Popen",
+    "check_call",
+    "check_output",
+    "communicate",
+}
+
+# Deliberately-blocking-under-lock seams, (pkg_path, qualname) — a bare
+# class name sanctions every method of that class:
+BLOCKING_SANCTIONED = {
+    # The slice dispatch lock IS the cross-rank ordering contract:
+    # publish order must equal execute order, so the collective runs
+    # under the lock by design (distributed/slice.py module doc).
+    ("distributed/slice.py", "SliceRunner"),
+    # The WAL's append ordering + fsync durability is the journal's
+    # whole contract: appends are one small write each and the lock IS
+    # the WAL order, and compaction must be atomic against appends
+    # (serve/journal.py module doc). Only these two methods are
+    # sanctioned — the bounded result-store write in finish() was moved
+    # OUT of the lock in the same PR that added this rule.
+    ("serve/journal.py", "JobJournal._append_locked"),
+    ("serve/journal.py", "JobJournal.compact"),
+    # flush()/close() are the drain path's explicit force-to-disk
+    # calls; the lock is the WAL order they are flushing.
+    ("serve/journal.py", "JobJournal.flush"),
+    ("serve/journal.py", "JobJournal.close"),
+    # IterLogger/Tracer emit one small flushed write per record under
+    # their own lock — that lock exists only to serialize the stream,
+    # never wraps device work, and fsync mode is opt-in diagnostics.
+    ("utils/logging.py", "IterLogger"),
+}

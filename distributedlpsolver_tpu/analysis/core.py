@@ -63,6 +63,7 @@ class Finding:
 # Rule registry
 
 _RULES: Dict[str, Tuple[Callable, str]] = {}
+_PROJECT_RULES: Dict[str, Tuple[Callable, str]] = {}
 
 
 def rule(name: str, doc: str):
@@ -70,9 +71,26 @@ def rule(name: str, doc: str):
     the token suppression comments name)."""
 
     def deco(fn):
-        if name in _RULES:
+        if name in _RULES or name in _PROJECT_RULES:
             raise ValueError(f"duplicate graftcheck rule {name!r}")
         _RULES[name] = (fn, doc)
+        fn.rule_name = name
+        return fn
+
+    return deco
+
+
+def project_rule(name: str, doc: str):
+    """Register an *interprocedural* rule: ``fn(project) ->
+    Iterable[Finding]`` over a :class:`ProjectContext` (whole analyzed
+    file set + call graph) instead of one file. Project rules run once
+    per check invocation; their findings are attributed to individual
+    files and go through the same per-file suppression machinery."""
+
+    def deco(fn):
+        if name in _RULES or name in _PROJECT_RULES:
+            raise ValueError(f"duplicate graftcheck rule {name!r}")
+        _PROJECT_RULES[name] = (fn, doc)
         fn.rule_name = name
         return fn
 
@@ -82,7 +100,9 @@ def rule(name: str, doc: str):
 def all_rules() -> Dict[str, str]:
     """{rule name: one-line description} for --list-rules and docs."""
     _load_rules()
-    return {name: doc for name, (fn, doc) in sorted(_RULES.items())}
+    merged = dict(_RULES)
+    merged.update(_PROJECT_RULES)
+    return {name: doc for name, (fn, doc) in sorted(merged.items())}
 
 
 _loaded = False
@@ -98,6 +118,7 @@ def _load_rules() -> None:
         rules_jit,
         rules_locks,
         rules_schema,
+        rules_spmd,
     )
 
     _loaded = True
@@ -156,6 +177,52 @@ def _infer_pkg_path(path: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Whole-file-set context (graftcheck v2)
+
+class ProjectContext:
+    """Everything an interprocedural rule needs about the analyzed file
+    set: the per-file contexts plus the lazily-built call graph, taint
+    engine, and lock model (analysis/callgraph.py). A single fixture
+    file checked via :func:`check_file` gets a degenerate one-file
+    project — the same rules run, just with nothing to resolve across.
+    """
+
+    def __init__(self, contexts: Sequence[FileContext]):
+        self.contexts = list(contexts)
+        self.by_path: Dict[str, FileContext] = {
+            c.pkg_path: c for c in self.contexts
+        }
+        self._graph = None
+        self._taint = None
+        self._locks = None
+
+    @property
+    def graph(self):
+        if self._graph is None:
+            from distributedlpsolver_tpu.analysis.callgraph import CallGraph
+
+            self._graph = CallGraph(self.contexts)
+        return self._graph
+
+    @property
+    def taint(self):
+        if self._taint is None:
+            from distributedlpsolver_tpu.analysis import config
+            from distributedlpsolver_tpu.analysis.callgraph import TaintEngine
+
+            self._taint = TaintEngine(self.graph, config.RANK_ENV_KEYS)
+        return self._taint
+
+    @property
+    def locks(self):
+        if self._locks is None:
+            from distributedlpsolver_tpu.analysis.callgraph import LockModel
+
+            self._locks = LockModel(self.graph)
+        return self._locks
+
+
+# ---------------------------------------------------------------------------
 # Suppressions
 
 class _Suppressions:
@@ -210,14 +277,61 @@ class _Suppressions:
 # ---------------------------------------------------------------------------
 # Entry points
 
+def _split_rule_names(rules: Optional[Sequence[str]]):
+    """(file_rule_names, project_rule_names) with unknown-name check."""
+    names = list(rules) if rules is not None else None
+    if names is None:
+        return list(_RULES), list(_PROJECT_RULES)
+    unknown = [n for n in names if n not in _RULES and n not in _PROJECT_RULES]
+    if unknown:
+        raise ValueError(f"unknown graftcheck rule(s): {unknown}")
+    return (
+        [n for n in names if n in _RULES],
+        [n for n in names if n in _PROJECT_RULES],
+    )
+
+
+def _run_rules(
+    contexts: Sequence[FileContext],
+    parse_errors: Sequence[Finding],
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """File rules per context + project rules once over the whole set,
+    then suppressions per file. The shared tail of check_file/check_paths."""
+    file_rules, project_rules = _split_rule_names(rules)
+    findings: List[Finding] = list(parse_errors)
+    for ctx in contexts:
+        for name in file_rules:
+            fn, _doc = _RULES[name]
+            findings.extend(fn(ctx))
+    if project_rules and contexts:
+        project = ProjectContext(contexts)
+        for name in project_rules:
+            fn, _doc = _PROJECT_RULES[name]
+            findings.extend(fn(project))
+    by_display: Dict[str, FileContext] = {c.path: c for c in contexts}
+    sups: Dict[str, _Suppressions] = {}
+    for f in findings:
+        ctx = by_display.get(f.path)
+        if ctx is None:
+            continue
+        sup = sups.get(f.path)
+        if sup is None:
+            sup = sups[f.path] = _Suppressions(ctx)
+        f.suppressed = sup.covers(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
 def check_file(
     path: str,
     source: Optional[str] = None,
     rules: Optional[Sequence[str]] = None,
     pkg_path: Optional[str] = None,
 ) -> List[Finding]:
-    """Run ``rules`` (default: all registered) over one file. Returns
-    every finding, suppressed ones flagged — callers filter."""
+    """Run ``rules`` (default: all registered) over one file — project
+    rules see a one-file project. Returns every finding, suppressed ones
+    flagged — callers filter."""
     _load_rules()
     if source is None:
         with open(path) as fh:
@@ -234,19 +348,7 @@ def check_file(
                 message=f"file does not parse: {e.msg}",
             )
         ]
-    names = list(rules) if rules is not None else list(_RULES)
-    unknown = [n for n in names if n not in _RULES]
-    if unknown:
-        raise ValueError(f"unknown graftcheck rule(s): {unknown}")
-    findings: List[Finding] = []
-    for name in names:
-        fn, _doc = _RULES[name]
-        findings.extend(fn(ctx))
-    sup = _Suppressions(ctx)
-    for f in findings:
-        f.suppressed = sup.covers(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+    return _run_rules([ctx], [], rules=rules)
 
 
 def iter_py_files(paths: Sequence[str]) -> List[str]:
@@ -268,11 +370,79 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 def check_paths(
     paths: Sequence[str], rules: Optional[Sequence[str]] = None
 ) -> List[Finding]:
-    """Run the suite over files and directories (recursed)."""
-    findings: List[Finding] = []
+    """Run the suite over files and directories (recursed). All files
+    are parsed up front so the interprocedural rules resolve calls
+    across every file given in one project view."""
+    _load_rules()
+    contexts: List[FileContext] = []
+    parse_errors: List[Finding] = []
     for path in iter_py_files(paths):
-        findings.extend(check_file(path, rules=rules))
-    return findings
+        with open(path) as fh:
+            source = fh.read()
+        try:
+            contexts.append(FileContext(path, source))
+        except SyntaxError as e:
+            parse_errors.append(
+                Finding(
+                    rule="parse-error",
+                    path=path,
+                    line=e.lineno or 0,
+                    col=e.offset or 0,
+                    message=f"file does not parse: {e.msg}",
+                )
+            )
+    return _run_rules(contexts, parse_errors, rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# Findings baseline (incremental diff-gate)
+
+def baseline_key(f: Finding) -> str:
+    """Line-number-independent identity of a finding for baseline
+    comparison: rule + package-relative path + message. Line numbers
+    drift with every edit; the message (which names the symbol and the
+    violated contract) is stable until the code actually changes."""
+    parts = f.path.replace(os.sep, "/").split("/")
+    if "distributedlpsolver_tpu" in parts:
+        i = len(parts) - 1 - parts[::-1].index("distributedlpsolver_tpu")
+        rel = "/".join(parts[i + 1 :])
+    else:
+        rel = parts[-1]
+    return f"{f.rule}::{rel}::{f.message}"
+
+
+def write_baseline(findings: Sequence[Finding]) -> str:
+    """Serialize the unsuppressed findings as a committed baseline
+    document (``cli check --write-baseline``)."""
+    keys: Dict[str, int] = {}
+    for f in findings:
+        if not f.suppressed:
+            k = baseline_key(f)
+            keys[k] = keys.get(k, 0) + 1
+    return json.dumps(
+        {"schema": 1, "findings": keys},
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def diff_baseline(
+    findings: Sequence[Finding], baseline_doc: dict
+) -> List[Finding]:
+    """The unsuppressed findings NOT covered by the baseline — the
+    diff-gate's failure set. A baseline entry covers as many findings of
+    its key as it counted; the (N+1)-th is new."""
+    budget = dict(baseline_doc.get("findings", {}))
+    new: List[Finding] = []
+    for f in findings:
+        if f.suppressed:
+            continue
+        k = baseline_key(f)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            new.append(f)
+    return new
 
 
 # ---------------------------------------------------------------------------

@@ -1,4 +1,5 @@
-"""Lock-discipline rule: the ``# guarded-by:`` annotation convention.
+"""Lock-discipline rules: the ``# guarded-by:`` annotation convention,
+plus the interprocedural deadlock families (graftcheck v2).
 
 The serve dispatcher is a three-thread pipeline (scheduler → pack →
 solve) sharing mutable state with submitters and introspection calls;
@@ -20,21 +21,41 @@ lock declare it on the def line:
 
 ``__init__`` is exempt: construction happens-before publication.
 
-The static check is lexical by design — it cannot see cross-function
-lock flow, which is why it pairs with the *dynamic* lock-order recorder
-(analysis/lockorder.py): tests wrap the live locks, drain a real
-3-thread service, and assert the acquisition graph stays acyclic. The
-static rule catches unguarded access; the recorder catches ordering
-inversions between guards the static rule approved.
+The ``guarded-by`` check is lexical by design. Since graftcheck v2 it
+pairs with two *interprocedural* families built on the package call
+graph (analysis/callgraph.py):
+
+- ``lock-order`` — the static half of the dynamic lockorder recorder:
+  every ``with self._a: ... self._m() ... with self._b`` path
+  contributes a held→acquired edge (including edges through resolved
+  calls, cross-class via inferred attribute types), and any cycle in
+  the global edge graph is an ordering inversion that CAN deadlock,
+  whether or not a run has hit it yet. Tests cross-check this graph
+  against the edges the dynamic recorder observes on a live 3-thread
+  SolveService drain.
+- ``blocking-under-lock`` — a collective, HTTP round-trip, fsync,
+  subprocess, sleep, or Future.result reached (transitively) while a
+  known lock is held. A collective blocks until every RANK arrives;
+  holding a lock across one turns a slow peer into a whole-process
+  stall, and two such locks into a distributed deadlock. Deliberate
+  seams (the slice dispatch-order lock, the WAL append) are sanctioned
+  in :data:`analysis.config.BLOCKING_SANCTIONED`.
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, List, Set
+from typing import Dict, List, Set, Tuple
 
-from distributedlpsolver_tpu.analysis.core import FileContext, Finding, rule
+from distributedlpsolver_tpu.analysis import config
+from distributedlpsolver_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    ProjectContext,
+    project_rule,
+    rule,
+)
 
 _GUARDED = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 _HOLDS = re.compile(r"#\s*holds:\s*([A-Za-z_][A-Za-z0-9_]*)")
@@ -151,4 +172,117 @@ def check_guarded_by(ctx: FileContext) -> List[Finding]:
                         ),
                     )
                 )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural deadlock families (graftcheck v2)
+
+
+def _blocking_sanctioned(key: Tuple[str, str]) -> bool:
+    pkg, qual = key
+    if (pkg, qual) in config.BLOCKING_SANCTIONED:
+        return True
+    head = qual.split(".", 1)[0]
+    return (pkg, head) in config.BLOCKING_SANCTIONED
+
+
+@project_rule(
+    "lock-order",
+    "the cross-method lock acquisition graph must stay acyclic",
+)
+def check_lock_order(project: ProjectContext) -> List[Finding]:
+    cycle = project.locks.find_cycle()
+    if not cycle:
+        return []
+    path_str = " -> ".join([a for a, _b, _p, _l in cycle] + [cycle[0][0]])
+    sites = ", ".join(f"{a}->{b} at {p}:{l}" for a, b, p, l in cycle)
+    pkg = cycle[0][2]
+    ctx = project.by_path.get(pkg)
+    return [
+        Finding(
+            rule="lock-order",
+            path=ctx.path if ctx is not None else pkg,
+            line=cycle[0][3],
+            col=0,
+            message=(
+                f"lock-order cycle {path_str} ({sites}) — inconsistent "
+                "acquisition order can deadlock; pick one global order "
+                "(the dynamic lockorder recorder asserts the same "
+                "invariant at runtime)"
+            ),
+        )
+    ]
+
+
+@project_rule(
+    "blocking-under-lock",
+    "no collective/IO/subprocess/sleep while a lock is held",
+)
+def check_blocking_under_lock(project: ProjectContext) -> List[Finding]:
+    out: List[Finding] = []
+    graph = project.graph
+    locks = project.locks
+    blocking = set(config.BLOCKING_CALLS)
+
+    # Transitive blocking summaries, with sanctioned functions
+    # contributing nothing (their blocking is their documented design;
+    # callers do not inherit it).
+    chains: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+    for key, unit in graph.functions.items():
+        if _blocking_sanctioned(key):
+            continue
+        for call, resolved, term in unit.call_sites:
+            if term in blocking and not (
+                resolved is not None and _blocking_sanctioned(resolved)
+            ):
+                chains[key] = (term,)
+                break
+    changed = True
+    while changed:
+        changed = False
+        for key, unit in graph.functions.items():
+            if key in chains or _blocking_sanctioned(key):
+                continue
+            for call, resolved, term in unit.call_sites:
+                if (
+                    resolved is not None
+                    and resolved != key
+                    and resolved in chains
+                ):
+                    chains[key] = (resolved[1],) + chains[resolved]
+                    changed = True
+                    break
+
+    for key, unit in graph.functions.items():
+        if "<locals>" in key[1] or _blocking_sanctioned(key):
+            continue
+        for call, resolved, term in unit.call_sites:
+            chain: Tuple[str, ...] = ()
+            if term in blocking and not (
+                resolved is not None and _blocking_sanctioned(resolved)
+            ):
+                chain = (term,)
+            elif resolved is not None and chains.get(resolved):
+                chain = (resolved[1],) + chains[resolved]
+            if not chain:
+                continue
+            held = locks._held_at(unit, call)
+            if not held:
+                continue
+            out.append(
+                Finding(
+                    rule="blocking-under-lock",
+                    path=unit.ctx.path,
+                    line=call.lineno,
+                    col=call.col_offset,
+                    message=(
+                        f"blocking op `{' -> '.join(chain)}` while "
+                        f"holding {', '.join(sorted(held))} in "
+                        f"{key[1]}() — move the wait outside the lock "
+                        "or sanction the seam in analysis/config."
+                        "BLOCKING_SANCTIONED"
+                    ),
+                )
+            )
     return out

@@ -16,13 +16,21 @@ dispatch on those fields. Two statically visible drift modes:
   json.dumps(...))``) without routing through ``stamp_record``, losing
   the schema_version/ts/t_mono stamps that let report merge streams
   across processes. Whole-file JSON artifacts (Chrome traces, metric
-  snapshots) use ``json.dump(obj, fh)`` and are exempt by pattern.
+  snapshots) use ``json.dump(obj, fh)`` and are exempt by pattern;
+  HTTP response bodies are ``json.dumps(...).encode()`` bytes and
+  exempt by the same token (replies, not stream records).
+
+Since graftcheck v2 both rules see through one level of local dataflow:
+``payload = json.dumps({...}); fh.write(payload)`` is checked at the
+write (the PR 13 heartbeat-writer pattern the lexical rule missed), and
+literal dicts passed to ``stamp_record({...})`` have their keys checked
+against the catalogue exactly like ``.event({...})`` payloads.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import List, Optional
 
 from distributedlpsolver_tpu.analysis import config
 from distributedlpsolver_tpu.analysis.core import FileContext, Finding, rule
@@ -30,17 +38,22 @@ from distributedlpsolver_tpu.analysis.core import FileContext, Finding, rule
 
 def _is_event_call(node: ast.Call) -> bool:
     """``<logger-ish>.event({...})`` — the IterLogger event surface (the
-    tracer has no ``event`` method, so attribute name is decisive)."""
-    return (
+    tracer has no ``event`` method, so attribute name is decisive) —
+    or a literal record stamped for a stream, ``stamp_record({...})``."""
+    if (
         isinstance(node.func, ast.Attribute)
         and node.func.attr == "event"
         and len(node.args) == 1
-    )
+    ):
+        return True
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", "")
+    return name == "stamp_record" and len(node.args) == 1
 
 
 @rule(
     "jsonl-fields",
-    "IterLogger.event payloads carry only catalogued fields/types",
+    "IterLogger.event/stamp_record payloads carry only catalogued fields/types",
 )
 def check_event_fields(ctx: FileContext) -> List[Finding]:
     out: List[Finding] = []
@@ -90,9 +103,11 @@ def check_event_fields(ctx: FileContext) -> List[Finding]:
     return out
 
 
-def _dumps_arg(node: ast.AST):
+def _dumps_arg(node: ast.AST, ctx: Optional[FileContext] = None):
     """The first argument of a ``json.dumps(...)`` call found anywhere
-    inside ``node`` (write argument expressions are concatenations)."""
+    inside ``node`` (write argument expressions are concatenations).
+    ``json.dumps(...).encode()`` results are exempt when ``ctx`` is
+    given — those are HTTP body bytes, not stream records."""
     for sub in ast.walk(node):
         if (
             isinstance(sub, ast.Call)
@@ -102,8 +117,26 @@ def _dumps_arg(node: ast.AST):
             and sub.func.value.id == "json"
             and sub.args
         ):
+            if ctx is not None:
+                parent = ctx.parents.get(sub)
+                if isinstance(parent, ast.Attribute) and parent.attr == "encode":
+                    continue
             return sub.args[0]
     return None
+
+
+def _local_bindings(ctx: FileContext, node: ast.AST) -> dict:
+    """name -> last assigned value expression in the enclosing function
+    (or module body) — the one level of dataflow the stamp rule sees
+    through (``payload = json.dumps(...); fh.write(payload)``)."""
+    fn = ctx.enclosing_function(node) or ctx.tree
+    out: dict = {}
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = sub.value
+    return out
 
 
 @rule(
@@ -117,10 +150,26 @@ def check_stamp(ctx: FileContext) -> List[Finding]:
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
             and node.func.attr == "write"
-            and node.args
+            and len(node.args) == 1
         ):
             continue
-        payload = _dumps_arg(node.args[0])
+        arg = node.args[0]
+        payload = _dumps_arg(arg, ctx)
+        if payload is None:
+            # One level of local dataflow: a Name in the write argument
+            # bound to a json.dumps(...) expression earlier in the
+            # function (the heartbeat-writer pattern).
+            bindings = None
+            for sub in ast.walk(arg):
+                if not isinstance(sub, ast.Name):
+                    continue
+                if bindings is None:
+                    bindings = _local_bindings(ctx, node)
+                bound = bindings.get(sub.id)
+                if bound is not None:
+                    payload = _dumps_arg(bound, ctx)
+                    if payload is not None:
+                        break
         if payload is None:
             continue
         stamped = (

@@ -1350,7 +1350,17 @@ def solve_bucket(
         # transfer. Divisibility was checked at placement time.
         A, b, c, active = batch.A, batch.b, batch.c, active
         if not isinstance(active, jax.Array):
-            active = jnp.asarray(np.asarray(active, dtype=bool))
+            # A host mask next to a pre-placed batch must still commit
+            # against the SAME mesh sharding as the data: a bare
+            # jnp.asarray pins it to the default local device, which a
+            # multi-process mesh program cannot consume.
+            act_h = np.asarray(active, dtype=bool)
+            if mesh is not None:
+                active = jax.device_put(
+                    act_h, mesh_lib.batch_sharding(mesh, 1, batch_axis)
+                )
+            else:
+                active = jnp.asarray(act_h)
     else:
         placed, active = place_bucket(
             batch, active, cfg, mesh=mesh, batch_axis=batch_axis
@@ -1372,7 +1382,13 @@ def solve_bucket(
     ):
         warm_states, wm = warm, warm_mask  # pre-placed by place_warm
         if not isinstance(wm, jax.Array):
-            wm = jnp.asarray(np.asarray(wm, dtype=bool))
+            wm_h = np.asarray(wm, dtype=bool)
+            if mesh is not None:
+                wm = jax.device_put(
+                    wm_h, mesh_lib.batch_sharding(mesh, 1, batch_axis)
+                )
+            else:
+                wm = jnp.asarray(wm_h)
     else:
         warm_states, wm = place_warm(
             warm, warm_mask, (Bsz, A.shape[1], n), cfg,

@@ -775,7 +775,19 @@ def solve_pdhg_bucket(
     if isinstance(batch.A, jax.Array) and batch.A.dtype == dtype:
         A, b, c = batch.A, batch.b, batch.c
         if not isinstance(active, jax.Array):
-            active = jnp.asarray(np.asarray(active, dtype=bool))
+            # Commit a host mask against the same mesh sharding as the
+            # pre-placed batch — a bare jnp.asarray pins it to the
+            # default local device, which a multi-process program
+            # cannot consume (see batched.solve_bucket).
+            from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+            act_h = np.asarray(active, dtype=bool)
+            if mesh is not None:
+                active = jax.device_put(
+                    act_h, mesh_lib.batch_sharding(mesh, 1, batch_axis)
+                )
+            else:
+                active = jnp.asarray(act_h)
     else:
         placed, active = place_bucket(
             batch, active, cfg, mesh=mesh, batch_axis=batch_axis

@@ -824,10 +824,13 @@ def cmd_report(args) -> int:
 
 def cmd_check(args) -> int:
     """graftcheck: run the repo's static-analysis suite (jit/recompile
-    hygiene, dtype discipline, lock discipline, JSONL schema) over the
-    given paths. Exit 0 iff there are no unsuppressed findings — this is
-    the tier-1 CI gate (README "Static analysis"). Pure stdlib: no jax
-    import, sub-second on CPU."""
+    hygiene, dtype discipline, lock + static deadlock discipline, SPMD
+    discipline, JSONL schema) over the given paths. Exit 0 iff there are
+    no unsuppressed findings — this is the tier-1 CI gate (README
+    "Static analysis"). With ``--baseline`` the gate is incremental:
+    only findings NOT in the committed baseline fail (the cheap
+    diff-gate downstream PRs ride; this repo commits an EMPTY baseline).
+    Pure stdlib: no jax import, a few seconds on CPU."""
     import os
 
     from distributedlpsolver_tpu import analysis
@@ -847,11 +850,37 @@ def cmd_check(args) -> int:
     except ValueError as e:  # unknown rule name
         print(f"check: {e}", file=sys.stderr)
         return 2
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as fh:
+            fh.write(analysis.write_baseline(findings) + "\n")
+        print(
+            f"check: wrote baseline of "
+            f"{sum(1 for f in findings if not f.suppressed)} finding(s) "
+            f"to {args.write_baseline}",
+            file=sys.stderr,
+        )
+        return 0
+    gating = [f for f in findings if not f.suppressed]
+    if args.baseline:
+        try:
+            with open(args.baseline) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"check: --baseline {args.baseline!r}: {e}", file=sys.stderr)
+            return 2
+        gating = analysis.diff_baseline(findings, doc)
+        known = sum(1 for f in findings if not f.suppressed) - len(gating)
+        if known:
+            print(
+                f"check: {known} known finding(s) covered by baseline "
+                f"{args.baseline}",
+                file=sys.stderr,
+            )
     if args.json:
         print(analysis.render_json(findings))
     else:
         print(analysis.render_text(findings, show_suppressed=args.show_suppressed))
-    return 1 if any(not f.suppressed for f in findings) else 0
+    return 1 if gating else 0
 
 
 def cmd_backends(_args) -> int:
@@ -1195,6 +1224,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap_c.add_argument(
         "--show-suppressed", action="store_true",
         help="also print findings silenced by graftcheck directives",
+    )
+    ap_c.add_argument(
+        "--baseline", default=None, metavar="JSON",
+        help="incremental diff-gate: fail only on findings absent from "
+        "this committed baseline (see --write-baseline); the tier-1 "
+        "gate runs against the empty BASELINE_GRAFTCHECK.json",
+    )
+    ap_c.add_argument(
+        "--write-baseline", default=None, metavar="JSON",
+        help="write the current unsuppressed findings as a baseline "
+        "document and exit 0 (adopt-then-ratchet for existing trees)",
     )
     ap_c.set_defaults(fn=cmd_check)
 

@@ -249,15 +249,22 @@ class World:
         self._monitor_thread.start()
 
     def _write_beat(self) -> None:
+        from distributedlpsolver_tpu.utils.logging import stamp_record
+
         path = self._hb_path(self.rank)
         tmp = f"{path}.{os.getpid()}.tmp"
+        # Stamped like every other record a consumer may merge: the
+        # launcher reads mtimes, but post-mortem tooling concatenates
+        # beat files into the world's JSONL view and needs the shared
+        # schema_version/ts/t_mono header.
         payload = json.dumps(
-            {
-                "rank": self.rank,
-                "pid": os.getpid(),
-                "ts": time.time(),
-                "generation": self.cfg.generation,
-            }
+            stamp_record(
+                {
+                    "rank": self.rank,
+                    "pid": os.getpid(),
+                    "generation": self.cfg.generation,
+                }
+            )
         )
         try:
             with open(tmp, "w") as fh:

@@ -163,54 +163,61 @@ class BackendRegistry:
 
     # -- writes -----------------------------------------------------------
 
-    def _count_write(self, applied: bool):  # holds: _lock
+    def _count_write(self, applied: bool):
         key = "true" if applied else "false"
-        ctr = self._m_writes.get(key)
-        if ctr is None:
-            ctr = self._metrics.counter(
-                "registry_writes_total",
-                labels={"applied": key},
-                help="registry mutation attempts (false = stale, skipped)",
-            )
-            self._m_writes[key] = ctr
+        with self._lock:
+            ctr = self._m_writes.get(key)
+            if ctr is None:
+                ctr = self._metrics.counter(
+                    "registry_writes_total",
+                    labels={"applied": key},
+                    help="registry mutation attempts (false = stale, skipped)",
+                )
+                self._m_writes[key] = ctr
         return ctr
 
     def update(self, mutate: Callable[[dict], bool]) -> Optional[dict]:
-        """Locked read-modify-write: ``mutate(backends)`` edits the
-        backend table in place and returns True iff something changed.
-        Applied changes bump the generation and land via atomic rename.
-        Returns the written document, or None when nothing changed or
-        the lease could not be taken (callers retry on their next
-        poll — the registry favors availability over blocking)."""
-        with self._lock:
-            if not self._acquire_lease():
+        """Lease-serialized read-modify-write: ``mutate(backends)`` edits
+        the backend table in place and returns True iff something
+        changed. Applied changes bump the generation and land via atomic
+        rename. Returns the written document, or None when nothing
+        changed or the lease could not be taken (callers retry on their
+        next poll — the registry favors availability over blocking).
+
+        The file lease is the ONLY serialization: it already excludes
+        writers across processes AND across threads of one process, so
+        holding an in-process lock around the RMW would add nothing but
+        a place for the router's poll thread to sleep behind a peer's
+        lease wait + fsync (blocking-under-lock). ``_lock`` guards only
+        the lazily-built metrics map."""
+        if not self._acquire_lease():
+            self._count_write(False).inc()
+            return None
+        try:
+            data = self.load()
+            changed = bool(mutate(data["backends"]))
+            if not changed:
                 self._count_write(False).inc()
                 return None
-            try:
-                data = self.load()
-                changed = bool(mutate(data["backends"]))
-                if not changed:
-                    self._count_write(False).inc()
-                    return None
-                data["generation"] = int(data["generation"]) + 1
-                data["writer"] = self.writer_id
-                data["updated_ts"] = time.time()
-                for entry in data["backends"].values():
-                    entry.setdefault("gen", data["generation"])
-                tmp = f"{self.path}.{os.getpid()}.tmp"
-                with open(tmp, "w") as fh:
-                    json.dump(data, fh)
-                    fh.flush()
-                    os.fsync(fh.fileno())
-                os.replace(tmp, self.path)
-                self._m_generation.set(float(data["generation"]))
-                self._count_write(True).inc()
-                return data
-            except OSError:
-                self._count_write(False).inc()
-                return None
-            finally:
-                self._release_lease()
+            data["generation"] = int(data["generation"]) + 1
+            data["writer"] = self.writer_id
+            data["updated_ts"] = time.time()
+            for entry in data["backends"].values():
+                entry.setdefault("gen", data["generation"])
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(data, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+            self._m_generation.set(float(data["generation"]))
+            self._count_write(True).inc()
+            return data
+        except OSError:
+            self._count_write(False).inc()
+            return None
+        finally:
+            self._release_lease()
 
     # -- the router-facing surface ----------------------------------------
 

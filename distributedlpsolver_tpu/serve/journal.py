@@ -170,6 +170,10 @@ class JobJournal:
         self._nonce = ""  # guarded-by: _lock
         self._pending: Dict[str, JournaledJob] = {}  # guarded-by: _lock
         self._results: "OrderedDict[str, str]" = OrderedDict()  # jid -> path; guarded-by: _lock
+        # jids whose result file is mid-write outside the lock — keeps
+        # the finish() idempotency window closed without holding the
+        # WAL lock across the disk write.
+        self._finishing: set = set()  # guarded-by: _lock
         self._records_since_compact = 0  # guarded-by: _lock
         self.write_errors = 0  # guarded-by: _lock
         self._writes = 0  # guarded-by: _lock
@@ -381,42 +385,70 @@ class JobJournal:
         """Journal the terminal verdict and persist the result record to
         the bounded store. Idempotent: the second finish of one jid is a
         counted no-op, so a replayed job racing its pre-crash completion
-        can never double-record (or double-serve) a result."""
+        can never double-record (or double-serve) a result.
+
+        The result-store write (a whole result record — solution vector
+        included — plus an optional fsync) happens OUTSIDE the WAL lock:
+        submit/poll/mark callers must never queue behind a disk write
+        that only this jid cares about. ``_finishing`` keeps the
+        idempotency window closed while the file is in flight; the WAL
+        lock is held only for the in-memory commit + the one-line
+        ``finished`` append."""
         with self._lock:
-            if jid in self._results:
+            if jid in self._results or jid in self._finishing:
                 return False  # already finished (replay raced completion)
+            self._finishing.add(jid)
+            # The job stays in _pending until the commit block below: a
+            # concurrent compact() must keep writing its admitted record
+            # while the result file is still in flight, or a crash in
+            # the window would lose acknowledged work.
+        path = os.path.join(self.results_dir, f"{jid}.json")
+        tmp = path + ".tmp"
+        wrote = True
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(record, fh)
+                if self.fsync == "always":
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            wrote = False
+        except BaseException:
+            # Unexpected failure (e.g. an unserializable record): reopen
+            # the idempotency window before propagating, or the jid
+            # would be stuck "finishing" forever.
+            with self._lock:
+                self._finishing.discard(jid)
+            raise
+        evicted: List[str] = []
+        with self._lock:
+            self._finishing.discard(jid)
             self._pending.pop(jid, None)
             self._m_pending.set(len(self._pending))
-            path = os.path.join(self.results_dir, f"{jid}.json")
-            tmp = path + ".tmp"
-            try:
-                with open(tmp, "w") as fh:
-                    json.dump(record, fh)
-                    if self.fsync == "always":
-                        fh.flush()
-                        os.fsync(fh.fileno())
-                os.replace(tmp, path)
-            except OSError:
-                self.write_errors += 1
-                self._m_write_errors.inc()
-            else:
+            if wrote:
                 self._results[jid] = path
                 # All stored results are resolved by construction —
                 # eviction reclaims the oldest poll URLs, never
                 # unfinished work.
                 while len(self._results) > self.results_cap:
-                    old_jid, old_path = self._results.popitem(last=False)
-                    try:
-                        os.remove(old_path)
-                    except OSError:
-                        pass
+                    _old_jid, old_path = self._results.popitem(last=False)
+                    evicted.append(old_path)
                     self._m_evicted.inc()
+            else:
+                self.write_errors += 1
+                self._m_write_errors.inc()
             self._append_locked(
                 {"j": "finished", "jid": jid, "status": status}
             )
             compact_due = (
                 self._records_since_compact >= self.compact_every
             )
+        for old_path in evicted:
+            try:
+                os.remove(old_path)
+            except OSError:
+                pass
         if compact_due:
             self.compact()
         return True
@@ -424,12 +456,19 @@ class JobJournal:
     # -- reads (the poll path) --------------------------------------------
 
     def is_pending(self, jid: str) -> bool:
+        # A jid whose result file is mid-write (outside the lock) is
+        # still pending to pollers — without _finishing here, a poll
+        # racing finish() would see neither pending nor done.
         with self._lock:
-            return jid in self._pending
+            return jid in self._pending or jid in self._finishing
 
     def known(self, jid: str) -> bool:
         with self._lock:
-            return jid in self._pending or jid in self._results
+            return (
+                jid in self._pending
+                or jid in self._results
+                or jid in self._finishing
+            )
 
     def result(self, jid: str) -> Optional[dict]:
         """The stored result record for ``jid``, or None (pending,

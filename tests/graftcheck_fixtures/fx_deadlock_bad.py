@@ -1,0 +1,34 @@
+"""Seeded static-deadlock violations (graftcheck twin test, pkg_path
+serve/fx.py): a cross-method lock-order cycle the dynamic recorder
+would only catch if a run happened to interleave it, and a blocking
+HTTP round-trip held under a lock."""
+
+import threading
+import urllib.request
+
+
+class Pipeline:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def pack(self):
+        # a -> b, through a call: the edge the lexical checker of PR 6
+        # could not see.
+        with self._a:
+            self._note()
+
+    def _note(self):
+        with self._b:
+            pass
+
+    def solve(self):
+        # b -> a: closes the cycle with pack()'s a -> b.
+        with self._b:
+            with self._a:
+                pass
+
+    def push(self, payload):
+        # blocking-under-lock: an HTTP round-trip while holding _a.
+        with self._a:
+            urllib.request.urlopen("http://example/submit", payload)

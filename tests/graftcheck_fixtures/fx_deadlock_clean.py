@@ -1,0 +1,34 @@
+"""Clean twin of fx_deadlock_bad.py (pkg_path serve/fx.py): one global
+acquisition order (a before b, everywhere, including through calls) and
+the blocking round-trip moved outside the lock."""
+
+import threading
+import urllib.request
+
+
+class Pipeline:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def pack(self):
+        with self._a:
+            self._note()
+
+    def _note(self):
+        with self._b:
+            pass
+
+    def solve(self):
+        # Same a -> b order as pack(): the graph stays acyclic.
+        with self._a:
+            with self._b:
+                pass
+
+    def push(self, payload):
+        with self._a:
+            body = self._render(payload)
+        urllib.request.urlopen("http://example/submit", body)
+
+    def _render(self, payload):
+        return payload

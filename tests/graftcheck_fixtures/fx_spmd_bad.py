@@ -1,0 +1,64 @@
+"""Seeded spmd-* violations (graftcheck twin test, pkg_path
+distributed/fx.py). Every def here breaks the multi-host SPMD contract
+one way: a rank-gated collective, an early rank exit skipping one, a
+rank fact passed into a param-sensitive callee, unordered iteration
+feeding world-visible publication, and an uncommitted array entering a
+mesh program."""
+
+import os
+
+import jax.numpy as jnp
+
+
+def rank_gated_report(world, stats):
+    # spmd-divergent-collective: only rank 0 reaches the barrier; every
+    # follower hangs in it forever.
+    if world.rank == 0:
+        world.barrier("report")
+    return stats
+
+
+def early_exit_skips_collective(world, value):
+    # spmd-divergent-collective: nonzero ranks leave before the
+    # allgather the primary then blocks in.
+    primary = world.rank == 0
+    if not primary:
+        return None
+    return world.allgather(value)
+
+
+def _publish_if(primary, world):
+    if primary:
+        world.barrier("pub")
+
+
+def caller(world):
+    # spmd-divergent-collective (call-argument taint): the divergence
+    # lives one call down, seeded here.
+    _publish_if(world.rank == 0, world)
+
+
+def replay_dispatches(control, journal_dir):
+    # spmd-unordered-dispatch: filesystem order feeds the dispatch
+    # journal — ranks replay in different orders.
+    for fname in os.listdir(journal_dir):
+        control.publish({"f": fname})
+
+
+def warm_world(service, shapes):
+    # spmd-unordered-dispatch: set order differs per process hash seed,
+    # so the warm-up publication order diverges across the world.
+    pending = set(shapes)
+    for spec in pending:
+        service.publish(spec)
+
+
+def dispatch_bucket(batch, active, cfg, mesh):
+    # spmd-uncommitted-input: a bare default-device commit entering the
+    # mesh program.
+    act = jnp.asarray(active)
+    return solve_bucket(batch, act, cfg, mesh=mesh)
+
+
+def solve_bucket(batch, active, cfg, mesh=None):
+    return batch

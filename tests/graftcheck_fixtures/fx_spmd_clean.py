@@ -1,0 +1,63 @@
+"""Clean twin of fx_spmd_bad.py (pkg_path distributed/fx.py): the same
+shapes written the way the SPMD contract wants them — world-uniform
+branches, unconditional collectives, sorted world-visible iteration,
+committed placements, and the sanctioned single-device fallback."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def world_report(world, stats):
+    # Every rank runs the collective; branching on world_size is fine —
+    # it is identical on every rank (world-uniform), unlike rank.
+    vals = world.allgather(stats)
+    if world.world_size > 1:
+        return vals
+    return [stats]
+
+
+def replay_dispatches(control, journal_dir):
+    # Deterministic replay order on every rank.
+    for fname in sorted(os.listdir(journal_dir)):
+        control.publish({"f": fname})
+
+
+def count_dispatches(journal_dir):
+    # Order-insensitive consumers never publish iteration order.
+    return sum(1 for f in os.listdir(journal_dir) if f.endswith(".npz"))
+
+
+def warm_world(service, shapes):
+    for spec in sorted(set(shapes)):
+        service.publish(spec)
+
+
+def dispatch_bucket(batch, active, cfg, mesh):
+    # Committed placement: the mask rides the same batch-axis sharding
+    # as the data.
+    act = put_global(active, batch_sharding(mesh, 1))
+    return solve_bucket(batch, act, cfg, mesh=mesh)
+
+
+def place_local(active, mesh=None):
+    # The single-device fallback: a bare put is exactly right when the
+    # mesh is absent.
+    if mesh is None:
+        act = jnp.asarray(active)
+    else:
+        act = jax.device_put(active, batch_sharding(mesh, 1))
+    return act
+
+
+def put_global(x, sharding):
+    return x
+
+
+def batch_sharding(mesh, ndim):
+    return None
+
+
+def solve_bucket(batch, active, cfg, mesh=None):
+    return batch
