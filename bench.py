@@ -1319,6 +1319,254 @@ def _bench_multihost(args) -> list:
     return rows
 
 
+def _bench_elastic(args) -> list:
+    """Closed-loop elasticity rows (``--elastic``): a deterministic
+    LoadRamp over a LIVE plane — one router over the shared registry, a
+    backend pool owned by an in-process ElasticController — measuring
+    what the closed loop buys and costs: sync p50/p99 before / during /
+    after the ramp, the pool-size trajectory, scale-out lead times
+    (signal observed -> backend serving), the brownout ladder's engaged
+    window and shed count, and graceful scale-in on release. Shapes are
+    calibrated so one CPU backend genuinely saturates under the peak
+    (~32 rps capacity at batch 4 vs the 48 rps peak) — the overload is
+    real, not simulated. CPU-harness figures measure the control loop
+    and serving plane, not TPU speed; ``--require-tpu`` aborts before
+    any fallback row here like everywhere else."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from distributedlpsolver_tpu.net.chaos import ChaosPlane, LoadRamp
+    from distributedlpsolver_tpu.obs.stats import percentile
+    from distributedlpsolver_tpu.serve.elastic import (
+        ElasticConfig,
+        ElasticController,
+    )
+
+    shape = (96, 288)
+    n_ramp = 120 if args.quick else 240
+
+    def post(url, body=None, timeout=60.0):
+        req = urllib.request.Request(
+            url,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"} if body else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:
+                return e.code, {}
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            return 599, {"error": f"{type(e).__name__}: {e}"}
+
+    workdir = tempfile.mkdtemp(prefix="dlps-bench-elastic-")
+    plane = ChaosPlane(workdir)
+    registry_path = os.path.join(workdir, "registry.json")
+    buckets_json = os.path.join(workdir, "ladder.json")
+    with open(buckets_json, "w") as fh:
+        fh.write(json.dumps([{"m": shape[0], "n": shape[1], "batch": 4}]))
+    brownout = {
+        "depth_high": 0.5, "depth_low": 0.125, "reject_rate_high": 1.0,
+        "engage_after_s": 0.2, "escalate_after_s": 0.4,
+        "release_after_s": 0.5, "retry_after_s": 0.05,
+    }
+    ctl = ElasticController(ElasticConfig(
+        registry_path=registry_path,
+        min_backends=1,
+        max_backends=3,
+        poll_s=0.2,
+        load_high=6.0,
+        reject_rate_high=0.5,
+        out_sustain_s=0.4,
+        load_low=1.0,
+        in_sustain_s=2.0,
+        cooldown_s=1.0,
+        flap_window_s=60.0,
+        flap_max_actions=24,
+        workdir=workdir,
+        buckets_json=buckets_json,
+        backend_flags=(
+            "--flush-ms", "20", "--batch", "4", "--queue-depth", "16",
+            "--brownout", json.dumps(brownout, separators=(",", ":")),
+            "--quiet",
+        ),
+        heartbeat_s=0.25,
+    ))
+    try:
+        t0 = time.perf_counter()
+        ctl.start()
+        if ctl.pool_size() < 1:
+            raise RuntimeError("elastic bench: min pool never came up")
+        router = plane.spawn_router("bench-router", [], registry_path)
+        if not plane.wait_ready(router, 60):
+            raise RuntimeError("elastic bench: router never came up")
+        adopt_deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < adopt_deadline:
+            c, o = post(router.url + "/statusz", timeout=5.0)
+            if c == 200 and any(
+                b.get("healthy") for b in o.get("backends", [])
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("elastic bench: router never adopted pool")
+        _log(
+            f"elastic plane up in {time.perf_counter() - t0:.1f}s "
+            f"(pool {ctl.pool_size()}, router {router.url})"
+        )
+
+        def sync_wave(n, tag, gap_fn):
+            """Fire n sync solves (one thread each, gap_fn(k)-paced) and
+            return per-request submit->verdict walls in ms. 429s retry
+            after the structured hint — the retry wait is PART of the
+            measured latency, which is the point: brownout converts
+            overload into bounded extra latency, not lost work."""
+            lat, lock = [], threading.Lock()
+
+            def drive(k):
+                t = time.perf_counter()
+                deadline = t + 120.0
+                while True:
+                    c, o = post(
+                        router.url + "/v1/solve",
+                        {"m": shape[0], "n": shape[1], "seed": k,
+                         "tenant": "bench", "id": f"{tag}-{k}"},
+                    )
+                    if c == 429:
+                        time.sleep(min(
+                            float(o.get("retry_after_s", 0.05) or 0.05), 1.0
+                        ))
+                    elif c in (502, 503, 599):
+                        if time.perf_counter() > deadline:
+                            return
+                        time.sleep(0.05)
+                    else:
+                        break
+                if c == 200 and o.get("status") == "optimal":
+                    with lock:
+                        lat.append((time.perf_counter() - t) * 1e3)
+
+            ws = []
+            for k in range(n):
+                w = threading.Thread(target=drive, args=(k,), daemon=True)
+                w.start()
+                ws.append(w)
+                time.sleep(gap_fn(k))
+            for w in ws:
+                w.join(timeout=180)
+            return lat
+
+        # Phase 1 — base: trickle load on the min pool (steady-state
+        # latency floor the ramp phases are compared against).
+        n_base = 8 if args.quick else 12
+        lat_base = sync_wave(n_base, "base", lambda k: 0.5)
+
+        # Phase 2 — ramp: LoadRamp to a saturating peak; a monitor
+        # samples pool size and the max brownout stage across backends.
+        ramp = LoadRamp(n_ramp, peak_rps=48.0, base_rps=3.0)
+        done = threading.Event()
+        pool_peak = [ctl.pool_size()]
+        brownout_samples = []  # (t_rel_s, max stage across the pool)
+        t_ramp = time.perf_counter()
+
+        def monitor():
+            while not done.is_set():
+                pool_peak[0] = max(pool_peak[0], ctl.pool_size())
+                stage = 0
+                for m in ctl.statusz()["pool"]:
+                    c, o = post(m["url"] + "/statusz", timeout=2.0)
+                    if c == 200:
+                        bo = (o.get("stats") or {}).get("brownout") or {}
+                        stage = max(stage, int(bo.get("stage", 0) or 0))
+                brownout_samples.append(
+                    (time.perf_counter() - t_ramp, stage)
+                )
+                done.wait(0.1)
+
+        mon = threading.Thread(target=monitor, daemon=True)
+        mon.start()
+        n_actions_pre = len(ctl.actions())
+        lat_ramp = sync_wave(n_ramp, "ramp", ramp.gap_s)
+        ramp_wall = time.perf_counter() - t_ramp
+        done.set()
+        mon.join(timeout=30)
+
+        # Phase 3 — settle: wait for the drain back to min_backends,
+        # then re-measure the trickle (did release restore the floor?).
+        t_in = time.perf_counter()
+        while time.perf_counter() - t_in < 120.0:
+            if ctl.pool_size() <= ctl.config.min_backends:
+                break
+            time.sleep(0.3)
+        scale_in_wall = time.perf_counter() - t_in
+        lat_settle = sync_wave(n_base, "settle", lambda k: 0.5)
+
+        actions = ctl.actions()[n_actions_pre:]
+        outs = [a for a in actions if a["event"] == "scale_out"]
+        ins = [a for a in actions if a["event"] == "scale_in"]
+        engaged = [t for t, s in brownout_samples if s >= 1]
+        hist = ctl.history()
+        rows = []
+        for phase, lat, extra in (
+            ("base", lat_base, {"n": n_base, "pool": 1}),
+            ("ramp", lat_ramp, {
+                "n": n_ramp,
+                "wall_s": round(ramp_wall, 3),
+                "peak_rps": 48.0,
+                "pool_peak": pool_peak[0],
+                "scale_outs": len(outs),
+                "scale_out_lead_ms": (
+                    [round(a["ms"]) for a in outs] or None
+                ),
+                "brownout_stage_peak": max(
+                    (s for _, s in brownout_samples), default=0
+                ),
+                "brownout_engaged_s": round(
+                    max(engaged) - min(engaged), 3
+                ) if engaged else 0.0,
+            }),
+            ("settle", lat_settle, {
+                "n": n_base,
+                "pool": ctl.pool_size(),
+                "scale_ins": len(ins),
+                "drained": sum(bool(a.get("drained")) for a in ins),
+                "scale_in_wall_s": round(scale_in_wall, 3),
+            }),
+        ):
+            row = {
+                "family": "elastic",
+                "phase": phase,
+                "instance": f"dense {shape[0]}x{shape[1]} batch=4",
+                "completed": len(lat),
+                "latency_ms_p50": (
+                    round(percentile(lat, 50), 3) if lat else None
+                ),
+                "latency_ms_p99": (
+                    round(percentile(lat, 99), 3) if lat else None
+                ),
+                "platform": args.platform,
+                **extra,
+            }
+            rows.append(row)
+            _log(json.dumps(row))
+        # The trajectory rides the ramp row (it IS the ramp's story);
+        # sampled at the controller's own control cycle.
+        rows[1]["pool_trajectory"] = [
+            [round(t, 2), n] for t, n in hist
+        ]
+        return rows
+    finally:
+        ctl.shutdown(drain=False)
+        plane.shutdown_all()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (smoke)")
@@ -1344,6 +1592,12 @@ def main() -> int:
                     "through 1 vs N jax.distributed processes "
                     "(sharded backend, CPU harness; --require-tpu "
                     "honored) -> BENCH_MULTIHOST.json")
+    ap.add_argument("--elastic", action="store_true",
+                    help="closed-loop elasticity rows: sync p50/p99 "
+                    "before/during/after a saturating LoadRamp over a "
+                    "live router + ElasticController pool, with the "
+                    "pool trajectory, scale-out lead times, and the "
+                    "brownout engaged window -> BENCH_ELASTIC.json")
     ap.add_argument("--serve-http", action="store_true",
                     help="serving rows incl. the HTTP network plane: the "
                     "in-process row plus a localhost POST /v1/solve row, "
@@ -1413,6 +1667,17 @@ def main() -> int:
         _log(f"multihost rows -> {out}")
         print(json.dumps(rows[-1]))  # headline: the widest world's row
         return 0  # multihost tier is its own run; no headline solve after
+
+    if args.elastic:
+        rows = _bench_elastic(args)
+        for r in rows:
+            r.setdefault("metrics", _obs_row(args.platform))
+        out = os.path.join(_REPO, "BENCH_ELASTIC.json")
+        with open(out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+        _log(f"elastic rows -> {out}")
+        print(json.dumps(rows[1]))  # headline: the ramp row
+        return 0  # elasticity tier is its own run; no headline solve after
 
     if args.scenario:
         rows = _bench_scenario(args)

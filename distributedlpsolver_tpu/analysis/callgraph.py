@@ -439,6 +439,38 @@ class TaintEngine:
                         return True
         return False
 
+    def comp_rank_filters(
+        self,
+        unit: FunctionUnit,
+        tainted_names: Set[str],
+        extra_tainted_fns: Optional[Set[FuncKey]] = None,
+    ) -> List[Tuple[ast.AST, ast.AST]]:
+        """Comprehensions whose generator filters test a rank-derived
+        value — ``[f(x) for x in xs if rank == 0]`` runs its element a
+        different number of times per rank, the same divergence an
+        ``if`` statement would carry, but invisible to any walker that
+        only looks at ``ast.If``/``ast.While`` tests. Returns
+        ``(comprehension, tainted_filter)`` pairs."""
+        out: List[Tuple[ast.AST, ast.AST]] = []
+        for node in ast.walk(unit.node):
+            if not isinstance(
+                node,
+                (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp),
+            ):
+                continue
+            for gen in node.generators:
+                hit = None
+                for cond in gen.ifs:
+                    if self.expr_tainted(
+                        cond, tainted_names, extra_tainted_fns
+                    ):
+                        hit = cond
+                        break
+                if hit is not None:
+                    out.append((node, hit))
+                    break
+        return out
+
     def tainted_names(
         self,
         unit: FunctionUnit,

@@ -142,6 +142,17 @@ JSONL_EVENT_TYPES = {
     "world_reinit",
     "slice_register",
     "heartbeat",
+    # Closed-loop elasticity (serve/elastic.py, net/admission.py
+    # BrownoutController, net/router.py circuit breaker): one record per
+    # controller scale action (or vetoed intent), per brownout-ladder
+    # stage transition, and per breaker state change on a backend.
+    "scale_out",
+    "scale_in",
+    "scale_veto",
+    "brownout_enter",
+    "brownout_exit",
+    "breaker_open",
+    "breaker_close",
 }
 
 # Every field a stamped JSONL record may carry, across all streams: the
@@ -293,6 +304,14 @@ JSONL_FIELDS = {
     "stage",
     "deadline_ts",
     "pid",
+    # closed-loop elasticity: scale_out/scale_in/scale_veto events carry
+    # the pool size after the action and the controller's target; the
+    # breaker_open event attributes its trip (observed error rate over
+    # the outcome window, hold before the half-open probe).
+    "pool",
+    "target",
+    "error_rate",
+    "backoff_s",
 }
 
 # ``X.write(json.dumps(...))`` record emission points that must stamp:

@@ -186,6 +186,44 @@ def check_divergent_collective(project: ProjectContext) -> List[Finding]:
                     )
                 )
 
+        # Comprehension filters guarded by a rank-derived test: the
+        # element expression runs a different number of times per rank,
+        # so a collective inside it diverges exactly like an ``if``
+        # branch — but lives in a generator's ``ifs``, which the
+        # statement walk above never visits.
+        for comp, _cond in taint.comp_rank_filters(unit, local_taint):
+            bodies = (
+                [comp.key, comp.value]
+                if isinstance(comp, ast.DictComp)
+                else [comp.elt]
+            )
+            hit = None
+            for body in bodies:
+                for call, resolved, term in _calls_in(body, site_map):
+                    chain = call_chain(call, resolved, term)
+                    if chain:
+                        hit = chain
+                        break
+                if hit:
+                    break
+            if hit:
+                out.append(
+                    Finding(
+                        rule="spmd-divergent-collective",
+                        path=unit.ctx.path,
+                        line=comp.lineno,
+                        col=comp.col_offset,
+                        message=(
+                            f"rank-derived comprehension filter in "
+                            f"{key[1]}() gates collective "
+                            f"`{_chain_str(hit)}` — ranks that filter "
+                            "out the element skip the collective their "
+                            "peers enter (sanction deliberate seams in "
+                            "analysis/config.SPMD_SANCTIONED)"
+                        ),
+                    )
+                )
+
         # Rank facts passed into param-sensitive callees.
         for call, resolved, term in unit.call_sites:
             if resolved is None or resolved not in param_divergent:

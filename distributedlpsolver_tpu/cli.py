@@ -338,6 +338,21 @@ def _admission_from(args):
     return AdmissionConfig(**kwargs)
 
 
+def _brownout_from(args):
+    """BrownoutConfig from ``--brownout`` (``on`` for defaults, or
+    inline JSON overriding any BrownoutConfig field, e.g.
+    ``{"depth_high": 0.6, "engage_after_s": 0.5}``). None when the
+    flag is absent — no brownout ladder."""
+    spec = getattr(args, "brownout", None)
+    if not spec:
+        return None
+    from distributedlpsolver_tpu.net.admission import BrownoutConfig
+
+    if spec.strip().lower() == "on":
+        return BrownoutConfig()
+    return BrownoutConfig(**json.loads(spec))
+
+
 def _service_config_from(args) -> "ServiceConfig":
     """The ServiceConfig both ``serve`` and ``serve-http`` build from
     the shared serving flags."""
@@ -360,6 +375,7 @@ def _service_config_from(args) -> "ServiceConfig":
         admission=_admission_from(args),
         journal_dir=getattr(args, "journal_dir", None),
         journal_fsync=getattr(args, "journal_fsync", "flush"),
+        brownout=_brownout_from(args),
     )
 
 
@@ -493,6 +509,29 @@ def cmd_serve_http(args) -> int:
             # The /quitquitquit drain path closes the listener, then
             # this callback lets the process exit cleanly.
             server.on_drained = lambda drained: stopped.set()
+            # Self-registration + heartbeats into the shared registry —
+            # strictly AFTER warm-up and the listener bind, so an
+            # elastic rollout never exposes a backend whose bucket
+            # ladder isn't compiled yet (the zero-warm-recompile
+            # rollout contract; same beat loop as cli serve-slice).
+            hb_stop = threading.Event()
+            if getattr(args, "registry", None):
+                from distributedlpsolver_tpu.net.registry import (
+                    BackendRegistry,
+                )
+
+                breg = BackendRegistry(
+                    args.registry, logger=svc._logger, metrics=reg
+                )
+                breg.register(server.url)
+
+                def _beat():
+                    while not hb_stop.wait(args.heartbeat_s):
+                        breg.heartbeat(server.url)
+
+                threading.Thread(
+                    target=_beat, daemon=True, name="dlps-http-hb"
+                ).start()
             print(
                 f"serving on {server.url} "
                 f"(POST /v1/solve; GET /metrics /healthz /readyz "
@@ -505,6 +544,7 @@ def cmd_serve_http(args) -> int:
             except KeyboardInterrupt:
                 print("shutting down", file=sys.stderr)
             finally:
+                hb_stop.set()
                 server.shutdown()
     finally:
         finalize_obs()
@@ -767,6 +807,64 @@ def cmd_route(args) -> int:
     return 0
 
 
+def cmd_elastic(args) -> int:
+    """Closed-loop elasticity controller: telemetry-driven backend pool
+    autoscaling over the shared registry (README "Elasticity & overload
+    protection")."""
+    from distributedlpsolver_tpu.obs import metrics as obs_metrics
+    from distributedlpsolver_tpu.serve.elastic import (
+        ElasticConfig,
+        ElasticController,
+    )
+
+    finalize_obs = _obs_setup(args)
+    reg = obs_metrics.get_registry()
+    if not reg.enabled:
+        reg = obs_metrics.MetricsRegistry()
+    backend_flags = []
+    for item in args.backend_flag or []:
+        backend_flags.extend(item.split())
+    ctl = ElasticController(
+        ElasticConfig(
+            registry_path=args.registry,
+            min_backends=args.min_backends,
+            max_backends=args.max_backends,
+            poll_s=args.poll_s,
+            load_high=args.load_high,
+            load_low=args.load_low,
+            reject_rate_high=args.reject_rate_high,
+            out_sustain_s=args.out_sustain_s,
+            in_sustain_s=args.in_sustain_s,
+            cooldown_s=args.cooldown_s,
+            host=args.host,
+            workdir=args.workdir,
+            buckets_json=args.buckets,
+            backend_flags=tuple(backend_flags),
+            heartbeat_s=args.heartbeat_s,
+            log_jsonl=args.log_jsonl,
+        ),
+        metrics=reg,
+    )
+    try:
+        ctl.start()
+        print(
+            f"elastic controller over {args.registry}: pool "
+            f"{args.min_backends}..{args.max_backends}, "
+            f"{ctl.pool_size()} up",
+            file=sys.stderr,
+        )
+        try:
+            import threading
+
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            print("draining managed pool", file=sys.stderr)
+    finally:
+        ctl.shutdown(drain=True)
+        finalize_obs()
+    return 0
+
+
 def cmd_autotune(args) -> int:
     """Refine a serve bucket ladder from a telemetry JSONL file and write
     it as a ladder JSON ``cli serve --buckets`` consumes."""
@@ -987,6 +1085,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             help="journal persistence per record: flush survives "
             "kill -9 (default), always additionally fsyncs",
         )
+        p.add_argument(
+            "--brownout", default=None,
+            help="overload brownout ladder: 'on' for defaults, or "
+            "inline JSON overriding BrownoutConfig fields, e.g. "
+            '{"depth_high": 0.6, "engage_after_s": 0.5} '
+            "(README 'Elasticity & overload protection')",
+        )
 
     ap_srv = sub.add_parser(
         "serve",
@@ -1032,6 +1137,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--warm-buckets", action="store_true",
         help="pre-compile the explicit --buckets ladder before binding "
         "the listener (restart recovery runs warm from request one)",
+    )
+    ap_http.add_argument(
+        "--registry", default=None,
+        help="shared backend-registry file: self-register AFTER "
+        "warm-up + listener bind and heartbeat (routers and the "
+        "elastic controller adopt this backend with no manual config)",
+    )
+    ap_http.add_argument(
+        "--heartbeat-s", type=float, default=1.0,
+        help="registry heartbeat cadence when --registry is set",
     )
     _add_serving_flags(ap_http)
     _add_solver_flags(ap_http)
@@ -1157,6 +1272,73 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap_rt.add_argument("--metrics-path", default=None, help=argparse.SUPPRESS)
     ap_rt.add_argument("--trace-path", default=None, help=argparse.SUPPRESS)
     ap_rt.set_defaults(fn=cmd_route)
+
+    ap_el = sub.add_parser(
+        "elastic",
+        help="closed-loop elasticity controller: scale serve-http "
+        "backends out/in from pool telemetry (README 'Elasticity & "
+        "overload protection')",
+    )
+    ap_el.add_argument(
+        "--registry", required=True,
+        help="shared backend-registry file the pool lives in",
+    )
+    ap_el.add_argument("--min-backends", type=int, default=1)
+    ap_el.add_argument("--max-backends", type=int, default=4)
+    ap_el.add_argument(
+        "--poll-s", type=float, default=0.5, help="decision cadence"
+    )
+    ap_el.add_argument(
+        "--load-high", type=float, default=8.0,
+        help="mean per-backend queued+inflight at/above which the pool "
+        "counts as overloaded",
+    )
+    ap_el.add_argument(
+        "--load-low", type=float, default=1.0,
+        help="mean load at/below which the pool counts as idle",
+    )
+    ap_el.add_argument(
+        "--reject-rate-high", type=float, default=1.0,
+        help="pool-wide admission rejects/sec that count as overload",
+    )
+    ap_el.add_argument(
+        "--out-sustain-s", type=float, default=1.0,
+        help="overload must hold this long before a scale-out",
+    )
+    ap_el.add_argument(
+        "--in-sustain-s", type=float, default=5.0,
+        help="idleness must hold this long before a scale-in",
+    )
+    ap_el.add_argument(
+        "--cooldown-s", type=float, default=5.0,
+        help="minimum quiet time between target changes",
+    )
+    ap_el.add_argument("--host", default="127.0.0.1")
+    ap_el.add_argument(
+        "--workdir", default=".",
+        help="spawned backends' journals and logs live here",
+    )
+    ap_el.add_argument(
+        "--buckets", default=None,
+        help="bucket ladder JSON spawned backends warm before they "
+        "register (the zero-warm-recompile rollout contract)",
+    )
+    ap_el.add_argument(
+        "--backend-flag", action="append", default=None,
+        help="extra serve-http flag(s) for spawned backends "
+        "(repeatable; each value is whitespace-split)",
+    )
+    ap_el.add_argument(
+        "--heartbeat-s", type=float, default=0.5,
+        help="registry heartbeat cadence of spawned backends",
+    )
+    ap_el.add_argument(
+        "--log-jsonl", default=None,
+        help="scale_out/scale_in/scale_veto JSONL event stream",
+    )
+    ap_el.add_argument("--metrics-path", default=None, help=argparse.SUPPRESS)
+    ap_el.add_argument("--trace-path", default=None, help=argparse.SUPPRESS)
+    ap_el.set_defaults(fn=cmd_elastic)
 
     ap_at = sub.add_parser(
         "autotune",

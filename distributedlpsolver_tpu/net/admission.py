@@ -356,3 +356,243 @@ class AdmissionController:
                     "weight": q.weight,
                 }
             return out
+
+
+# ---------------------------------------------------------------------------
+# Overload brownout ladder
+# ---------------------------------------------------------------------------
+
+# Stage semantics (cumulative — stage N applies every rung <= N):
+#   0  off           normal service
+#   1  shed_batch    batch-priority submits get a structured brownout
+#                    verdict with an honest Retry-After
+#   2  widen_flush   every admitted request's flush window widens by
+#                    ``flush_widen`` (fuller buckets, fewer dispatches)
+#   3  pdhg_reroute  tol-eligible traffic (request tol >= the floor)
+#                    routes to the cheaper PDHG engine; tight-tol work
+#                    stays on IPM untouched
+BROWNOUT_STAGES: Mapping[int, str] = {
+    0: "off",
+    1: "shed_batch",
+    2: "widen_flush",
+    3: "pdhg_reroute",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class BrownoutConfig:
+    """Staged-degradation policy for :class:`BrownoutController`.
+
+    The saturation signal is *sustained* queue depth (as a fraction of
+    the scheduler's depth bound) OR a sustained admission-reject rate;
+    instantaneous spikes never engage a stage, and release requires the
+    complement (below the LOW watermark) to hold just as long — classic
+    two-watermark hysteresis, so the ladder cannot flap with the queue.
+    """
+
+    # Depth watermarks as fractions of max_queue_depth: saturation at/
+    # above ``depth_high``; only depths at/below ``depth_low`` count as
+    # calm (between the two the current stage holds).
+    depth_high: float = 0.75
+    depth_low: float = 0.40
+    # Non-brownout rejections (depth/quota/fair) per second that also
+    # count as saturation — a service rejecting hard is overloaded even
+    # when its queue drains fast. Brownout sheds themselves are
+    # excluded from this rate or stage 1 would self-sustain forever.
+    reject_rate_high: float = 2.0
+    reject_window_s: float = 1.0
+    # Signal must hold this long before stage 1 engages; continued
+    # saturation escalates one stage per ``escalate_after_s``; sustained
+    # calm releases one stage per ``release_after_s``.
+    engage_after_s: float = 1.0
+    escalate_after_s: float = 2.0
+    release_after_s: float = 2.0
+    max_stage: int = 3
+    # Stage >= 2: flush-window multiplier on every admitted request.
+    flush_widen: float = 4.0
+    # Stage >= 3: request tols at/above this floor re-route to PDHG.
+    # Tighter requests NEVER re-route — the ladder degrades latency and
+    # throughput shape, not correctness.
+    pdhg_tol_floor: float = 1e-6
+    # Honest Retry-After carried by every shed verdict.
+    retry_after_s: float = 1.0
+
+
+class BrownoutController:
+    """Closed-loop staged degradation under overload.
+
+    The service calls :meth:`observe` with the current queue depth on
+    every submit (and may call it from its poll/stats paths), collects
+    the returned transition events into its JSONL stream, and consults
+    :meth:`should_shed` / :meth:`flush_widen` / :meth:`reroute_pdhg`
+    for the stage's rungs. :meth:`note_reject` feeds the reject-rate
+    half of the saturation signal (non-brownout rejections only).
+
+    Thread-safety: own lock; never calls out while holding it.
+    """
+
+    def __init__(
+        self,
+        config: Optional[BrownoutConfig] = None,
+        max_depth: int = 1024,
+        metrics: Optional[obs_metrics.MetricsRegistry] = None,
+        clock=time.perf_counter,
+    ):
+        self.config = config or BrownoutConfig()
+        self.max_depth = max(1, int(max_depth))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stage = 0  # guarded-by: _lock
+        self._sat_since: Optional[float] = None  # guarded-by: _lock
+        self._calm_since: Optional[float] = None  # guarded-by: _lock
+        self._stage_since = 0.0  # guarded-by: _lock
+        self._entered_at: Optional[float] = None  # guarded-by: _lock
+        self._rejects: list = []  # recent reject stamps; guarded-by: _lock
+        self._sheds = 0  # guarded-by: _lock
+        self._entries = 0  # guarded-by: _lock
+        m = metrics if metrics is not None else obs_metrics.get_registry()
+        self._m_stage = m.gauge(
+            "net_brownout_stage",
+            help="current brownout ladder stage (0 = off)",
+        )
+        self._m_sheds = m.counter(
+            "net_brownout_sheds_total",
+            help="batch-priority submits shed by the brownout ladder",
+        )
+
+    # -- saturation signal -----------------------------------------------
+
+    def note_reject(self, now: Optional[float] = None) -> None:
+        """One non-brownout rejection (depth/quota/fair) happened —
+        half of the saturation signal."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._rejects.append(now)
+            self._prune(now)
+
+    def _prune(self, now: float) -> None:  # holds: _lock
+        cutoff = now - self.config.reject_window_s
+        i = 0
+        for i, t in enumerate(self._rejects):
+            if t >= cutoff:
+                break
+        else:
+            i = len(self._rejects)
+        if i:
+            del self._rejects[:i]
+
+    def observe(self, depth: int, now: Optional[float] = None) -> list:
+        """Feed the current queue depth; returns the list of transition
+        event payloads (``brownout_enter`` per engage/escalation,
+        ``brownout_exit`` per release) for the caller to log — the
+        controller itself never touches a stream."""
+        cfg = self.config
+        now = self._clock() if now is None else now
+        events = []
+        with self._lock:
+            self._prune(now)
+            rate = len(self._rejects) / max(cfg.reject_window_s, 1e-9)
+            frac = depth / float(self.max_depth)
+            saturated = frac >= cfg.depth_high or rate >= cfg.reject_rate_high
+            calm = frac <= cfg.depth_low and rate < cfg.reject_rate_high
+            reason = (
+                "reject_rate" if rate >= cfg.reject_rate_high else "queue_depth"
+            )
+            if saturated:
+                self._calm_since = None
+                if self._sat_since is None:
+                    self._sat_since = now
+                held = now - self._sat_since
+                if self._stage == 0 and held >= cfg.engage_after_s:
+                    events.append(self._shift(+1, reason, depth, now))
+                elif (
+                    0 < self._stage < cfg.max_stage
+                    and now - self._stage_since >= cfg.escalate_after_s
+                ):
+                    events.append(self._shift(+1, reason, depth, now))
+            elif calm:
+                self._sat_since = None
+                if self._stage > 0:
+                    if self._calm_since is None:
+                        self._calm_since = now
+                    if (
+                        now - self._calm_since >= cfg.release_after_s
+                        and now - self._stage_since >= cfg.release_after_s
+                    ):
+                        events.append(self._shift(-1, "recovered", depth, now))
+            else:
+                # Between the watermarks: hysteresis — hold the stage,
+                # restart both sustain clocks.
+                self._sat_since = None
+                self._calm_since = None
+        return events
+
+    def _shift(
+        self, delta: int, reason: str, depth: int, now: float
+    ) -> dict:  # holds: _lock
+        prev = self._stage
+        self._stage = max(0, min(self.config.max_stage, prev + delta))
+        self._stage_since = now
+        self._m_stage.set(float(self._stage))
+        if delta > 0:
+            if prev == 0:
+                self._entered_at = now
+                self._entries += 1
+            self._sat_since = now  # escalation pacing restarts
+            return {
+                "event": "brownout_enter",
+                "stage": self._stage,
+                "reason": reason,
+                "queue_depth": depth,
+            }
+        self._calm_since = now
+        ev = {
+            "event": "brownout_exit",
+            "stage": self._stage,
+            "reason": reason,
+            "queue_depth": depth,
+        }
+        if self._stage == 0 and self._entered_at is not None:
+            ev["ms"] = round((now - self._entered_at) * 1e3, 3)
+            self._entered_at = None
+        return ev
+
+    # -- stage rungs ------------------------------------------------------
+
+    def stage(self) -> int:
+        with self._lock:
+            return self._stage
+
+    def should_shed(self, priority: str) -> bool:
+        """Stage >= 1 sheds batch-priority work (and only batch —
+        normal/high traffic keeps flowing, just batched differently)."""
+        with self._lock:
+            if self._stage >= 1 and priority == "batch":
+                self._sheds += 1
+                self._m_sheds.inc()
+                return True
+            return False
+
+    def flush_widen(self) -> float:
+        with self._lock:
+            return self.config.flush_widen if self._stage >= 2 else 1.0
+
+    def reroute_pdhg(self, tol: float) -> bool:
+        """Stage >= 3 routes tol-eligible work to PDHG. The floor is a
+        hard correctness line: requests tighter than it never re-route."""
+        with self._lock:
+            return self._stage >= 3 and tol >= self.config.pdhg_tol_floor
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "stage": self._stage,
+                "stage_name": BROWNOUT_STAGES.get(self._stage, "?"),
+                "sheds": self._sheds,
+                "entries": self._entries,
+                "reject_rate": round(
+                    len(self._rejects)
+                    / max(self.config.reject_window_s, 1e-9),
+                    3,
+                ),
+            }

@@ -29,6 +29,15 @@ scenario (2 routers + 2 backends, 200 requests / 2 tenants) and asserts
 the invariant the whole PR is about: **no acknowledged request is ever
 lost** — every 200/202 resolves to an honest verdict after recovery,
 with zero duplicate solves and zero warm recompiles.
+
+The elasticity leg (README "Elasticity & overload protection") adds a
+closed control loop to the plane: :class:`LoadRamp` paces a
+deterministic rps ramp (up / hold / down) while an
+:class:`~distributedlpsolver_tpu.serve.elastic.ElasticController`
+scales real backends against it, and :meth:`ChaosPlane.kill9_pid`
+SIGKILLs controller-spawned members (which live outside ``procs``) so
+self-healing is validated mid-scale. ``scripts/probe_elastic_serve.py``
+drives that acceptance scenario.
 """
 
 from __future__ import annotations
@@ -104,6 +113,45 @@ class ChaosSchedule:
                 self._fired.add(i)
                 out.append(e)
         return out
+
+
+class LoadRamp:
+    """Deterministic piecewise request pacing for the elasticity leg:
+    ramp up to ``peak_rps`` over the first ``up_frac`` of the run, hold,
+    then ramp back down over the final ``down_frac``. The controller
+    under test must scale out during the hold and back in after the
+    ramp releases — both transitions are driven by this one shape, so a
+    failing run replays exactly."""
+
+    def __init__(
+        self,
+        total: int,
+        peak_rps: float,
+        base_rps: float = 1.0,
+        up_frac: float = 0.3,
+        down_frac: float = 0.3,
+    ):
+        if total <= 0:
+            raise ValueError("LoadRamp needs a positive request count")
+        self.total = total
+        self.peak_rps = max(peak_rps, base_rps)
+        self.base_rps = max(1e-6, base_rps)
+        self.up_frac = min(0.49, max(0.0, up_frac))
+        self.down_frac = min(0.49, max(0.0, down_frac))
+
+    def rps_at(self, frac: float) -> float:
+        """Target request rate at progress fraction ``frac`` in [0, 1]."""
+        frac = min(1.0, max(0.0, frac))
+        lo, hi = self.base_rps, self.peak_rps
+        if self.up_frac > 0.0 and frac < self.up_frac:
+            return lo + (hi - lo) * (frac / self.up_frac)
+        if self.down_frac > 0.0 and frac > 1.0 - self.down_frac:
+            return lo + (hi - lo) * ((1.0 - frac) / self.down_frac)
+        return hi
+
+    def gap_s(self, i: int) -> float:
+        """Inter-arrival sleep before request ``i`` (0-based)."""
+        return 1.0 / self.rps_at(i / float(self.total))
 
 
 @dataclasses.dataclass
@@ -205,6 +253,31 @@ class ChaosPlane:
             name, cmd, port, journal_dir=journal_dir, extra_env=extra_env
         )
 
+    def spawn_controller(
+        self,
+        name: str,
+        registry_path: str,
+        min_backends: int = 1,
+        max_backends: int = 3,
+        buckets_json: Optional[str] = None,
+        extra_flags: Optional[List[str]] = None,
+    ) -> ManagedProcess:
+        """One ``cli elastic`` autoscaler over the shared registry —
+        the controller leg of the chaos plane. Its spawned backends are
+        real ``serve-http`` processes the schedule can kill -9 by pid
+        (:meth:`kill9_pid`); the loop must reap and replace them."""
+        cmd = [
+            sys.executable, "-m", "distributedlpsolver_tpu.cli",
+            "elastic", "--registry", registry_path,
+            "--min-backends", str(min_backends),
+            "--max-backends", str(max_backends),
+            "--workdir", self.workdir,
+        ]
+        if buckets_json:
+            cmd += ["--buckets", buckets_json]
+        cmd += extra_flags or []
+        return self._spawn(name, cmd, port=0)
+
     def spawn_router(
         self,
         name: str,
@@ -257,6 +330,19 @@ class ChaosPlane:
         except ProcessLookupError:
             pass
         proc.popen.wait(timeout=30)
+
+    @staticmethod
+    def kill9_pid(pid: int) -> bool:
+        """SIGKILL a process the plane did not spawn — the
+        controller-leg fault: elastic-pool members are children of the
+        ElasticController, not ``procs`` entries, yet the schedule must
+        still be able to kill one mid-scale. Returns False if the pid
+        was already gone."""
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, OSError):
+            return False
+        return True
 
     def restart(self, name: str, wait: bool = True) -> ManagedProcess:
         """Relaunch a killed process with its original command line —

@@ -89,8 +89,27 @@ class RouterConfig:
     # whose heartbeat is older than this leaves rotation as an ejection
     # (counted in registry_expired_total) even if no probe has failed
     # yet — the deterministic exit for a kill -9'd slice. 0 disables;
-    # entries that never heartbeat are exempt either way.
+    # entries that never heartbeat are exempt either way. Aging is
+    # measured on OBSERVER-LOCAL receipt time of each beat, never on
+    # the serving host's wall-clock stamp — cross-host clock skew can't
+    # mass-eject a healthy pool.
     registry_ttl_s: float = 0.0
+    # Per-backend circuit breaker over FORWARD outcomes. Probes have
+    # their own eject/backoff machinery, but a successful probe resets
+    # it — so a backend whose /healthz answers while its forwards keep
+    # dying flaps in and out of rotation, eating the retry-once budget
+    # of one live request per flap. The breaker remembers across probe
+    # re-admissions: closed → open when the error rate over the recent
+    # forward window crosses the threshold, open → half-open after a
+    # hold that doubles per consecutive trip (same deterministic-jitter
+    # shape as the probe backoff), half-open admits exactly ONE trial
+    # forward — success closes, failure re-opens with a longer hold.
+    breaker_window: int = 8
+    breaker_min_samples: int = 4
+    breaker_error_rate: float = 0.5
+    breaker_hold_base_s: float = 1.0
+    breaker_hold_cap_s: float = 30.0
+    breaker_enabled: bool = True
 
 
 @dataclasses.dataclass
@@ -136,8 +155,26 @@ class BackendState:
     next_probe: float = 0.0
     # Last heartbeat the serving process itself wrote into the shared
     # registry (0 = this backend never registered/heartbeat — exempt
-    # from TTL ejection). Wall clock, adopted on registry pulls.
+    # from TTL ejection). REMOTE wall clock, adopted on registry pulls;
+    # used only as a monotonicity key ("is this beat newer than the
+    # last one I saw"), never compared against the local clock.
     last_heartbeat_ts: float = 0.0
+    # Observer-local (perf_counter) moment a NEWER heartbeat stamp was
+    # adopted — the clock TTL aging actually runs on. A serving host
+    # whose wall clock is hours off still refreshes this on every beat,
+    # so skew can't mass-eject a healthy pool; a dead host stops
+    # producing newer stamps and ages out exactly at the TTL.
+    hb_rx: float = 0.0
+    # Circuit breaker (see RouterConfig.breaker_*): state machine over
+    # forward outcomes, orthogonal to probe-driven eject/readmit.
+    breaker: str = "closed"  # closed | open | half_open
+    outcomes: List[bool] = dataclasses.field(default_factory=list)
+    breaker_trips: int = 0  # lifetime opens (stats)
+    breaker_streak: int = 0  # consecutive opens without sustained close
+    breaker_until: float = 0.0  # perf_counter when open may half-open
+    breaker_hold_s: float = 0.0
+    breaker_probe_live: bool = False  # the single half-open trial
+    breaker_closed_at: float = 0.0  # perf_counter of the last close
 
 
 class Router:
@@ -171,6 +208,11 @@ class Router:
         self._m_failovers = m.counter(
             "router_failovers_total",
             help="forwards retried on another backend after a failure",
+        )
+        self._m_breaker: Dict[str, object] = {}  # guarded-by: _lock
+        self._m_breaker_trips = m.counter(
+            "router_breaker_opens_total",
+            help="circuit-breaker trips (closed/half-open -> open)",
         )
         # Shared registry: warm-load the table a sibling (or our own
         # previous incarnation) built instead of starting blind, then
@@ -287,17 +329,30 @@ class Router:
         slices exit deterministically at the TTL instead of whenever
         ``eject_after`` probes happen to have failed. Runs on the
         CACHED heartbeat stamps: a dead slice stops moving the registry
-        version, so the pull path alone would never re-examine it."""
+        version, so the pull path alone would never re-examine it.
+
+        Aging compares the OBSERVER-LOCAL receipt time of the newest
+        adopted beat (``hb_rx``, our perf_counter) against our own
+        clock — never the serving host's wall-clock stamp against local
+        ``time.time()``. The remote stamp is only a monotonicity key;
+        a host with hours of clock skew keeps refreshing ``hb_rx`` on
+        every beat and stays in rotation, while a dead host stops
+        producing newer stamps and ages out at exactly the TTL."""
         ttl = self.config.registry_ttl_s
         if ttl <= 0:
             return
         now_wall = time.time()
+        now_mono = time.perf_counter()
         expired = []
         with self._lock:
             for url, st in self._backends.items():
-                if st.ejected or st.last_heartbeat_ts <= 0.0:
+                if (
+                    st.ejected
+                    or st.last_heartbeat_ts <= 0.0
+                    or st.hb_rx <= 0.0
+                ):
                     continue
-                if now_wall - st.last_heartbeat_ts <= ttl:
+                if now_mono - st.hb_rx <= ttl:
                     continue
                 st.fails += 1
                 st.healthy = False
@@ -348,9 +403,13 @@ class Router:
                 # Heartbeats are liveness, not eject-state observations:
                 # adopt the freshest stamp unconditionally (the serving
                 # process writes it; no router ever competes on it).
+                # The remote stamp is a monotonicity key only; TTL
+                # aging runs on hb_rx — OUR receipt time of the newer
+                # beat — so cross-host clock skew never ejects anyone.
                 hb = float(entry.get("last_heartbeat_ts", 0.0))
                 if hb > st.last_heartbeat_ts:
                     st.last_heartbeat_ts = hb
+                    st.hb_rx = now
                 obs = float(entry.get("observed_ts", 0.0))
                 if obs <= st.observed_ts:
                     continue  # our own view is as fresh or fresher
@@ -526,6 +585,111 @@ class Router:
             )
         self._registry_push(push)
 
+    # -- circuit breaker -------------------------------------------------
+
+    def _breaker_gauge(self, url: str):  # holds: _lock
+        g = self._m_breaker.get(url)
+        if g is None:
+            g = self.metrics.gauge(
+                "router_breaker_open",
+                labels={"backend": url},
+                help="1 = breaker open/half-open (out of normal rotation)",
+            )
+            self._m_breaker[url] = g
+        return g
+
+    def _breaker_trip(self, st: BackendState, now: float) -> None:  # holds: _lock
+        """Open the breaker on ``st``: hold doubles per consecutive
+        trip (a close that didn't stick — within two hold-caps of the
+        re-open — escalates; a long quiet close resets the streak),
+        jittered deterministically like the probe backoff so trips
+        don't re-probe in phase across backends."""
+        import zlib
+
+        if st.breaker_closed_at and (
+            now - st.breaker_closed_at < 2.0 * self.config.breaker_hold_cap_s
+        ):
+            st.breaker_streak += 1
+        else:
+            st.breaker_streak = 1
+        st.breaker = "open"
+        st.breaker_trips += 1
+        base = self.config.breaker_hold_base_s
+        cap = self.config.breaker_hold_cap_s
+        raw = min(cap, base * (2.0 ** max(0, st.breaker_streak - 1)))
+        frac = (
+            zlib.crc32(
+                f"breaker:{st.url}:{st.breaker_trips}".encode("utf-8")
+            )
+            % 1000
+        ) / 1000.0
+        st.breaker_hold_s = min(cap, raw * (0.75 + 0.5 * frac))
+        st.breaker_until = now + st.breaker_hold_s
+        st.breaker_probe_live = False
+        st.outcomes.clear()
+        self._breaker_gauge(st.url).set(1.0)
+
+    def _record_forward_outcome(self, url: str, ok: bool) -> None:
+        """Feed one forward outcome (ok = the backend answered with a
+        stamped response; not-ok = transport death or an unstamped
+        gateway code) into the backend's breaker window. Draining
+        responses are routed around and never recorded."""
+        if not self.config.breaker_enabled:
+            return
+        event = None
+        now = time.perf_counter()
+        with self._lock:
+            st = self._backends.get(url)
+            if st is None:
+                return
+            if st.breaker == "half_open":
+                # The single trial came back: close on success, re-open
+                # with an escalated hold on failure.
+                st.breaker_probe_live = False
+                if ok:
+                    st.breaker = "closed"
+                    st.breaker_closed_at = now
+                    st.outcomes.clear()
+                    self._breaker_gauge(url).set(0.0)
+                    event = {"event": "breaker_close", "backend": url}
+                else:
+                    self._breaker_trip(st, now)
+                    event = {
+                        "event": "breaker_open",
+                        "backend": url,
+                        "error_rate": 1.0,
+                        "backoff_s": round(st.breaker_hold_s, 3),
+                        "reason": "half_open_trial_failed",
+                    }
+                    self._m_breaker_trips.inc()
+            elif st.breaker == "closed":
+                st.outcomes.append(ok)
+                if len(st.outcomes) > self.config.breaker_window:
+                    del st.outcomes[
+                        : len(st.outcomes) - self.config.breaker_window
+                    ]
+                n = len(st.outcomes)
+                errs = n - sum(st.outcomes)
+                if (
+                    n >= self.config.breaker_min_samples
+                    and errs / n >= self.config.breaker_error_rate
+                ):
+                    rate = errs / n
+                    self._breaker_trip(st, now)
+                    event = {
+                        "event": "breaker_open",
+                        "backend": url,
+                        "error_rate": round(rate, 3),
+                        "backoff_s": round(st.breaker_hold_s, 3),
+                        "reason": "error_rate",
+                    }
+                    self._m_breaker_trips.inc()
+            # breaker == "open": pick() never routes here, so the only
+            # forwards that can still land are ones already in flight
+            # when it tripped — stale evidence, ignored.
+        if event is not None:
+            self._logger.event(event)
+
     def _note_draining(self, url: str) -> None:
         """A forward came back with a backend-stamped draining 503: the
         backend is alive but shutting down — take it out of rotation
@@ -559,16 +723,29 @@ class Router:
     ) -> Optional[str]:
         """The best in-rotation backend for one request: min padding
         score (when the shape is visible), then min load, then
-        round-robin. None = nothing routable."""
+        round-robin. None = nothing routable. Breaker-open backends
+        are out of rotation even when their probes pass; once the hold
+        elapses they go half-open and exactly one trial forward may
+        route here until it resolves."""
+        now = time.perf_counter()
         with self._lock:
-            in_rotation = [
-                st
-                for st in self._backends.values()
-                if st.healthy
-                and st.ready
-                and not st.ejected
-                and st.url not in exclude
-            ]
+            in_rotation = []
+            for st in self._backends.values():
+                if (
+                    not st.healthy
+                    or not st.ready
+                    or st.ejected
+                    or st.url in exclude
+                ):
+                    continue
+                if st.breaker == "open":
+                    if now < st.breaker_until:
+                        continue
+                    st.breaker = "half_open"
+                    st.breaker_probe_live = False
+                if st.breaker == "half_open" and st.breaker_probe_live:
+                    continue  # the single trial is already in flight
+                in_rotation.append(st)
             if not in_rotation:
                 return None
             self._rr += 1
@@ -588,6 +765,8 @@ class Router:
             url = scored[0][3]
             self._backends[url].forwards += 1
             self._backends[url].live += 1
+            if self._backends[url].breaker == "half_open":
+                self._backends[url].breaker_probe_live = True
             ctr = self._m_routed.get(url)
             if ctr is None:
                 ctr = self.metrics.counter(
@@ -690,6 +869,7 @@ class Router:
             if transport_dead or (
                 code in (502, 503, 504) and not from_backend
             ):
+                self._record_forward_outcome(url, False)
                 self._note_forward_failure(url)
                 if attempt == 0:
                     tried = (url,)
@@ -710,6 +890,11 @@ class Router:
                         self._failovers += 1
                     self._m_failovers.inc()
                     continue
+            else:
+                # Any backend-stamped response — including its own 429
+                # and TIMEOUT verdicts — proves the backend serves; it
+                # counts FOR the breaker window, not against it.
+                self._record_forward_outcome(url, True)
             return code, payload, url
         return code, payload, url  # second attempt's outcome, whatever it was
 
@@ -758,6 +943,8 @@ class Router:
                         "fails": st.fails,
                         "probes": st.probes,
                         "backoff_s": round(st.backoff_s, 3),
+                        "breaker": st.breaker,
+                        "breaker_trips": st.breaker_trips,
                         "queue_depth": st.queue_depth,
                         "inflight": st.inflight,
                         "live": st.live,

@@ -89,6 +89,12 @@ class NetConfig:
     drain_timeout_s: float = 60.0
     # Retry-After hint on not-ready (draining) 503s.
     drain_retry_after_s: float = 5.0
+    # After the drain finishes, keep the listener answering for up to
+    # this long while computed-but-unclaimed async verdicts exist — a
+    # client polling at any sane cadence collects its result before the
+    # process exits (scale-in must not orphan acknowledged work). The
+    # linger ends early once every resolved async id has been fetched.
+    drain_linger_s: float = 2.0
     # http_request JSONL event stream (stamped schema); None = off.
     log_jsonl: Optional[str] = None
 
@@ -144,6 +150,9 @@ class SolveHTTPServer:
         # Async-poll store: id -> (future, include_x, t_created).
         self._async: OrderedDict = OrderedDict()  # guarded-by: _lock
         self._async_seq = 0  # guarded-by: _lock
+        # Resolved async ids a client has fetched at least once — the
+        # drain linger waits only on resolved-but-never-claimed ids.
+        self._async_claimed: set = set()  # guarded-by: _lock
         # healthz cache + wedge-detector pulse.
         self._health: Optional[Tuple[bool, dict]] = None  # guarded-by: _health_lock
         self._health_t = 0.0  # guarded-by: _health_lock
@@ -275,12 +284,28 @@ class SolveHTTPServer:
                     old_fut = self._async[old_rid][0]
                     if old_fut.done():
                         del self._async[old_rid]
+                        self._async_claimed.discard(old_rid)
                         self._m_evict("resolved").inc()
         return rid
 
     def _lookup_async(self, rid: str):
         with self._lock:
             return self._async.get(rid)
+
+    def _mark_async_claimed(self, rid: str) -> None:
+        with self._lock:
+            if rid in self._async:
+                self._async_claimed.add(rid)
+
+    def _async_unclaimed(self) -> int:
+        """Resolved async ids no client has fetched yet — what the
+        drain linger waits on."""
+        with self._lock:
+            return sum(
+                1
+                for rid, entry in self._async.items()
+                if entry[0].done() and rid not in self._async_claimed
+            )
 
     # -- health ----------------------------------------------------------
 
@@ -371,6 +396,19 @@ class SolveHTTPServer:
         drained = self.service.drain_for_shutdown(
             timeout=self.config.drain_timeout_s
         )
+        # Linger: every admitted request now has its verdict, but a
+        # client that was just ACKed may not have polled it yet. Keep
+        # the listener answering until each resolved async id has been
+        # claimed (or the linger budget runs out) — closing earlier
+        # turns acknowledged work into permanent 404s on scale-in.
+        linger_deadline = (
+            time.perf_counter() + self.config.drain_linger_s
+        )
+        while (
+            time.perf_counter() < linger_deadline
+            and self._async_unclaimed() > 0
+        ):
+            time.sleep(0.05)
         self._logger.event(
             {
                 "event": "drain",
@@ -589,6 +627,7 @@ class _Handler(BaseHTTPRequestHandler):
                             fut.result(), include_x
                         )
                         self._send_json(code, payload)
+                        front._mark_async_claimed(rid)
                 else:
                     # Durable fallback: ids this process never minted
                     # (issued before a restart) resolve through the

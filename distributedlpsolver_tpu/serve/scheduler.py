@@ -165,8 +165,14 @@ class Scheduler:
             if q
         }
 
-    def add(self, p: PendingRequest) -> QueueKey:
-        if self._depth >= self.max_depth:
+    def add(self, p: PendingRequest, exempt: bool = False) -> QueueKey:
+        # ``exempt`` re-enqueues journal-replayed jobs past the depth
+        # bound: they were admitted before the crash and are owed a
+        # verdict — the bound gates NEW work, and a replacement backend
+        # replaying a dead member's WAL under live load must not
+        # resolve that backlog FAILED just because its own queue is
+        # busy.
+        if not exempt and self._depth >= self.max_depth:
             self._m_rejects.inc()
             raise ServiceOverloaded(
                 f"queue depth {self._depth} at max_queue_depth="

@@ -199,6 +199,14 @@ class ServiceConfig:
     # than one plain request, far fewer than K (the batched Schur
     # decomposition amortizes the per-scenario work).
     scenario_k_unit: int = 16
+    # Overload brownout ladder (net/admission.BrownoutConfig): staged
+    # degradation under sustained saturation — stage 1 sheds batch
+    # priority with a structured verdict + honest Retry-After, stage 2
+    # widens every flush window, stage 3 re-routes tol-eligible work to
+    # the cheaper PDHG engine (never tightening below its tol floor —
+    # tight-tol correctness is untouched). Auto-releases on recovery;
+    # None = no brownout.
+    brownout: Optional[object] = None
 
 
 def standard_form(problem: LPProblem):
@@ -398,6 +406,23 @@ class SolveService:
         # Read-only surface for the HTTP front-end (shared tenant
         # labeler) and introspection; None without the SLO layer.
         self.admission = self._admission
+        # Overload brownout ladder (net/admission.BrownoutController):
+        # sustained saturation (queue depth + reject rate) engages
+        # staged degradation on the submit path — shed batch priority,
+        # widen flush windows, re-route tol-eligible work to PDHG —
+        # auto-releasing on recovery. None = no brownout.
+        if self.config.brownout is not None:
+            from distributedlpsolver_tpu.net.admission import (
+                BrownoutController,
+            )
+
+            self._brownout: Optional[object] = BrownoutController(
+                self.config.brownout,
+                max_depth=self.config.max_queue_depth,
+                metrics=m,
+            )
+        else:
+            self._brownout = None
         # Multi-host slice mode (distributed/slice.py): an explicit
         # slice_runner routes every bucket dispatch through the slice
         # control plane so follower ranks execute the same programs; an
@@ -907,6 +932,39 @@ class SolveService:
             n_scenarios=n_scen,
             scenario_bucket=scen_bucket,
         )
+        # Overload brownout ladder: observe saturation (logging any
+        # stage transitions), then apply the current stage's rungs —
+        # shed batch priority with a structured verdict, widen the
+        # flush window, re-route tol-eligible work to PDHG. Replays are
+        # exempt: they were admitted before the crash and the journal
+        # owes them a verdict.
+        if self._brownout is not None and _replay_job is None:
+            with self._lock:
+                depth_now = self.scheduler.depth()
+            for ev in self._brownout.observe(depth_now, now):
+                self._logger.event(ev)
+            if self._brownout.should_shed(priority):
+                retry = self._brownout.config.retry_after_s
+                self._log_reject(p, "brownout", retry)
+                raise ServiceOverloaded(
+                    "brownout: batch-priority work shed under overload "
+                    f"(stage {self._brownout.stage()})",
+                    reason="brownout",
+                    retry_after_s=retry,
+                    tenant=tenant,
+                )
+            p.flush_scale *= self._brownout.flush_widen()
+            if (
+                p.engine == "ipm"
+                and sf is not None
+                and self.config.pdhg_routing
+                and self._brownout.reroute_pdhg(req_tol)
+            ):
+                # Stage 3: the cheaper first-order engine takes the
+                # tol-eligible traffic. Crossover honesty still holds —
+                # a PDHG lane is OPTIMAL only at true KKT ≤ the request
+                # tol, else it re-solves through the solo IPM ladder.
+                p.engine = "pdhg"
         with self._wake:
             if self._stopping:
                 raise RuntimeError("SolveService is shut down")
@@ -941,7 +999,9 @@ class SolveService:
                         tenant=tenant,
                     )
             try:
-                key = self.scheduler.add(p)
+                # Replays are depth-exempt for the same reason they are
+                # admission-exempt: the journal owes them a verdict.
+                key = self.scheduler.add(p, exempt=_replay_job is not None)
             except ServiceOverloaded as e:
                 self._log_reject(p, e.reason, e.retry_after_s)
                 raise
@@ -987,6 +1047,11 @@ class SolveService:
         """One reject record per shed request: the verdict reason and
         wait hint ride the event so overload post-mortems can tell a
         quota-limited tenant from a depth wall."""
+        if self._brownout is not None and reason != "brownout":
+            # Non-brownout rejections feed the saturation signal's
+            # reject-rate half; brownout's own sheds are excluded or
+            # stage 1 would sustain itself forever.
+            self._brownout.note_reject()
         self.tracer.instant(
             "serve.reject",
             args={"id": p.request_id, "name": p.name, "reason": reason},
@@ -2184,6 +2249,17 @@ class SolveService:
         with self._lock:
             return list(self._dispatch_rows)
 
+    def _brownout_stats(self) -> Optional[dict]:
+        """Brownout state for stats()/statusz — observing on the way
+        so status polls drive stage release when traffic is idle."""
+        if self._brownout is None:
+            return None
+        with self._lock:
+            depth = self.scheduler.depth()
+        for ev in self._brownout.observe(depth):
+            self._logger.event(ev)
+        return self._brownout.stats()
+
     def stats(self) -> dict:
         import jax
 
@@ -2269,6 +2345,12 @@ class SolveService:
                 if self._admission is not None
                 else None
             ),
+            # Brownout ladder state (None without one). Reading stats
+            # also OBSERVES the current depth: /statusz polls keep the
+            # release clock ticking even when submits stop entirely —
+            # a brownout must not outlive the overload that caused it
+            # just because traffic went to zero.
+            "brownout": self._brownout_stats(),
             # Crash-safe fabric: drain state + durable-journal counters
             # (None without a journal) — the /readyz and recovery
             # post-mortem surface.

@@ -1,0 +1,465 @@
+"""Chaos-elasticity acceptance probe: a deterministic load ramp over a
+LIVE multi-process plane — one router over the shared registry, a
+backend pool owned by an in-process :class:`ElasticController` — with
+one pool member SIGKILLed mid-scale (README "Elasticity & overload
+protection").
+
+The closed loop under test:
+
+  ramp up   → queue depth / admission rejects / brownout stage push the
+              controller past its watermarks → scale-OUT spawns warm
+              backends (compile ladder → bind → register, in that
+              order) while the brownout ladder sheds batch-priority
+              work with structured 429 verdicts;
+  mid-scale → kill -9 one pool member: the controller reaps it and
+              restores capacity (its slot's journal is reused, so poll
+              ids minted by the dead incarnation re-bind);
+  ramp down → sustained calm releases the brownout ladder and drains
+              the pool back to min_backends via /quitquitquit — every
+              admitted request resolves before a victim exits.
+
+Checks:
+  - the pool scaled out (>= 2 backends) and back in to min_backends,
+    with attributed scale_out/scale_in actions, and the killed member
+    was replaced;
+  - zero lost acks: every sync request ends with an honest verdict,
+    every 202 id resolves through the router's fan-out;
+  - zero duplicate solves across every slot journal (the replacement
+    replayed the dead member's WAL, it did not re-run it);
+  - brownout engaged (>= 1 batch-priority shed carrying
+    reason="brownout" + retry_after_s) and released (stage 0 at the
+    end);
+  - zero warm recompiles at steady state: after scale-in, a verify
+    wave leaves programs_compiled flat on the surviving pool.
+
+Run: python scripts/probe_elastic_serve.py [--requests N] [--budget-s S]
+Exit 0 iff every check passes.
+"""
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedlpsolver_tpu.net.chaos import (  # noqa: E402
+    ChaosPlane,
+    LoadRamp,
+    journal_duplicate_solves,
+)
+from distributedlpsolver_tpu.serve.elastic import (  # noqa: E402
+    ElasticConfig,
+    ElasticController,
+)
+
+# Heavy enough that one CPU backend saturates under the ramp peak
+# (~32 rps capacity at batch 4 vs the 48 rps peak) — the overload is
+# real, not simulated.
+SHAPE = (96, 288)
+
+BROWNOUT = {
+    "depth_high": 0.5,
+    "depth_low": 0.125,
+    "reject_rate_high": 1.0,
+    "engage_after_s": 0.2,
+    "escalate_after_s": 0.4,
+    "release_after_s": 0.5,
+    "retry_after_s": 0.05,
+}
+
+
+def http_json(url, body=None, timeout=30.0):
+    req = urllib.request.Request(
+        url,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:
+            return e.code, {}
+    except (urllib.error.URLError, OSError, ConnectionError, ValueError) as e:
+        return 599, {"error": f"{type(e).__name__}: {e}"}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=240)
+    ap.add_argument(
+        "--budget-s", type=float, default=0.0,
+        help="fail if the whole probe exceeds this wall time (0 = none)",
+    )
+    ap.add_argument("--keep-workdir", action="store_true")
+    args = ap.parse_args()
+    t_probe = time.perf_counter()
+
+    workdir = tempfile.mkdtemp(prefix="dlps-elastic-")
+    plane = ChaosPlane(workdir)
+    registry_path = os.path.join(workdir, "registry.json")
+    buckets_json = os.path.join(workdir, "ladder.json")
+    with open(buckets_json, "w") as fh:
+        fh.write(json.dumps([{"m": SHAPE[0], "n": SHAPE[1], "batch": 4}]))
+
+    ok = True
+
+    def fail(msg):
+        nonlocal ok
+        print(f"FAIL: {msg}")
+        ok = False
+
+    ctl = ElasticController(
+        ElasticConfig(
+            registry_path=registry_path,
+            min_backends=1,
+            max_backends=3,
+            poll_s=0.2,
+            load_high=6.0,
+            reject_rate_high=0.5,
+            out_sustain_s=0.4,
+            load_low=1.0,
+            in_sustain_s=2.0,
+            cooldown_s=1.0,
+            flap_window_s=60.0,
+            flap_max_actions=24,  # the damper must not gate this scenario
+            workdir=workdir,
+            buckets_json=buckets_json,
+            backend_flags=(
+                "--flush-ms", "20", "--batch", "4", "--queue-depth", "16",
+                "--brownout", json.dumps(BROWNOUT, separators=(",", ":")),
+                "--quiet",
+            ),
+            heartbeat_s=0.25,
+            log_jsonl=os.path.join(workdir, "elastic.jsonl"),
+        )
+    )
+    t0 = time.perf_counter()
+    ctl.start()  # synchronous first reconcile: the min pool is warm now
+    if ctl.pool_size() < 1:
+        fail("controller did not bring up the min pool")
+        ctl.shutdown(drain=False)
+        print("FAIL")
+        return 1
+    print(
+        f"min pool up in {time.perf_counter() - t0:.1f}s: "
+        f"{[m['url'] for m in ctl.statusz()['pool']]}"
+    )
+    router = plane.spawn_router("router-1", [], registry_path)
+    if not plane.wait_ready(router, 60):
+        fail("router did not come up")
+        ctl.shutdown(drain=False)
+        print("FAIL")
+        return 1
+    # The router adopts the self-registered pool from the registry.
+    deadline = time.perf_counter() + 30.0
+    while time.perf_counter() < deadline:
+        c, o = http_json(router.url + "/statusz", timeout=5.0)
+        if c == 200 and any(
+            b.get("healthy") for b in o.get("backends", [])
+        ):
+            break
+        time.sleep(0.2)
+    else:
+        fail("router never adopted the elastic pool from the registry")
+    print(f"router up: {router.url} (registry: {registry_path})")
+
+    # -- load wave: LoadRamp-paced sync/async stream + batch-priority
+    # probes; a monitor kills one pool member once the pool scales out.
+    n_total = args.requests
+    ramp = LoadRamp(n_total, peak_rps=48.0, base_rps=3.0)
+    responses = []  # (kind, code, body)
+    async_verdicts = {}  # rid -> (code, status) | None (never resolved)
+    sheds = []  # structured brownout verdicts observed
+    res_lock = threading.Lock()
+    wave_done = threading.Event()
+    pool_peak = [ctl.pool_size()]
+    brownout_peak = [0]
+    killed = {"pid": None, "at_pool": 0, "n_actions": 0}
+
+    def drive(k):
+        body = {
+            "m": SHAPE[0], "n": SHAPE[1], "seed": k,
+            "tenant": "ramp", "id": f"ramp-{k}",
+        }
+        if k % 3 == 0:
+            body["async"] = True
+        deadline = time.perf_counter() + 120.0
+        while True:
+            code, out = http_json(router.url + "/v1/solve", body, timeout=60.0)
+            if code == 429:
+                time.sleep(
+                    min(float(out.get("retry_after_s", 0.05) or 0.05), 1.0)
+                )
+            elif code in (502, 503, 599):
+                if time.perf_counter() > deadline:
+                    break
+                time.sleep(0.05)
+            else:
+                break
+        with res_lock:
+            responses.append(("async" if "async" in body else "sync",
+                              code, out))
+        if code == 202 and out.get("id"):
+            # Poll the ack to its verdict NOW, like a real client: a
+            # draining victim answers until every resolved id is
+            # claimed (the listener linger), a killed member's ids
+            # re-bind in the successor on its slot — 404s during that
+            # handoff are transient, so keep polling.
+            rid = out["id"]
+            verdict = None
+            pdl = time.perf_counter() + 180.0
+            while time.perf_counter() < pdl:
+                c, o = http_json(
+                    router.url + f"/v1/solve/{rid}", timeout=30.0
+                )
+                if c in (202, 404, 502, 503, 599):
+                    time.sleep(0.1)
+                    continue
+                verdict = (c, o.get("status"))
+                break
+            with res_lock:
+                async_verdicts[rid] = verdict
+
+    def batch_probe():
+        """Batch-priority feelers: under brownout stage >= 1 these get
+        the structured shed verdict — the honest degradation contract."""
+        k = 0
+        while not wave_done.is_set():
+            code, out = http_json(
+                router.url + "/v1/solve",
+                {"m": SHAPE[0], "n": SHAPE[1], "seed": 50_000 + k,
+                 "tenant": "bulk", "priority": "batch",
+                 "id": f"bulk-{k}"},
+                timeout=30.0,
+            )
+            if code == 429 and out.get("reason") == "brownout":
+                with res_lock:
+                    sheds.append(out)
+            k += 1
+            wave_done.wait(0.1)
+
+    def monitor():
+        """Track pool/brownout peaks; kill -9 one member mid-scale."""
+        while not wave_done.is_set():
+            n = ctl.pool_size()
+            pool_peak[0] = max(pool_peak[0], n)
+            for m in ctl.statusz()["pool"]:
+                c, o = http_json(m["url"] + "/statusz", timeout=2.0)
+                if c != 200:
+                    continue
+                bo = (o.get("stats") or {}).get("brownout") or {}
+                brownout_peak[0] = max(
+                    brownout_peak[0], int(bo.get("stage", 0) or 0)
+                )
+            if killed["pid"] is None and n >= 2:
+                victim = max(ctl.statusz()["pool"], key=lambda m: m["gen"])
+                if ChaosPlane.kill9_pid(victim["pid"]):
+                    killed["pid"] = victim["pid"]
+                    killed["at_pool"] = n
+                    killed["n_actions"] = len(ctl.actions())
+                    print(
+                        f"  [mid-scale] kill -9 {victim['url']} "
+                        f"(pid {victim['pid']}, pool {n})"
+                    )
+            wave_done.wait(0.1)
+
+    threads = [
+        threading.Thread(target=batch_probe, daemon=True),
+        threading.Thread(target=monitor, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    t_wave = time.perf_counter()
+    workers = []
+    for k in range(n_total):
+        w = threading.Thread(target=drive, args=(k,), daemon=True)
+        w.start()
+        workers.append(w)
+        time.sleep(ramp.gap_s(k))
+    for w in workers:
+        w.join(timeout=180)
+    wave_done.set()
+    for t in threads:
+        t.join(timeout=30)
+    print(
+        f"load wave: {len(responses)}/{n_total} responses in "
+        f"{time.perf_counter() - t_wave:.1f}s; pool peak {pool_peak[0]}, "
+        f"brownout peak stage {brownout_peak[0]}, "
+        f"{len(sheds)} batch sheds"
+    )
+
+    if len(responses) != n_total:
+        fail(f"lost submissions: {len(responses)} of {n_total} responded")
+    sync_bad = [
+        (c, o.get("status") or o.get("error"))
+        for kind, c, o in responses
+        if kind == "sync" and not (
+            (c == 200 and o.get("status") == "optimal")
+            or (c == 504 and o.get("status") == "timeout")
+        )
+    ]
+    if sync_bad:
+        fail(f"sync requests without honest verdicts: {sync_bad[:5]}")
+
+    # -- elasticity: the pool scaled out, and the kill was absorbed
+    if pool_peak[0] < 2:
+        fail(f"pool never scaled out (peak {pool_peak[0]})")
+    if killed["pid"] is None:
+        fail("no pool member was killed mid-scale (pool never reached 2)")
+    else:
+        # Replacement: a scale_out strictly after the kill restored
+        # capacity (reasons vary — the signal may still be hot; by now
+        # the ramp released, so the POOL SIZE has legitimately scaled
+        # back in — the action log is the evidence).
+        after_kill = ctl.actions()[killed["n_actions"]:]
+        heals = [a for a in after_kill if a["event"] == "scale_out"]
+        live_pids = {m["pid"] for m in ctl.statusz()["pool"]}
+        if killed["pid"] in live_pids:
+            fail("killed pid still listed in the pool (reap failed)")
+        if not heals:
+            fail(
+                "controller never replaced the killed member "
+                "(no scale_out after the kill)"
+            )
+        else:
+            print(
+                f"  self-heal: {len(heals)} scale_out after the kill "
+                f"(first: {heals[0]['reason']}, "
+                f"{heals[0]['ms']:.0f}ms lead)"
+            )
+
+    # -- brownout: engaged under the ramp, structured verdicts carried
+    if brownout_peak[0] < 1 and not sheds:
+        fail("brownout never engaged under the ramp")
+    bad_sheds = [
+        s for s in sheds
+        if not (s.get("reason") == "brownout"
+                and float(s.get("retry_after_s") or 0) > 0)
+    ]
+    if bad_sheds:
+        fail(f"sheds without structured verdicts: {bad_sheds[:3]}")
+    elif sheds:
+        print(
+            f"  brownout: {len(sheds)} batch sheds, all carrying "
+            f"reason=brownout + retry_after_s"
+        )
+
+    # -- zero lost acks: every 202 resolved through the router fan-out
+    # (each driver polled its ack to a verdict live, across drains and
+    # the kill — the client's view of "no acknowledged work vanished").
+    unresolved = [
+        (rid, v) for rid, v in async_verdicts.items()
+        if v is None or v[1] is None
+    ]
+    statuses = {}
+    for _, v in async_verdicts.items():
+        if v is not None and v[1] is not None:
+            statuses[v[1]] = statuses.get(v[1], 0) + 1
+    print(
+        f"async resolution: {len(async_verdicts) - len(unresolved)}/"
+        f"{len(async_verdicts)} ids resolved — {statuses}"
+    )
+    if unresolved:
+        fail(f"acknowledged async ids never resolved: {unresolved[:5]}")
+    if statuses.get("failed"):
+        fail(f"{statuses['failed']} async ids resolved FAILED")
+
+    # -- ramp released: the controller drains back to min_backends
+    t_in = time.perf_counter()
+    while time.perf_counter() - t_in < 120.0:
+        if ctl.pool_size() <= ctl.config.min_backends:
+            break
+        time.sleep(0.3)
+    if ctl.pool_size() > ctl.config.min_backends:
+        fail(
+            f"pool never scaled back in "
+            f"({ctl.pool_size()} > min {ctl.config.min_backends})"
+        )
+    actions = ctl.actions()
+    outs = [a for a in actions if a["event"] == "scale_out"]
+    ins = [a for a in actions if a["event"] == "scale_in"]
+    if not any(a.get("drained") for a in ins):
+        fail(f"no scale_in drained gracefully: {ins}")
+    else:
+        lead = [a["ms"] for a in outs]
+        print(
+            f"  scale actions: {len(outs)} out "
+            f"(lead {min(lead):.0f}..{max(lead):.0f}ms), "
+            f"{len(ins)} in ({sum(bool(a.get('drained')) for a in ins)} "
+            f"drained)"
+        )
+
+    # -- brownout released: every surviving backend at stage 0
+    for m in ctl.statusz()["pool"]:
+        c, o = http_json(m["url"] + "/statusz", timeout=5.0)
+        bo = ((o.get("stats") or {}).get("brownout")) or {}
+        if c == 200 and int(bo.get("stage", 0) or 0) != 0:
+            fail(f"{m['url']} still browned out at idle: {bo}")
+
+    # -- zero duplicate solves across every slot journal (replacements
+    # replay the dead incarnation's WAL, they never re-run it)
+    for jdir in sorted(glob.glob(os.path.join(workdir, "elastic-be*-journal"))):
+        dups = journal_duplicate_solves(jdir)
+        if dups:
+            fail(f"{os.path.basename(jdir)}: {dups} duplicate finished "
+                 f"records")
+    print("  duplicate solves: 0 across all slot journals")
+
+    # -- zero warm recompiles at steady state
+    snaps = {}
+    for m in ctl.statusz()["pool"]:
+        c, o = http_json(m["url"] + "/statusz", timeout=5.0)
+        if c != 200:
+            fail(f"{m['url']} statusz unreachable at steady state ({c})")
+            continue
+        snaps[m["url"]] = int(
+            (o.get("stats") or {}).get("programs_compiled", -1)
+        )
+    for k in range(8):
+        c, o = http_json(
+            router.url + "/v1/solve",
+            {"m": SHAPE[0], "n": SHAPE[1], "seed": 90_000 + k,
+             "tenant": "verify"},
+            timeout=60.0,
+        )
+        if c != 200 or o.get("status") != "optimal":
+            fail(f"verification request failed: {c} {o}")
+            break
+    for url, before in snaps.items():
+        c, o = http_json(url + "/statusz", timeout=5.0)
+        after = int((o.get("stats") or {}).get("programs_compiled", -2))
+        if after != before:
+            fail(
+                f"{url}: warm recompiles at steady state "
+                f"({before} -> {after} programs)"
+            )
+    print(f"  steady-state programs_compiled: {snaps} (flat)")
+
+    ctl.shutdown(drain=True)
+    plane.shutdown_all()
+    if not args.keep_workdir and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        print(f"workdir kept for post-mortem: {workdir}")
+
+    probe_wall = time.perf_counter() - t_probe
+    if args.budget_s and probe_wall > args.budget_s:
+        fail(f"probe took {probe_wall:.1f}s > budget {args.budget_s:.0f}s")
+    print(f"probe wall: {probe_wall:.1f}s")
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

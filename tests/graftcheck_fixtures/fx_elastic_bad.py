@@ -1,0 +1,25 @@
+# Seeds: jsonl-schema x2 — elasticity telemetry written wrong. Checked
+# with pkg_path="serve/fx.py": a scale action under a type the event
+# catalogue never heard of (invisible to `cli report` and the bench's
+# pool-trajectory reconstruction), and a breaker trip carrying an
+# uncatalogued rate field.
+
+
+def scale_record(logger, pool, target):
+    logger.event(
+        {
+            "event": "pool_resize",  # jsonl-event-type: not catalogued
+            "pool": pool,
+            "target": target,
+        }
+    )
+
+
+def breaker_record(logger, backend, rate):
+    logger.event(
+        {
+            "event": "breaker_open",
+            "backend": backend,
+            "trip_rate": rate,  # jsonl-fields: not catalogued
+        }
+    )

@@ -1,0 +1,78 @@
+# Compliant twin of fx_elastic_bad: the closed-loop elasticity event
+# family with catalogued fields only — scale actions and vetoes as
+# serve/elastic.py emits them, brownout-ladder transitions as
+# net/admission.py emits them, breaker trips as net/router.py emits
+# them.
+
+
+def scale_records(logger, url, pool, target):
+    logger.event(
+        {
+            "event": "scale_out",
+            "reason": "queue_depth",
+            "backend": url,
+            "pool": pool,
+            "target": target,
+            "ms": 1830.0,
+            "pid": 4242,
+        }
+    )
+    logger.event(
+        {
+            "event": "scale_in",
+            "reason": "load_low",
+            "backend": url,
+            "pool": pool,
+            "target": target,
+            "ms": 210.0,
+            "drained": True,
+        }
+    )
+    logger.event(
+        {
+            "event": "scale_veto",
+            "reason": "cooldown",
+            "pool": pool,
+            "target": target,
+            "detail": "signal=queue_depth",
+        }
+    )
+
+
+def brownout_records(logger, depth):
+    logger.event(
+        {
+            "event": "brownout_enter",
+            "stage": 1,
+            "reason": "queue_depth",
+            "queue_depth": depth,
+        }
+    )
+    logger.event(
+        {
+            "event": "brownout_exit",
+            "stage": 0,
+            "reason": "calm",
+            "queue_depth": depth,
+            "ms": 2400.0,
+        }
+    )
+
+
+def breaker_records(logger, backend):
+    logger.event(
+        {
+            "event": "breaker_open",
+            "backend": backend,
+            "reason": "error_rate",
+            "error_rate": 0.62,
+            "backoff_s": 2.0,
+        }
+    )
+    logger.event(
+        {
+            "event": "breaker_close",
+            "backend": backend,
+            "reason": "half_open_trial_ok",
+        }
+    )

@@ -1,9 +1,9 @@
 """Seeded spmd-* violations (graftcheck twin test, pkg_path
 distributed/fx.py). Every def here breaks the multi-host SPMD contract
 one way: a rank-gated collective, an early rank exit skipping one, a
-rank fact passed into a param-sensitive callee, unordered iteration
-feeding world-visible publication, and an uncommitted array entering a
-mesh program."""
+rank fact passed into a param-sensitive callee, a rank-filtered
+comprehension wrapping one, unordered iteration feeding world-visible
+publication, and an uncommitted array entering a mesh program."""
 
 import os
 
@@ -36,6 +36,14 @@ def caller(world):
     # spmd-divergent-collective (call-argument taint): the divergence
     # lives one call down, seeded here.
     _publish_if(world.rank == 0, world)
+
+
+def gather_primary_only(world, shards):
+    # spmd-divergent-collective (comprehension filter): the rank test
+    # hides in the generator's `if`, so only rank 0 ever enters the
+    # allgather — followers hang, and a statement-level If/While walk
+    # never sees the guard.
+    return [world.allgather(s) for s in shards if world.rank == 0]
 
 
 def replay_dispatches(control, journal_dir):

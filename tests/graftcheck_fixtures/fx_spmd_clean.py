@@ -18,6 +18,12 @@ def world_report(world, stats):
     return [stats]
 
 
+def gather_all(world, shards):
+    # A world-uniform comprehension filter is fine: world_size is the
+    # same on every rank, so every rank runs the same collectives.
+    return [world.allgather(s) for s in shards if world.world_size > 1]
+
+
 def replay_dispatches(control, journal_dir):
     # Deterministic replay order on every rank.
     for fname in sorted(os.listdir(journal_dir)):

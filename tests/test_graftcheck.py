@@ -181,24 +181,43 @@ class TestRuleFamilies:
         )
         assert rules == []
 
+    def test_elastic_catches_seeded(self):
+        # Closed-loop elasticity: a scale action under an uncatalogued
+        # event type and a breaker trip carrying an uncatalogued field.
+        rules, findings = _rules_hit("fx_elastic_bad.py", "serve/fx.py")
+        assert rules == ["jsonl-fields"]
+        assert sum(f.rule == "jsonl-fields" for f in findings) == 2
+        msgs = " | ".join(f.message for f in findings)
+        assert "pool_resize" in msgs
+        assert "trip_rate" in msgs
+
+    def test_elastic_clean_twin_silent(self):
+        # scale_out/scale_in/scale_veto, brownout_enter/exit, and
+        # breaker_open/close with catalogued fields only: silent.
+        rules, _ = _rules_hit("fx_elastic_clean.py", "serve/fx.py")
+        assert rules == []
+
     def test_spmd_family_catches_seeded(self):
         # graftcheck v2: rank-gated collective, early rank exit, rank
-        # fact through a call argument, unsorted listdir + set-order
-        # publication, uncommitted mesh input.
+        # fact through a call argument, rank-filtered comprehension,
+        # unsorted listdir + set-order publication, uncommitted mesh
+        # input.
         rules, findings = _rules_hit("fx_spmd_bad.py", "distributed/fx.py")
         assert rules == [
             "spmd-divergent-collective",
             "spmd-uncommitted-input",
             "spmd-unordered-dispatch",
         ]
-        assert sum(f.rule == "spmd-divergent-collective" for f in findings) == 3
+        assert sum(f.rule == "spmd-divergent-collective" for f in findings) == 4
         assert sum(f.rule == "spmd-unordered-dispatch" for f in findings) == 2
         assert sum(f.rule == "spmd-uncommitted-input" for f in findings) == 1
         # the interprocedural variants are among them: the call-argument
-        # taint and the early-return divergence
+        # taint, the early-return divergence, and the comprehension-
+        # filter divergence the statement walk cannot see
         msgs = " | ".join(f.message for f in findings)
         assert "passed as `primary`" in msgs
         assert "early_exit_skips_collective" in msgs
+        assert "comprehension filter" in msgs
 
     def test_spmd_clean_twin_silent(self):
         # world-size branches, sorted scans, committed placements, and

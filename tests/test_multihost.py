@@ -265,6 +265,63 @@ def test_registry_heartbeat_ttl_ejects(tmp_path):
     assert st["ejected"]
 
 
+def test_registry_heartbeat_ttl_is_skew_immune(tmp_path):
+    """Regression: TTL aging runs on OBSERVER-LOCAL receipt time of
+    each beat, never on the serving host's wall-clock stamp. A backend
+    whose clock is hours behind keeps beating (each stamp newer than
+    the last) and must stay in rotation past the TTL; once the beats
+    stop, it ages out at the TTL like anyone else."""
+    from distributedlpsolver_tpu.net.registry import BackendRegistry
+    from distributedlpsolver_tpu.net.router import Router, RouterConfig
+    from distributedlpsolver_tpu.obs.metrics import MetricsRegistry
+
+    path = str(tmp_path / "reg.json")
+    reg = BackendRegistry(path)
+    url = "http://127.0.0.1:1"
+    assert reg.register(url, slice_id="sZ", world_size=2)
+    skew_base = time.time() - 7200.0  # two hours behind
+
+    def beat(k):
+        # Skewed but monotonic stamps — what a wrong-clock host writes.
+        def _mutate(backends):
+            backends[url]["last_heartbeat_ts"] = skew_base + 0.001 * k
+            return True
+
+        assert reg.update(_mutate) is not None
+
+    beat(0)
+    metrics = MetricsRegistry()
+    router = Router(
+        [],
+        RouterConfig(
+            registry_path=path,
+            registry_ttl_s=0.4,
+            eject_after=100,  # probes alone must NOT eject here
+        ),
+        metrics=metrics,
+    )
+    # Beats keep arriving: total elapsed exceeds the TTL several times
+    # over, yet the entry stays in rotation — wall-skew alone (every
+    # stamp is ~2h stale) can never eject a live backend.
+    for k in range(1, 5):
+        beat(k)
+        router._sync_registry_pull()
+        router._expire_stale_heartbeats()
+        st = next(
+            b for b in router.statusz()["backends"] if b["url"] == url
+        )
+        assert not st["ejected"], f"skewed-but-live backend ejected at beat {k}"
+        time.sleep(0.15)
+    # The beats stop: observer-local receipt time ages past the TTL and
+    # the entry leaves rotation deterministically.
+    time.sleep(0.6)
+    router._sync_registry_pull()
+    router._expire_stale_heartbeats()
+    st = next(b for b in router.statusz()["backends"] if b["url"] == url)
+    assert st["ejected"], "dead backend with skewed stamps never aged out"
+    assert metrics.snapshot().get("registry_expired_total") == 1
+
+
 def test_record_preserves_slice_fields(tmp_path):
     """A router observation push must not wipe the serving-side fields
     (slice_id / world_size / last_heartbeat_ts)."""
