@@ -629,11 +629,19 @@ class Router:
         st.outcomes.clear()
         self._breaker_gauge(st.url).set(1.0)
 
-    def _record_forward_outcome(self, url: str, ok: bool) -> None:
+    def _record_forward_outcome(
+        self, url: str, ok: bool, trial: Optional[bool] = None
+    ) -> None:
         """Feed one forward outcome (ok = the backend answered with a
         stamped response; not-ok = transport death or an unstamped
         gateway code) into the backend's breaker window. Draining
-        responses are routed around and never recorded."""
+        responses are routed around and never recorded. ``trial`` says
+        whether THIS forward was the admitted half-open trial (stamped
+        by pick() at route time): only the trial's outcome may resolve
+        a half-open breaker — a slow forward dispatched before the trip
+        must not close it the moment the hold elapses. None = unknown
+        attribution (direct callers); falls back to the probe-live
+        flag."""
         if not self.config.breaker_enabled:
             return
         event = None
@@ -643,6 +651,12 @@ class Router:
             if st is None:
                 return
             if st.breaker == "half_open":
+                if trial is False or (
+                    trial is None and not st.breaker_probe_live
+                ):
+                    # Outcome of a forward dispatched before the trip
+                    # — stale evidence, ignored like the open state.
+                    return
                 # The single trial came back: close on success, re-open
                 # with an escalated hold on failure.
                 st.breaker_probe_live = False
@@ -690,15 +704,21 @@ class Router:
         if event is not None:
             self._logger.event(event)
 
-    def _note_draining(self, url: str) -> None:
+    def _note_draining(self, url: str, trial: bool = False) -> None:
         """A forward came back with a backend-stamped draining 503: the
         backend is alive but shutting down — take it out of rotation
         (ready=False) without ejection or failure accounting; the poll
-        loop re-admits it the moment /readyz recovers."""
+        loop re-admits it the moment /readyz recovers. When the forward
+        was the half-open breaker trial, release the trial slot: a
+        draining verdict resolves neither way, and a live probe flag
+        with no forward behind it would pin the backend out of rotation
+        forever (even across a restart on the same URL)."""
         with self._lock:
             st = self._backends.get(url)
             if st is not None:
                 st.ready = False
+                if trial and st.breaker == "half_open":
+                    st.breaker_probe_live = False
 
     # -- routing ---------------------------------------------------------
 
@@ -727,6 +747,18 @@ class Router:
         are out of rotation even when their probes pass; once the hold
         elapses they go half-open and exactly one trial forward may
         route here until it resolves."""
+        return self._pick_attributed(hint, exclude)[0]
+
+    def _pick_attributed(
+        self,
+        hint: Optional[Tuple[int, int, float]] = None,
+        exclude: Tuple[str, ...] = (),
+    ) -> Tuple[Optional[str], bool]:
+        """pick() plus trial attribution: (url, is_trial) where
+        is_trial marks that THIS route admitted the backend's single
+        half-open trial — forward() threads it back into
+        _record_forward_outcome so stale in-flight outcomes can't
+        resolve the breaker."""
         now = time.perf_counter()
         with self._lock:
             in_rotation = []
@@ -747,7 +779,7 @@ class Router:
                     continue  # the single trial is already in flight
                 in_rotation.append(st)
             if not in_rotation:
-                return None
+                return None, False
             self._rr += 1
             rr = self._rr
             scored = []
@@ -765,7 +797,10 @@ class Router:
             url = scored[0][3]
             self._backends[url].forwards += 1
             self._backends[url].live += 1
-            if self._backends[url].breaker == "half_open":
+            is_trial = self._backends[url].breaker == "half_open"
+            if is_trial:
+                # probe_live was False (gated above), so this route IS
+                # the single admitted trial.
                 self._backends[url].breaker_probe_live = True
             ctr = self._m_routed.get(url)
             if ctr is None:
@@ -776,7 +811,7 @@ class Router:
                 )
                 self._m_routed[url] = ctr
         ctr.inc()
-        return url
+        return url, is_trial
 
     # -- forwarding ------------------------------------------------------
 
@@ -839,7 +874,7 @@ class Router:
         route_path = urlsplit(path).path
         tried: Tuple[str, ...] = ()
         for attempt in range(2):
-            url = self.pick(hint, exclude=tried)
+            url, is_trial = self._pick_attributed(hint, exclude=tried)
             if url is None:
                 return 503, b"", None
             t0 = time.perf_counter()
@@ -869,7 +904,7 @@ class Router:
             if transport_dead or (
                 code in (502, 503, 504) and not from_backend
             ):
-                self._record_forward_outcome(url, False)
+                self._record_forward_outcome(url, False, trial=is_trial)
                 self._note_forward_failure(url)
                 if attempt == 0:
                     tried = (url,)
@@ -883,7 +918,7 @@ class Router:
                 # stop routing to it and retry this one request on a
                 # sibling. Distinct from a stamped 429/504, which pass
                 # through as the backend's own verdict.
-                self._note_draining(url)
+                self._note_draining(url, trial=is_trial)
                 if attempt == 0:
                     tried = (url,)
                     with self._lock:
@@ -894,7 +929,7 @@ class Router:
                 # Any backend-stamped response — including its own 429
                 # and TIMEOUT verdicts — proves the backend serves; it
                 # counts FOR the breaker window, not against it.
-                self._record_forward_outcome(url, True)
+                self._record_forward_outcome(url, True, trial=is_trial)
             return code, payload, url
         return code, payload, url  # second attempt's outcome, whatever it was
 

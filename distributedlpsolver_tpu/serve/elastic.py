@@ -96,6 +96,14 @@ class ElasticConfig:
     heartbeat_s: float = 0.5
     spawn_timeout_s: float = 180.0
     drain_timeout_s: float = 120.0
+    # Consecutive failed /statusz sweeps before a registry entry stops
+    # counting toward the live pool. Liveness is observer-derived: a
+    # stale entry (kill -9'd or drained backend that never unregisters)
+    # must not inflate n_live — standalone, with no router probing the
+    # registry, nothing else would ever clear it, and an inflated
+    # n_live makes reconcile drain HEALTHY members below min_backends
+    # while the self-heal respawn never fires.
+    statusz_miss_limit: int = 3
     # scale_out/scale_in/scale_veto JSONL event stream; None = off.
     log_jsonl: Optional[str] = None
 
@@ -171,6 +179,7 @@ class ElasticController:
         self._hi_since: Optional[float] = None
         self._lo_since: Optional[float] = None
         self._last_veto: Tuple[str, int] = ("", 0)
+        self._statusz_misses: Dict[str, int] = {}
         self._prev_rejects: Dict[str, int] = {}
         self._prev_reject_t: Optional[float] = None
         self._stop = threading.Event()
@@ -252,11 +261,21 @@ class ElasticController:
         return total
 
     def _observe(self) -> dict:
-        """One telemetry sweep: the registry's live backends + each
-        one's /statusz. Returns the signal summary the decision step
-        consumes (no lock held across the HTTP fetches)."""
+        """One telemetry sweep: the registry's non-ejected backends +
+        each one's /statusz. Returns the signal summary the decision
+        step consumes (no lock held across the HTTP fetches).
+
+        Liveness is derived by this observer, not trusted from the
+        registry: an entry counts toward ``n_live`` only while its
+        /statusz keeps answering (with ``statusz_miss_limit``
+        consecutive misses of grace for transient blips). Registry
+        entries are registered by the backends themselves and never
+        unregistered — a kill -9'd or drained member would otherwise
+        inflate ``n_live`` forever when no router is around to probe
+        it out, driving reconcile to drain healthy members below
+        ``min_backends`` while the self-heal respawn never fires."""
         data = self._registry.load()
-        live_urls = [
+        registered = [
             url
             for url, entry in (data.get("backends") or {}).items()
             if not entry.get("ejected", False)
@@ -267,10 +286,14 @@ class ElasticController:
         brownout_stage = 0
         rejects: Dict[str, int] = {}
         ready = 0
-        for url in live_urls:
+        for url in registered:
             stz = self._fetch_json(url.rstrip("/") + "/statusz")
             if stz is None:
+                self._statusz_misses[url] = (
+                    self._statusz_misses.get(url, 0) + 1
+                )
                 continue
+            self._statusz_misses[url] = 0
             ready += 1
             stats = stz.get("stats") or {}
             net = stz.get("net") or {}
@@ -284,6 +307,16 @@ class ElasticController:
             if p99 is not None:
                 p99s.append(float(p99))
             rejects[url] = self._rejects_in(stz)
+        reg_set = set(registered)
+        self._statusz_misses = {
+            u: c for u, c in self._statusz_misses.items() if u in reg_set
+        }
+        live_urls = [
+            u
+            for u in registered
+            if self._statusz_misses.get(u, 0)
+            < max(1, self.config.statusz_miss_limit)
+        ]
         # Reject RATE over the inter-poll window, from per-backend
         # monotonic totals (a drained backend's counter disappearing
         # never counts negative).
@@ -295,7 +328,14 @@ class ElasticController:
             if self._prev_reject_t is not None
             else None
         )
-        self._prev_rejects = rejects
+        # Merge fresh totals over the old baseline rather than replace
+        # it: a backend whose /statusz blipped this sweep keeps its
+        # baseline, so rejects accrued during the gap still count when
+        # it reappears. Prune only URLs that left the registry.
+        self._prev_rejects = {
+            u: c for u, c in self._prev_rejects.items() if u in reg_set
+        }
+        self._prev_rejects.update(rejects)
         self._prev_reject_t = now
         reject_rate = (delta / dt) if dt and dt > 0 else 0.0
         return {
@@ -421,16 +461,32 @@ class ElasticController:
     # -- actions ---------------------------------------------------------
 
     def _reap(self) -> None:
-        """Drop managed members whose process died (kill -9, OOM). The
-        registry/routers handle their ejection; reconcile respawns."""
+        """Drop managed members whose process died (kill -9, OOM) and
+        publish their ejection to the registry — standalone (no router
+        probing), nothing else would ever clear the stale entry, and a
+        stale entry inflates n_live. Reconcile respawns."""
         with self._lock:
             dead = [
-                name
-                for name, mb in self._pool.items()
+                mb
+                for mb in self._pool.values()
                 if mb.proc.poll() is not None
             ]
-            for name in dead:
-                del self._pool[name]
+            for mb in dead:
+                del self._pool[mb.name]
+        for mb in dead:  # registry I/O outside the lock
+            self._eject_from_registry(mb.url)
+
+    def _eject_from_registry(self, url: str) -> None:
+        """Best-effort: mark a member this controller knows is gone as
+        ejected, so n_live drops without waiting for the statusz miss
+        streak (or an external router's probes)."""
+        try:
+            self._registry.record(
+                url, ejected=True, fails=0, observed_ts=time.time()
+            )
+        except Exception:
+            pass  # the miss-streak liveness still converges
+        self._statusz_misses.pop(url, None)
 
     def _next_slot(self) -> int:
         with self._lock:
@@ -526,6 +582,17 @@ class ElasticController:
                 }
             )
             return None
+        # A fresh incarnation can land on a URL an earlier one was
+        # ejected under (the OS reuses freed ports) and register() never
+        # clears an ejection. The controller just fresh-probed /healthz,
+        # so publish re-admission the way a router's probe would.
+        try:
+            self._registry.record(
+                url, ejected=False, fails=0, observed_ts=time.time()
+            )
+        except Exception:
+            pass
+        self._statusz_misses.pop(url, None)
         lead_ms = round((time.perf_counter() - t_decide) * 1e3, 3)
         self._action_times.append(time.perf_counter())
         self._m_actions.inc()
@@ -601,6 +668,11 @@ class ElasticController:
             time.sleep(0.05)
         if not drained and mb.proc.poll() is None:
             mb.proc.terminate()
+        # The drained incarnation never unregisters itself: publish its
+        # ejection so the next sweep's n_live drops immediately instead
+        # of reconcile draining ANOTHER healthy member against a stale
+        # count.
+        self._eject_from_registry(mb.url)
         self._action_times.append(time.perf_counter())
         self._m_actions.inc()
         event = {

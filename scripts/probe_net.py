@@ -156,13 +156,16 @@ def main() -> int:
         fronts.append(front)
         regs.append(reg)
     router_reg = MetricsRegistry()
-    # poll_s is LONGER than the load wave on purpose: the router must
-    # discover backend B's death through a failed forward (the
-    # retry-once failover under test), not through a lucky health poll
-    # racing ahead of the traffic.
+    # poll_s outlasts the WHOLE probe on purpose (start() still runs
+    # one synchronous sweep, so both backends enter rotation): the
+    # router must discover backend B's death through a failed forward
+    # (the retry-once failover under test), not through a lucky health
+    # poll racing ahead of the traffic — a poll landing between the
+    # kill and the next forward to B marks it unhealthy below the
+    # eject threshold and no failover is ever exercised.
     router = Router(
         [f.url for f in fronts],
-        RouterConfig(poll_s=2.0, eject_after=2),
+        RouterConfig(poll_s=60.0, eject_after=2),
         metrics=router_reg,
     ).start()
     rhttp = RouterHTTPServer(router, metrics=router_reg).start()

@@ -257,6 +257,56 @@ def test_breaker_disabled_never_records():
     assert st.breaker == "closed" and st.outcomes == []
 
 
+def test_breaker_half_open_ignores_stale_forward_outcome():
+    r, st = _router()
+    for _ in range(4):
+        r._record_forward_outcome(st.url, False)
+    st.breaker_until = 0.0
+    url, is_trial = r._pick_attributed()
+    assert url == st.url and is_trial  # the single admitted trial
+    r._release(st.url)
+    # A slow forward dispatched BEFORE the trip lands while the trial
+    # is still in flight: explicitly attributed as not-the-trial, it
+    # must neither close the breaker nor consume the trial slot.
+    r._record_forward_outcome(st.url, True, trial=False)
+    assert st.breaker == "half_open" and st.breaker_probe_live
+    r._record_forward_outcome(st.url, False, trial=False)
+    assert st.breaker == "half_open" and st.breaker_probe_live
+    # The real trial's verdict still resolves it.
+    r._record_forward_outcome(st.url, True, trial=True)
+    assert st.breaker == "closed"
+
+
+def test_breaker_half_open_unattributed_outcome_needs_live_probe():
+    r, st = _router()
+    for _ in range(4):
+        r._record_forward_outcome(st.url, False)
+    st.breaker = "half_open"
+    st.breaker_probe_live = False  # hold elapsed, no trial admitted yet
+    # Unattributed outcome with no trial in flight = stale evidence.
+    r._record_forward_outcome(st.url, True)
+    assert st.breaker == "half_open"
+
+
+def test_breaker_trial_draining_releases_probe_slot():
+    r, st = _router()
+    st.ready = True
+    for _ in range(4):
+        r._record_forward_outcome(st.url, False)
+    st.breaker_until = 0.0
+    url, is_trial = r._pick_attributed()
+    assert url == st.url and is_trial
+    r._release(st.url)
+    # The trial forward came back with a backend-stamped draining 503:
+    # no breaker verdict, but the trial slot must be released or the
+    # backend is pinned out of rotation forever.
+    r._note_draining(st.url, trial=is_trial)
+    assert not st.breaker_probe_live and st.breaker == "half_open"
+    assert not st.ready
+    st.ready = True  # the poll loop readmits after /readyz recovers
+    assert r.pick() == st.url  # a fresh trial routes here again
+
+
 # -- controller decisions (no processes: observe/spawn/drain stubbed) --------
 
 
@@ -381,6 +431,96 @@ def test_controller_scales_in_when_idle_sustained(tmp_path):
     ctl.step()
     assert ctl.target() == 1
     assert calls == [("drain", "idle")]
+
+
+def _live_ctl(tmp_path, **kw):
+    """A controller with a REAL registry and no stubbing of _observe —
+    for the observer-derived-liveness regressions."""
+    defaults = dict(
+        registry_path=str(tmp_path / "reg.json"),
+        min_backends=1,
+        max_backends=3,
+        workdir=str(tmp_path),
+    )
+    defaults.update(kw)
+    return ElasticController(
+        ElasticConfig(**defaults), metrics=MetricsRegistry()
+    )
+
+
+def test_observe_excludes_unresponsive_registry_entries(tmp_path):
+    # A kill -9'd / drained backend never unregisters; with no router
+    # probing the registry, the controller itself must stop counting
+    # it live once /statusz goes dark — or reconcile drains healthy
+    # members against an inflated n_live (high-severity review fix).
+    ctl = _live_ctl(tmp_path, statusz_miss_limit=2)
+    ctl._registry.ensure(["http://127.0.0.1:1/", "http://127.0.0.1:2/"])
+    stz = {"stats": {"queue_depth": 1}, "net": {"inflight": 0}}
+    ctl._fetch_json = lambda url, timeout=1.0: (
+        stz if url.startswith("http://127.0.0.1:1") else None
+    )
+    obs = ctl._observe()
+    assert obs["n_live"] == 2  # one miss: transient-blip grace
+    assert obs["n_ready"] == 1
+    obs = ctl._observe()
+    assert obs["n_live"] == 1  # miss streak hit the limit: it is gone
+    # The dead entry recovering (respawn on the same URL) counts again.
+    ctl._fetch_json = lambda url, timeout=1.0: stz
+    obs = ctl._observe()
+    assert obs["n_live"] == 2
+
+
+def test_drain_and_reap_publish_registry_ejection(tmp_path):
+    from distributedlpsolver_tpu.serve.elastic import ManagedBackend
+
+    ctl = _live_ctl(tmp_path, drain_timeout_s=5.0)
+    url = "http://127.0.0.1:3"
+    ctl._registry.ensure([url])
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    mb = ManagedBackend(
+        name="elastic-0-g1", slot=0, url=url, port=3, proc=proc,
+        journal_dir=str(tmp_path), log_path=str(tmp_path / "x.log"),
+        spawned_at=0.0, gen=1,
+    )
+    ctl._pool[mb.name] = mb
+    ctl._drain_one(mb, reason="idle")
+    entry = ctl._registry.load()["backends"][url]
+    assert entry["ejected"] is True  # the stale entry cannot inflate n_live
+    # Same for the reap path (kill -9 / OOM members).
+    time.sleep(0.02)
+    ctl._registry.record(url, ejected=False, fails=0,
+                         observed_ts=time.time())
+    ctl._pool[mb.name] = mb
+    time.sleep(0.02)
+    ctl._reap()
+    assert ctl.pool_size() == 0
+    assert ctl._registry.load()["backends"][url]["ejected"] is True
+
+
+def test_observe_retains_reject_baseline_across_statusz_gap(tmp_path):
+    # A transient /statusz miss must not reset the reject baseline:
+    # rejects accrued during the gap still count toward the rate when
+    # the backend reappears (low-severity review fix).
+    ctl = _live_ctl(tmp_path, statusz_miss_limit=5)
+    url = "http://127.0.0.1:4/"
+    ctl._registry.ensure([url])
+
+    def _stz(total):
+        return {
+            "stats": {
+                "admission": {"t": {"rejected": {"queue_full": total}}}
+            },
+            "net": {"inflight": 0},
+        }
+
+    replies = iter([_stz(5), None, _stz(9)])
+    ctl._fetch_json = lambda u, timeout=1.0: next(replies)
+    ctl._observe()  # baseline: 5 rejects
+    ctl._observe()  # blip: fetch fails, baseline must survive
+    assert ctl._prev_rejects  # not wiped by the gap
+    obs = ctl._observe()  # back: 9 - 5 = 4 rejects over the window
+    assert obs["reject_rate"] > 0.0
 
 
 def test_controller_rejects_inverted_bounds(tmp_path):

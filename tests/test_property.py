@@ -10,6 +10,11 @@ the to_interior_form/recover round trip is covered too).
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tier needs hypothesis installed"
+)
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 
