@@ -1567,6 +1567,159 @@ def _bench_elastic(args) -> list:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _bench_tail(args) -> list:
+    """Tail-tolerance rows (``--tail``): one sync wave through a LIVE
+    3-backend plane with one backend SIGSTOPped mid-wave, measured with
+    hedging OFF and then ON — what adaptive hedging buys on the p99
+    when a straggler appears, against the same healthy-floor wave. OFF
+    rows censor straggler-stuck requests at the client timeout (the
+    honest rendering of "this request would have waited out the full
+    forward timeout"); ON rows carry the router's hedging ledger. CPU
+    harness: these rows measure the routing plane, not TPU speed;
+    ``--require-tpu`` aborts before any fallback row as everywhere."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from distributedlpsolver_tpu.net.chaos import ChaosPlane
+    from distributedlpsolver_tpu.obs.stats import percentile
+
+    shape = (96, 288)
+    n_wave = 16 if args.quick else 24
+    cap_s = 15.0  # censor bound for straggler-stuck requests (OFF mode)
+
+    def post(url, body=None, timeout=60.0):
+        req = urllib.request.Request(
+            url,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"} if body else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:
+                return e.code, {}
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            return 599, {"error": f"{type(e).__name__}: {e}"}
+
+    workdir = tempfile.mkdtemp(prefix="dlps-bench-tail-")
+    plane = ChaosPlane(workdir)
+    buckets_json = os.path.join(workdir, "ladder.json")
+    with open(buckets_json, "w") as fh:
+        fh.write(json.dumps([{"m": shape[0], "n": shape[1], "batch": 4}]))
+    try:
+        t0 = time.perf_counter()
+        names = ["tail-be-a", "tail-be-b", "tail-be-c"]
+        for name in names:
+            plane.spawn_backend(
+                name,
+                buckets_json=buckets_json,
+                extra_flags=["--flush-ms", "20", "--batch", "4"],
+            )
+        for name in names:
+            if not plane.wait_ready(plane.procs[name], 180):
+                raise RuntimeError(f"tail bench: {name} never came up")
+        _log(f"tail plane up in {time.perf_counter() - t0:.1f}s")
+        victim = names[-1]
+        rows = []
+        for mode in ("off", "on"):
+            rname = f"tail-router-{mode}"
+            router = plane.spawn_router(
+                rname,
+                [plane.procs[n].url for n in names],
+                os.path.join(workdir, f"registry-{mode}.json"),
+                extra_flags=(
+                    ["--hedge", "--hedge-rate-cap", "0.5",
+                     "--retry-budget", "50", "--retry-budget-burst", "50"]
+                    if mode == "on"
+                    else ["--no-hedge"]
+                ),
+            )
+            if not plane.wait_ready(router, 60):
+                raise RuntimeError(f"tail bench: {rname} never came up")
+
+            def fire(n, base, timeout_s):
+                """n near-simultaneous sync solves; returns (walls_ms,
+                censored_count) with client-timeout walls censored at
+                the cap instead of dropped."""
+                walls, censored, lock = [], [0], threading.Lock()
+
+                def drive(k):
+                    t = time.perf_counter()
+                    c, o = post(
+                        router.url + "/v1/solve",
+                        {"m": shape[0], "n": shape[1], "seed": base + k,
+                         "tenant": "bench", "id": f"tail-{mode}-{base + k}"},
+                        timeout=timeout_s,
+                    )
+                    wall = (time.perf_counter() - t) * 1e3
+                    with lock:
+                        if c == 599:
+                            censored[0] += 1
+                            walls.append(timeout_s * 1e3)
+                        else:
+                            walls.append(wall)
+
+                ws = []
+                for k in range(n):
+                    w = threading.Thread(target=drive, args=(k,), daemon=True)
+                    w.start()
+                    ws.append(w)
+                    time.sleep(0.02)
+                for w in ws:
+                    w.join(timeout=timeout_s + 30)
+                return walls, censored[0]
+
+            # Warm until every backend's digest is warm (ON mode needs
+            # >= hedge_min_samples; OFF gets the same treatment so the
+            # healthy floors are comparable).
+            sent = 0
+            while sent < 120:
+                fire(6, 1000 + sent, 90.0)
+                sent += 6
+                c, o = post(router.url + "/statusz", timeout=5.0)
+                fwd = [
+                    b.get("forwards", 0) for b in o.get("backends", [])
+                ]
+                if c == 200 and fwd and min(fwd) >= 10:
+                    break
+            healthy, _ = fire(n_wave, 2000, 90.0)
+            plane.sigstop(victim)
+            straggler, censored = fire(n_wave, 3000, cap_s)
+            plane.sigcont(victim)
+            plane.wait_ready(plane.procs[victim], 60)
+            c, o = post(router.url + "/statusz", timeout=5.0)
+            row = {
+                "family": "tail",
+                "phase": f"hedge_{mode}",
+                "instance": f"dense {shape[0]}x{shape[1]} batch=4",
+                "n": n_wave,
+                "healthy_ms_p50": round(percentile(healthy, 50), 3),
+                "healthy_ms_p99": round(percentile(healthy, 99), 3),
+                "latency_ms_p50": round(percentile(straggler, 50), 3),
+                "latency_ms_p99": round(percentile(straggler, 99), 3),
+                "censored_at_ms": cap_s * 1e3,
+                "censored": censored,
+                "platform": args.platform,
+            }
+            if mode == "on" and c == 200:
+                row["hedging"] = o.get("hedging")
+            rows.append(row)
+            _log(json.dumps(row))
+            # This mode's router is done; the stuck OFF-mode legs died
+            # with it rather than lingering into the ON measurement.
+            plane.kill9(rname)
+        return rows
+    finally:
+        plane.shutdown_all()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (smoke)")
@@ -1598,6 +1751,11 @@ def main() -> int:
                     "live router + ElasticController pool, with the "
                     "pool trajectory, scale-out lead times, and the "
                     "brownout engaged window -> BENCH_ELASTIC.json")
+    ap.add_argument("--tail", action="store_true",
+                    help="tail-tolerance rows: p50/p99 of a sync wave "
+                    "over a live 3-backend plane with one backend "
+                    "SIGSTOPped mid-wave, hedging off vs on (the "
+                    "hedging ledger rides the on row) -> BENCH_TAIL.json")
     ap.add_argument("--serve-http", action="store_true",
                     help="serving rows incl. the HTTP network plane: the "
                     "in-process row plus a localhost POST /v1/solve row, "
@@ -1678,6 +1836,17 @@ def main() -> int:
         _log(f"elastic rows -> {out}")
         print(json.dumps(rows[1]))  # headline: the ramp row
         return 0  # elasticity tier is its own run; no headline solve after
+
+    if args.tail:
+        rows = _bench_tail(args)
+        for r in rows:
+            r.setdefault("metrics", _obs_row(args.platform))
+        out = os.path.join(_REPO, "BENCH_TAIL.json")
+        with open(out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+        _log(f"tail rows -> {out}")
+        print(json.dumps(rows[-1]))  # headline: the hedging-on row
+        return 0  # tail tier is its own run; no headline solve after
 
     if args.scenario:
         rows = _bench_scenario(args)

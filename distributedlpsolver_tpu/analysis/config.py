@@ -36,6 +36,11 @@ HOT_SCOPES = {
         "Router.poll_once",
         "Router._record_probe",
         "Router.forward",
+        # Hedge legs run on their own threads concurrently with the
+        # client-facing forward — same no-device-value contract.
+        "Router._forward_hedged",
+        "Router._attempt_result",
+        "Router._cancel_loser",
     },
     "net/server.py": {
         "SolveHTTPServer.health",
@@ -153,6 +158,16 @@ JSONL_EVENT_TYPES = {
     "brownout_exit",
     "breaker_open",
     "breaker_close",
+    # Tail tolerance (net/router.py, net/server.py, serve/service.py):
+    # one record per hedge resolution (launched hedges only — the
+    # suppressed ones surface through router_hedges_total and the
+    # statusz ledger), per cancellation (router loser-cancel AND the
+    # backend's queue-removal), per unfunded retry-budget spend, and
+    # per expired-on-arrival deadline rejection at a backend.
+    "hedge",
+    "cancel",
+    "retry_budget",
+    "deadline_expired",
 }
 
 # Every field a stamped JSONL record may carry, across all streams: the
@@ -312,6 +327,17 @@ JSONL_FIELDS = {
     "target",
     "error_rate",
     "backoff_s",
+    # tail tolerance: hedge events carry the primary backend, the delay
+    # that fired, and the resolution outcome; route events flag hedge
+    # legs; cancel events carry the cancellation state verdict; the
+    # backend's deadline_expired rejection records the (zero) budget
+    # that arrived.
+    "primary",
+    "delay_ms",
+    "outcome",
+    "hedge",
+    "state",
+    "remaining_ms",
 }
 
 # ``X.write(json.dumps(...))`` record emission points that must stamp:

@@ -478,6 +478,7 @@ def cmd_serve_http(args) -> int:
         max_wait_s=args.max_wait_s,
         wedge_s=args.wedge_s,
         log_jsonl=args.net_log_jsonl,
+        deadline_propagation=getattr(args, "deadline_propagation", True),
     )
     # A serving process ADVERTISES /metrics, so it always gets a live
     # registry — the zero-cost NULL default is for the in-process
@@ -663,6 +664,9 @@ def cmd_serve_slice(args) -> int:
             max_wait_s=args.max_wait_s,
             wedge_s=args.wedge_s,
             log_jsonl=args.net_log_jsonl,
+            deadline_propagation=getattr(
+                args, "deadline_propagation", True
+            ),
         )
         reg = obs_metrics.get_registry()
         if not reg.enabled:
@@ -781,6 +785,11 @@ def cmd_route(args) -> int:
             registry_path=args.registry,
             probe_backoff_cap_s=args.probe_backoff_cap_s,
             registry_ttl_s=args.registry_ttl_s,
+            hedge_enabled=args.hedge,
+            hedge_rate_cap=args.hedge_rate_cap,
+            retry_budget_rate=args.retry_budget,
+            retry_budget_burst=args.retry_budget_burst,
+            deadline_propagation=args.deadline_propagation,
         ),
         metrics=reg,
     )
@@ -1148,6 +1157,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--heartbeat-s", type=float, default=1.0,
         help="registry heartbeat cadence when --registry is set",
     )
+    ap_http.add_argument(
+        "--deadline-propagation", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="honor the X-DLPS-Deadline-Ms remaining-budget header: "
+        "clamp the request deadline to it and admission-reject work "
+        "whose budget expired in flight (README 'Tail tolerance')",
+    )
     _add_serving_flags(ap_http)
     _add_solver_flags(ap_http)
     ap_http.set_defaults(fn=cmd_serve_http, quiet=True)
@@ -1268,6 +1284,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--registry-ttl-s", type=float, default=0.0,
         help="eject self-registered backends whose registry heartbeat "
         "is older than this (0 = off; README 'Multi-host')",
+    )
+    ap_rt.add_argument(
+        "--hedge", action=argparse.BooleanOptionalAction, default=True,
+        help="adaptive hedged solves: when a primary forward is silent "
+        "past the backend's recent p95, race ONE duplicate on the "
+        "next-best backend (README 'Tail tolerance')",
+    )
+    ap_rt.add_argument(
+        "--hedge-rate-cap", type=float, default=0.05,
+        help="global bound on hedges as a fraction of solve forwards",
+    )
+    ap_rt.add_argument(
+        "--retry-budget", type=float, default=5.0,
+        help="per-tenant retry-budget refill rate (tokens/s); retries "
+        "drain it, hedges require a whole token",
+    )
+    ap_rt.add_argument(
+        "--retry-budget-burst", type=float, default=20.0,
+        help="per-tenant retry-budget bucket capacity",
+    )
+    ap_rt.add_argument(
+        "--deadline-propagation", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="stamp every forward/retry/hedge with the REMAINING "
+        "deadline budget (X-DLPS-Deadline-Ms + body re-stamp)",
     )
     ap_rt.add_argument("--metrics-path", default=None, help=argparse.SUPPRESS)
     ap_rt.add_argument("--trace-path", default=None, help=argparse.SUPPRESS)

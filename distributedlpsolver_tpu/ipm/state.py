@@ -55,6 +55,7 @@ class Status(enum.Enum):
     STALLED = "stalled"  # no progress over the stall window (fused loop)
     FAILED = "failed"  # supervisor exhausted its recovery ladder (supervisor/)
     TIMEOUT = "timeout"  # serve/: request deadline expired before a result
+    CANCELLED = "cancelled"  # serve/: queued work cancelled before dispatch
 
 
 class FaultKind(enum.Enum):

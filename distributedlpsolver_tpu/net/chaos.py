@@ -38,6 +38,13 @@ scales real backends against it, and :meth:`ChaosPlane.kill9_pid`
 SIGKILLs controller-spawned members (which live outside ``procs``) so
 self-healing is validated mid-scale. ``scripts/probe_elastic_serve.py``
 drives that acceptance scenario.
+
+The tail leg (README "Tail tolerance") adds the straggler faults
+hedging exists for: ``sigstop`` freezes one backend mid-stream (the
+router's hedge — not just its retry — must keep the tail bounded) and
+:class:`SlowLoris` drips never-completing request headers into a plane
+process to tie up handler threads while live traffic keeps flowing.
+``scripts/probe_tail.py`` drives that acceptance scenario.
 """
 
 from __future__ import annotations
@@ -46,8 +53,10 @@ import dataclasses
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -154,6 +163,77 @@ class LoadRamp:
         return 1.0 / self.rps_at(i / float(self.total))
 
 
+class SlowLoris:
+    """Slow-loris attacker for the tail leg: ``conns`` sockets against
+    one plane process, each sending an HTTP request whose headers never
+    finish — one byte every ``drip_s`` seconds, no terminating blank
+    line. The plane's servers are threaded, so each drip pins one
+    handler thread; the probe asserts that live traffic keeps meeting
+    its latency bound while the drip holds. Deterministic by
+    construction (fixed byte stream, fixed cadence)."""
+
+    _PREFIX = b"POST /v1/solve HTTP/1.1\r\nHost: loris\r\nX-Loris: "
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        conns: int = 8,
+        drip_s: float = 0.25,
+    ):
+        self.host = host
+        self.port = port
+        self.conns = conns
+        self.drip_s = drip_s
+        # Attack ledger (guarded by _lock): connections that opened and
+        # total header bytes dripped — the probe's proof the attack was
+        # actually in progress while the latency bound held.
+        self.opened = 0
+        self.dripped = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def _run_one(self) -> None:
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=5.0
+            )
+        except OSError:
+            return
+        with self._lock:
+            self.opened += 1
+        try:
+            sock.sendall(self._PREFIX)
+            while not self._stop.wait(self.drip_s):
+                sock.sendall(b"y")
+                with self._lock:
+                    self.dripped += 1
+        except OSError:
+            pass  # the server hung up on us — that is its prerogative
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def start(self) -> "SlowLoris":
+        for i in range(self.conns):
+            t = threading.Thread(
+                target=self._run_one,
+                daemon=True,
+                name=f"dlps-loris-{i}",
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+
 @dataclasses.dataclass
 class ManagedProcess:
     """One spawned plane process plus everything needed to relaunch it."""
@@ -179,8 +259,6 @@ def free_port() -> int:
     """An OS-assigned free TCP port (the restart scenario needs FIXED
     ports — poll URLs and registry entries embed them — so the plane
     reserves them up front instead of binding port 0)."""
-    import socket
-
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]

@@ -46,6 +46,14 @@ from distributedlpsolver_tpu.models.problem import LPProblem
 PLANE_HEADER = "X-DLPS-Plane"
 PLANE_BACKEND = "backend"
 
+# Remaining-deadline-budget header (milliseconds, decimal). The router
+# stamps it on every forward and re-stamps the REMAINING budget (original
+# minus elapsed) on every retry and hedge, so a hop never resurrects
+# already-spent budget. Backends treat it as an upper bound on the body's
+# own ``deadline_ms`` and admission-reject expired-on-arrival work with a
+# structured verdict instead of queueing it to die.
+DEADLINE_HEADER = "X-DLPS-Deadline-Ms"
+
 
 class ProtocolError(ValueError):
     """Malformed request body/fields — the HTTP 400 path."""
@@ -226,13 +234,71 @@ def peek_route_hint(
     return None
 
 
+def peek_deadline_tenant(
+    body: bytes, content_type: str = "application/json", query: str = ""
+) -> Tuple[Optional[float], str]:
+    """Cheap (deadline_ms, tenant) extraction for the router's deadline
+    propagation and per-tenant retry-budget accounting — reads the JSON
+    envelope (or the query string for raw-MPS bodies) without
+    materializing the problem. deadline_ms is None when the request is
+    unbounded."""
+    qfields = {
+        k: v[0] for k, v in urllib.parse.parse_qs(query or "").items()
+    }
+    spec: dict = dict(qfields)
+    if "json" in (content_type or "").lower():
+        try:
+            parsed = json.loads(body.decode("utf-8"))
+            if isinstance(parsed, dict):
+                spec.update(parsed)
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            pass  # backend's parse will 400; nothing to propagate
+    try:
+        dl = spec.get("deadline_ms")
+        deadline_ms = None if dl is None else float(dl)
+    except (TypeError, ValueError):
+        deadline_ms = None
+    tenant = str(spec.get("tenant") or "default")
+    return deadline_ms, tenant
+
+
+def restamp_deadline(
+    body: bytes,
+    content_type: str,
+    query: str,
+    remaining_ms: float,
+) -> Tuple[bytes, str]:
+    """Rewrite the request's own ``deadline_ms`` to the remaining budget
+    (a retry/hedge must not resurrect spent budget). JSON bodies carry
+    the field inline; raw-MPS bodies carry it in the query string.
+    Returns (body, query) — unchanged when the original carried no
+    deadline (the header the caller stamps is then the only budget)."""
+    remaining_ms = max(0.0, float(remaining_ms))
+    if "json" in (content_type or "").lower():
+        try:
+            spec = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return body, query
+        if isinstance(spec, dict) and spec.get("deadline_ms") is not None:
+            spec["deadline_ms"] = round(remaining_ms, 3)
+            return json.dumps(spec).encode("utf-8"), query
+        return body, query
+    q = urllib.parse.parse_qs(query or "")
+    if "deadline_ms" in q:
+        q["deadline_ms"] = [f"{remaining_ms:.3f}"]
+        return body, urllib.parse.urlencode(q, doseq=True)
+    return body, query
+
+
 # RequestResult.status -> HTTP code. Terminal solver verdicts are 200
 # (the verdict is data, not transport failure); a queued-past-deadline
 # request is the gateway-timeout class; an exhausted recovery ladder is
-# the server-error class.
+# the server-error class; client-requested cancellation is 499 (the
+# nginx client-closed-request convention — the hedge loser's verdict).
 _STATUS_HTTP = {
     Status.TIMEOUT: 504,
     Status.FAILED: 500,
+    Status.CANCELLED: 499,
 }
 
 
@@ -291,6 +357,7 @@ def payload_from_record(rec: dict) -> Tuple[int, dict]:
     code = {
         Status.TIMEOUT.value: 504,
         Status.FAILED.value: 500,
+        Status.CANCELLED.value: 499,
     }.get(status, 200)
 
     def _f(key):

@@ -28,6 +28,27 @@ that drive routing:
   backend: under a deadline storm, ejecting on those would empty the
   whole rotation and duplicate every shed solve elsewhere.
 
+Tail tolerance (README "Tail tolerance"):
+
+- **deadline propagation**: a request carrying ``deadline_ms`` is
+  forwarded with the ``X-DLPS-Deadline-Ms`` header holding the
+  REMAINING budget (original minus elapsed at this router), and every
+  retry/hedge re-stamps body and header with what is left — a hop can
+  consume budget but never resurrect it. Backends admission-reject
+  expired-on-arrival work with a structured timeout verdict.
+- **adaptive hedging**: per-backend latency digests over completed
+  forwards set a hedge delay (clamped p95); when the primary forward
+  of a ``POST /v1/solve`` is silent past it, ONE hedge goes to the
+  next-best backend and the first acceptable response wins. Safe
+  because journal fingerprint dedup makes duplicate submits attach to
+  one solve, and the losing leg's acknowledged-but-queued work is
+  cancelled (``POST /v1/cancel/{jid}``). A global hedge-rate cap and a
+  per-tenant retry-budget token bucket bound the speculative load:
+  budget-exhausted or cap-hit → no hedge, attributed event. Hedges
+  compose with breaker/readiness state (an open breaker or draining
+  backend is never a hedge target), and a stamped 429 (browned-out
+  backend shedding) never wins a hedge — backpressure is not raced.
+
 Everything is stdlib: ``urllib.request`` for forwarding,
 ``http.server`` for the front. Async-poll ids are backend-local, so
 ``GET /v1/solve/{id}`` consults the router's bounded id → backend map
@@ -38,11 +59,13 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import queue as queue_mod
 import socket
 import threading
 import time
 import urllib.error
 import urllib.request
+import zlib
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional, Tuple
@@ -51,6 +74,7 @@ from urllib.parse import urlsplit
 from distributedlpsolver_tpu.net import protocol
 from distributedlpsolver_tpu.net.server import PlaneHTTPServer
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.obs.stats import percentile
 from distributedlpsolver_tpu.utils.logging import IterLogger
 
 
@@ -110,6 +134,35 @@ class RouterConfig:
     breaker_hold_base_s: float = 1.0
     breaker_hold_cap_s: float = 30.0
     breaker_enabled: bool = True
+    # Adaptive hedged requests (POST /v1/solve only): when the primary
+    # forward is silent past the hedge delay — the backend's recent p95
+    # forward latency, clamped to [min, max] ms with deterministic
+    # jitter — ONE hedge goes to the next-best backend; first acceptable
+    # response wins. A backend with fewer than hedge_min_samples
+    # completed forwards has no digest and never triggers a hedge
+    # (measure, don't guess).
+    hedge_enabled: bool = True
+    hedge_delay_min_ms: float = 50.0
+    hedge_delay_max_ms: float = 2000.0
+    hedge_min_samples: int = 8
+    # Global cap: launched hedges may never exceed this fraction of all
+    # forwards — speculative load is bounded even when every backend
+    # looks slow (which under overload is exactly when hedging would
+    # amplify the problem).
+    hedge_rate_cap: float = 0.05
+    # Per-tenant retry-budget token bucket (tokens/s, burst cap),
+    # charged one token per retry AND per hedge. Retries always proceed
+    # — retry-once is the plane's no-lost-acks mechanism — but they
+    # DRAIN the bucket, so under a retry storm the speculative hedges
+    # are what stop first; an exhausted bucket suppresses hedging with
+    # an attributed event. Bounded latency-sample window per backend.
+    retry_budget_rate: float = 5.0
+    retry_budget_burst: float = 20.0
+    latency_window: int = 64
+    # Stamp/decrement X-DLPS-Deadline-Ms on every forward hop of a
+    # request that carries deadline_ms (and re-stamp the body's own
+    # field with the remaining budget on retries/hedges).
+    deadline_propagation: bool = True
 
 
 @dataclasses.dataclass
@@ -175,6 +228,9 @@ class BackendState:
     breaker_hold_s: float = 0.0
     breaker_probe_live: bool = False  # the single half-open trial
     breaker_closed_at: float = 0.0  # perf_counter of the last close
+    # Bounded streaming latency digest (ms) over completed stamped
+    # forwards — drives the adaptive hedge delay (p50/p95 in statusz).
+    lat_ms: List[float] = dataclasses.field(default_factory=list)
 
 
 class Router:
@@ -214,6 +270,27 @@ class Router:
             "router_breaker_opens_total",
             help="circuit-breaker trips (closed/half-open -> open)",
         )
+        # Tail tolerance: hedge accounting and the per-tenant retry
+        # budget. Hedge outcome counters are label-keyed and lazily
+        # created; the tenant bucket table is bounded (client strings).
+        self._m_hedges: Dict[str, object] = {}  # outcome -> counter; guarded-by: _lock
+        self._m_hedge_delay = m.histogram(
+            "router_hedge_delay_ms",
+            help="hedge delay used when a hedge was launched",
+        )
+        self._m_budget_exhausted = m.counter(
+            "retry_budget_exhausted_total",
+            help="retries/hedges that found the tenant's retry-budget "
+            "bucket empty (hedges are suppressed; retries proceed but "
+            "drain the bucket)",
+        )
+        self._forwards_total = 0  # guarded-by: _lock
+        self._hedges_launched = 0  # guarded-by: _lock
+        self._hedge_outcomes: Dict[str, int] = {}  # guarded-by: _lock
+        self._hedge_cancels = 0  # loser-cancel POSTs issued; guarded-by: _lock
+        self._budget_exhausted = 0  # guarded-by: _lock
+        # tenant -> (tokens, t_refill); bounded LRU over client strings.
+        self._retry_tokens: OrderedDict = OrderedDict()  # guarded-by: _lock
         # Shared registry: warm-load the table a sibling (or our own
         # previous incarnation) built instead of starting blind, then
         # contribute our configured backends.
@@ -720,6 +797,284 @@ class Router:
                 if trial and st.breaker == "half_open":
                     st.breaker_probe_live = False
 
+    # -- tail tolerance: latency digest, hedge delay, retry budget -------
+
+    def _observe_latency(self, url: str, ms: float) -> None:
+        """Feed one completed stamped forward's wall into the backend's
+        bounded latency digest (the hedge delay's input)."""
+        with self._lock:
+            st = self._backends.get(url)
+            if st is None:
+                return
+            st.lat_ms.append(ms)
+            if len(st.lat_ms) > self.config.latency_window:
+                del st.lat_ms[: len(st.lat_ms) - self.config.latency_window]
+
+    def _hedge_delay_s(self, url: str) -> Optional[float]:
+        """Adaptive hedge delay for a forward to ``url``: the backend's
+        recent p95 forward latency clamped to [min, max] ms, with the
+        same deterministic ±25% jitter shape as the probe backoff (keyed
+        by the backend and its forward count, so a seeded chaos run
+        replays exactly but hedges de-phase across backends). None =
+        hedging disabled or the digest is under-sampled — the router
+        never guesses a delay it has not measured."""
+        if not self.config.hedge_enabled:
+            return None
+        with self._lock:
+            st = self._backends.get(url)
+            if st is None or len(st.lat_ms) < self.config.hedge_min_samples:
+                return None
+            samples = list(st.lat_ms)
+            n_fwd = st.forwards
+        p95 = percentile(samples, 95)
+        lo = self.config.hedge_delay_min_ms
+        hi = self.config.hedge_delay_max_ms
+        raw = min(max(p95, lo), hi)
+        frac = (
+            zlib.crc32(f"hedge:{url}:{n_fwd}".encode("utf-8")) % 1000
+        ) / 1000.0
+        return min(hi, raw * (0.75 + 0.5 * frac)) / 1e3
+
+    def _spend_retry_budget(self, tenant: str, kind: str) -> bool:
+        """Charge one token from ``tenant``'s retry-budget bucket for a
+        retry or a hedge. Returns whether the spend was FUNDED. Retries
+        proceed either way (retry-once is the plane's no-lost-acks
+        mechanism) but drain the bucket to its floor, so under a retry
+        storm the speculative hedges stop first; an unfunded hedge is
+        suppressed by the caller. Unfunded spends count into
+        retry_budget_exhausted_total with an attributed event."""
+        cfg = self.config
+        now = time.perf_counter()
+        event = None
+        with self._lock:
+            tokens, t_refill = self._retry_tokens.get(
+                tenant, (cfg.retry_budget_burst, now)
+            )
+            tokens = min(
+                cfg.retry_budget_burst,
+                tokens + (now - t_refill) * cfg.retry_budget_rate,
+            )
+            funded = tokens >= 1.0
+            if funded:
+                tokens -= 1.0
+            self._retry_tokens[tenant] = (tokens, now)
+            self._retry_tokens.move_to_end(tenant)
+            while len(self._retry_tokens) > 256:  # bounded client strings
+                self._retry_tokens.popitem(last=False)
+            if not funded:
+                self._budget_exhausted += 1
+                event = {
+                    "event": "retry_budget",
+                    "tenant": tenant,
+                    "kind": kind,
+                    "reason": "exhausted",
+                }
+        if event is not None:
+            self._m_budget_exhausted.inc()
+            self._logger.event(event)
+        return funded
+
+    def _refund_retry_token(self, tenant: str) -> None:
+        """Return a token spent on a hedge that never launched (no
+        second eligible backend) — suppression must not charge."""
+        cfg = self.config
+        with self._lock:
+            tokens, t_refill = self._retry_tokens.get(tenant, (0.0, 0.0))
+            self._retry_tokens[tenant] = (
+                min(cfg.retry_budget_burst, tokens + 1.0),
+                t_refill,
+            )
+
+    def _count_hedge(self, outcome: str) -> None:
+        """router_hedges_total{outcome} + the statusz tally. Outcomes:
+        hedge_won / primary_won / both_failed for launched hedges;
+        suppressed_cap / suppressed_budget / suppressed_no_backend for
+        hedges the policy refused — counted so the rate cap and budget
+        are auditable against events."""
+        with self._lock:
+            self._hedge_outcomes[outcome] = (
+                self._hedge_outcomes.get(outcome, 0) + 1
+            )
+            ctr = self._m_hedges.get(outcome)
+            if ctr is None:
+                ctr = self.metrics.counter(
+                    "router_hedges_total",
+                    labels={"outcome": outcome},
+                    help="hedge decisions by outcome (launched hedges "
+                    "resolve to hedge_won/primary_won/both_failed; "
+                    "suppressed_* are policy refusals)",
+                )
+                self._m_hedges[outcome] = ctr
+        ctr.inc()
+
+    def _hedge_pick(
+        self,
+        hint: Optional[Tuple[int, int, float]],
+        exclude: Tuple[str, ...],
+        tenant: str,
+    ) -> Tuple[Optional[str], bool]:
+        """(url, is_trial) for the single hedge of one forward, or
+        (None, False) when hedging is suppressed: the global rate cap
+        is hit, the tenant's retry budget is exhausted, or no second
+        eligible backend exists (breaker-open, draining, and ejected
+        backends are already out of _pick_attributed's rotation — a
+        hedge never lands on one)."""
+        with self._lock:
+            capped = (self._hedges_launched + 1) > (
+                self.config.hedge_rate_cap * max(1, self._forwards_total)
+            )
+        if capped:
+            self._count_hedge("suppressed_cap")
+            return None, False
+        if not self._spend_retry_budget(tenant, "hedge"):
+            self._count_hedge("suppressed_budget")
+            return None, False
+        url, is_trial = self._pick_attributed(hint, exclude=exclude)
+        if url is None:
+            self._refund_retry_token(tenant)
+            self._count_hedge("suppressed_no_backend")
+            return None, False
+        with self._lock:
+            self._hedges_launched += 1
+        return url, is_trial
+
+    def _cancel_loser(self, url: str, payload: bytes, tenant: str) -> None:
+        """The losing hedge leg ACKed queued work (202): cancel its
+        queued-but-not-dispatched copy at that backend so the duplicate
+        admit releases its admission units and the journal stamps
+        ``cancelled``. Best-effort — the winner already answered the
+        client, and a 409 (the copy was dispatched before the cancel
+        landed) just means fingerprint dedup or the duplicate solve
+        finishes on its own."""
+        try:
+            rid = json.loads(payload.decode("utf-8")).get("id")
+        except (ValueError, UnicodeDecodeError, AttributeError):
+            return
+        if not rid:
+            return
+        state = "unreachable"
+        code = 599
+        try:
+            code, body, _ = self._forward_once(
+                url, f"/v1/cancel/{rid}", b"", "application/json", "POST"
+            )
+            try:
+                state = str(
+                    json.loads(body.decode("utf-8")).get("state", "?")
+                )
+            except (ValueError, UnicodeDecodeError, AttributeError):
+                state = "?"
+        except (urllib.error.URLError, socket.timeout, OSError):
+            pass
+        with self._lock:
+            self._hedge_cancels += 1
+        self._logger.event(
+            {
+                "event": "cancel",
+                "backend": url,
+                "jid": str(rid),
+                "tenant": tenant,
+                "code": code,
+                "state": state,
+            }
+        )
+
+    def _stamped_request(
+        self,
+        path: str,
+        body: bytes,
+        content_type: str,
+        method: str,
+        deadline_ms: Optional[float],
+        t_start: float,
+    ) -> Tuple[str, bytes, Optional[Dict[str, str]]]:
+        """(path, body, extra headers) for one forward attempt with the
+        REMAINING deadline budget stamped: header always, and the
+        body's/query's own deadline_ms re-stamped so a retry or hedge
+        consumes what is left of the budget rather than resurrecting
+        the original."""
+        if (
+            deadline_ms is None
+            or not self.config.deadline_propagation
+            or method != "POST"
+        ):
+            return path, body, None
+        elapsed_ms = (time.perf_counter() - t_start) * 1e3
+        remaining = max(0.0, deadline_ms - elapsed_ms)
+        parts = urlsplit(path)
+        new_body, new_query = protocol.restamp_deadline(
+            body, content_type, parts.query, remaining
+        )
+        new_path = parts.path + (f"?{new_query}" if new_query else "")
+        return new_path, new_body, {
+            protocol.DEADLINE_HEADER: f"{remaining:.3f}"
+        }
+
+    def _attempt_result(
+        self,
+        url: str,
+        path: str,
+        body: bytes,
+        content_type: str,
+        method: str,
+        headers: Optional[Dict[str, str]],
+    ) -> Tuple[int, bytes, bool, bool, float]:
+        """One forward attempt with live-count release and wall timing:
+        (code, payload, from_backend, transport_dead, ms)."""
+        t0 = time.perf_counter()
+        try:
+            code, payload, from_backend = self._forward_once(
+                url, path, body, content_type, method, headers
+            )
+            dead = False
+        except (urllib.error.URLError, socket.timeout, OSError):
+            code, payload, from_backend = 502, b"", False
+            dead = True
+        finally:
+            self._release(url)
+        return code, payload, from_backend, dead, (
+            (time.perf_counter() - t0) * 1e3
+        )
+
+    def _classify(
+        self, code: int, payload: bytes, from_backend: bool, dead: bool
+    ) -> str:
+        """One forward outcome's routing class: ``dead`` (transport
+        death or unstamped gateway code — failover evidence),
+        ``draining`` (backend-stamped graceful shutdown — route around,
+        no failure accounting), or ``good`` (any backend-stamped
+        response, including its own 429/504 verdicts)."""
+        if dead or (code in (502, 503, 504) and not from_backend):
+            return "dead"
+        if code == 503 and from_backend and self._is_draining(payload):
+            return "draining"
+        return "good"
+
+    def _log_route(
+        self,
+        url: str,
+        route_path: str,
+        code: int,
+        hint: Optional[Tuple[int, int, float]],
+        ms: float,
+        retried: bool,
+        hedge: bool,
+    ) -> None:
+        self._logger.event(
+            {
+                "event": "route",
+                "backend": url,
+                "path": route_path,
+                "code": code,
+                "m": hint[0] if hint else None,
+                "n": hint[1] if hint else None,
+                "tol": hint[2] if hint else None,
+                "ms": round(ms, 3),
+                "retried": retried,
+                "hedge": hedge,
+            }
+        )
+
     # -- routing ---------------------------------------------------------
 
     @staticmethod
@@ -832,13 +1187,16 @@ class Router:
 
     def _forward_once(
         self, url: str, path: str, body: bytes, content_type: str,
-        method: str,
+        method: str, headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, bytes, bool]:
         """(code, body, from_backend) for one forward attempt."""
+        hdrs = {"Content-Type": content_type} if body else {}
+        if headers:
+            hdrs.update(headers)
         req = urllib.request.Request(
             url + path,
             data=body if method == "POST" else None,
-            headers={"Content-Type": content_type} if body else {},
+            headers=hdrs,
             method=method,
         )
         try:
@@ -855,15 +1213,26 @@ class Router:
     def forward(
         self, path: str, body: bytes, content_type: str, method: str = "POST"
     ) -> Tuple[int, bytes, Optional[str]]:
-        """Route + forward one request with retry-once failover. Returns
-        (code, body, backend) — backend None means no backend was
-        routable (the 503 path). Transport errors and gateway-class
-        responses (502/503/504 WITHOUT the backend's plane header) from
-        the first backend eject it and retry exactly once elsewhere.
-        A backend-stamped 504/503 — the solver's own TIMEOUT verdict or
-        a graceful shutdown — is a normal response: it passes through
-        without ejecting the (healthy) backend or duplicating the solve
-        on a second one."""
+        """Route + forward one request with retry-once failover and,
+        for solves, adaptive hedging. Returns (code, body, backend) —
+        backend None means no backend was routable (the 503 path).
+        Transport errors and gateway-class responses (502/503/504
+        WITHOUT the backend's plane header) from the first backend
+        eject it and retry exactly once elsewhere. A backend-stamped
+        504/503 — the solver's own TIMEOUT verdict or a graceful
+        shutdown — is a normal response: it passes through without
+        ejecting the (healthy) backend or duplicating the solve on a
+        second one.
+
+        Tail tolerance: a solve whose primary stays silent past the
+        adaptive hedge delay (the backend's recent p95, once its digest
+        is warm) launches ONE hedge to the next-best backend; the first
+        good response wins, and the losing 202 is cancelled at its
+        backend (journal fingerprint dedup makes the duplicate admit
+        safe regardless). Every attempt — first, retry, or hedge —
+        re-stamps the REMAINING deadline budget so spent budget never
+        resurrects downstream."""
+        route_path = urlsplit(path).path
         hint = (
             protocol.peek_route_hint(
                 body, content_type, urlsplit(path).query
@@ -871,48 +1240,68 @@ class Router:
             if method == "POST"
             else None
         )
-        route_path = urlsplit(path).path
+        is_solve = method == "POST" and route_path == "/v1/solve"
+        deadline_ms: Optional[float] = None
+        tenant = "default"
+        if is_solve:
+            deadline_ms, tenant = protocol.peek_deadline_tenant(
+                body, content_type, urlsplit(path).query
+            )
+            with self._lock:
+                self._forwards_total += 1
+        t_start = time.perf_counter()
+        code, payload, url = 503, b"", None
         tried: Tuple[str, ...] = ()
         for attempt in range(2):
             url, is_trial = self._pick_attributed(hint, exclude=tried)
             if url is None:
                 return 503, b"", None
-            t0 = time.perf_counter()
-            try:
-                code, payload, from_backend = self._forward_once(
-                    url, path, body, content_type, method
-                )
-                transport_dead = False
-            except (urllib.error.URLError, socket.timeout, OSError):
-                code, payload, from_backend = 502, b"", False
-                transport_dead = True
-            finally:
-                self._release(url)
-            self._logger.event(
-                {
-                    "event": "route",
-                    "backend": url,
-                    "path": route_path,
-                    "code": code,
-                    "m": hint[0] if hint else None,
-                    "n": hint[1] if hint else None,
-                    "tol": hint[2] if hint else None,
-                    "ms": round((time.perf_counter() - t0) * 1e3, 3),
-                    "retried": attempt > 0,
-                }
+            delay_s = (
+                self._hedge_delay_s(url)
+                if is_solve and attempt == 0
+                else None
             )
-            if transport_dead or (
-                code in (502, 503, 504) and not from_backend
-            ):
+            if delay_s is not None:
+                done = self._forward_hedged(
+                    url, is_trial, path, body, content_type, method,
+                    hint, route_path, deadline_ms, tenant, t_start,
+                    delay_s,
+                )
+                if done is not None:
+                    return done
+                # The primary failed with no hedge launched: fall back
+                # to the classic retry-once path on a sibling.
+                self._spend_retry_budget(tenant, "retry")
+                tried = (url,)
+                with self._lock:
+                    self._failovers += 1
+                self._m_failovers.inc()
+                continue
+            spath, sbody, sheaders = self._stamped_request(
+                path, body, content_type, method, deadline_ms, t_start
+            )
+            code, payload, from_backend, dead, ms = self._attempt_result(
+                url, spath, sbody, content_type, method, sheaders
+            )
+            self._log_route(
+                url, route_path, code, hint, ms, attempt > 0, False
+            )
+            cls = self._classify(code, payload, from_backend, dead)
+            if cls == "dead":
                 self._record_forward_outcome(url, False, trial=is_trial)
                 self._note_forward_failure(url)
                 if attempt == 0:
+                    # Retries always proceed (retry-once is the plane's
+                    # no-lost-acks mechanism) but drain the tenant's
+                    # budget, so under a retry storm the speculative
+                    # hedges are what stop first.
+                    self._spend_retry_budget(tenant, "retry")
                     tried = (url,)
                     with self._lock:
                         self._failovers += 1
                     self._m_failovers.inc()
                     continue
-            elif code == 503 and from_backend and self._is_draining(payload):
+            elif cls == "draining":
                 # The backend is gracefully shutting down: alive (no
                 # eject, no failure accounting) but done taking work —
                 # stop routing to it and retry this one request on a
@@ -920,6 +1309,7 @@ class Router:
                 # through as the backend's own verdict.
                 self._note_draining(url, trial=is_trial)
                 if attempt == 0:
+                    self._spend_retry_budget(tenant, "retry")
                     tried = (url,)
                     with self._lock:
                         self._failovers += 1
@@ -930,8 +1320,151 @@ class Router:
                 # and TIMEOUT verdicts — proves the backend serves; it
                 # counts FOR the breaker window, not against it.
                 self._record_forward_outcome(url, True, trial=is_trial)
+                if from_backend:
+                    self._observe_latency(url, ms)
             return code, payload, url
         return code, payload, url  # second attempt's outcome, whatever it was
+
+    def _forward_hedged(
+        self,
+        primary: str,
+        primary_trial: bool,
+        path: str,
+        body: bytes,
+        content_type: str,
+        method: str,
+        hint: Optional[Tuple[int, int, float]],
+        route_path: str,
+        deadline_ms: Optional[float],
+        tenant: str,
+        t_start: float,
+        delay_s: float,
+    ) -> Optional[Tuple[int, bytes, Optional[str]]]:
+        """The hedge-eligible leg of forward(): run the already-picked
+        primary on a worker thread; if it stays silent past ``delay_s``,
+        launch one hedge to the next-best backend and let the first
+        good response win. Returns the winner's (code, body, backend);
+        the primary's failure when every launched leg failed AND a
+        hedge ran (the hedge consumed the retry); or None when the
+        primary failed with no hedge launched — the caller falls back
+        to the classic retry-once path.
+
+        Runner threads do ALL their own leg bookkeeping (breaker
+        outcome, failure/draining notes, latency observe, route log,
+        loser cancel) so this method answers the client the moment a
+        winner exists — it never joins a leg stalled on a straggler."""
+        results: "queue_mod.Queue" = queue_mod.Queue()
+        state = {"winner": None}
+        state_lock = threading.Lock()
+
+        def run_leg(url: str, is_trial: bool, leg: str) -> None:
+            spath, sbody, sheaders = self._stamped_request(
+                path, body, content_type, method, deadline_ms, t_start
+            )
+            code, payload, from_backend, dead, ms = self._attempt_result(
+                url, spath, sbody, content_type, method, sheaders
+            )
+            cls = self._classify(code, payload, from_backend, dead)
+            if cls == "dead":
+                self._record_forward_outcome(url, False, trial=is_trial)
+                self._note_forward_failure(url)
+            elif cls == "draining":
+                self._note_draining(url, trial=is_trial)
+            else:
+                self._record_forward_outcome(url, True, trial=is_trial)
+                if from_backend:
+                    self._observe_latency(url, ms)
+            self._log_route(
+                url, route_path, code, hint, ms, False, leg == "hedge"
+            )
+            # A hedge leg's 429 never wins: admission/brownout said no,
+            # and answering the client 429 while the primary may still
+            # succeed would turn a speculative probe into a shed.
+            eligible = cls == "good" and not (
+                leg == "hedge" and code == 429
+            )
+            with state_lock:
+                lost_to = state["winner"]
+                won = eligible and lost_to is None
+                if won:
+                    state["winner"] = leg
+            if not won and lost_to is not None and cls == "good" and (
+                code == 202
+            ):
+                # This leg queued work the client will never poll:
+                # cancel the duplicate so its admission units release
+                # without waiting for fingerprint dedup or a solve.
+                self._cancel_loser(url, payload, tenant)
+            results.put(
+                {
+                    "leg": leg,
+                    "code": code,
+                    "payload": payload,
+                    "url": url,
+                    "won": won,
+                }
+            )
+
+        threading.Thread(
+            target=run_leg,
+            args=(primary, primary_trial, "primary"),
+            daemon=True,
+            name="dlps-fwd-primary",
+        ).start()
+        legs = 1
+        hedged = False
+        hedge_url: Optional[str] = None
+        got: List[dict] = []
+        try:
+            got.append(results.get(timeout=delay_s))
+        except queue_mod.Empty:
+            hedge_url, hedge_trial = self._hedge_pick(
+                hint, (primary,), tenant
+            )
+            if hedge_url is not None:
+                hedged = True
+                legs = 2
+                self._m_hedge_delay.observe(delay_s * 1e3)
+                threading.Thread(
+                    target=run_leg,
+                    args=(hedge_url, hedge_trial, "hedge"),
+                    daemon=True,
+                    name="dlps-fwd-hedge",
+                ).start()
+        # Each leg's urlopen is bounded by forward_timeout_s, so these
+        # gets terminate even when a leg is SIGSTOPped mid-response.
+        while not any(r["won"] for r in got) and len(got) < legs:
+            got.append(results.get())
+        winner = next((r for r in got if r["won"]), None)
+        if hedged:
+            outcome = (
+                "both_failed"
+                if winner is None
+                else (
+                    "hedge_won"
+                    if winner["leg"] == "hedge"
+                    else "primary_won"
+                )
+            )
+            self._count_hedge(outcome)
+            self._logger.event(
+                {
+                    "event": "hedge",
+                    "backend": hedge_url,
+                    "primary": primary,
+                    "delay_ms": round(delay_s * 1e3, 3),
+                    "outcome": outcome,
+                    "tenant": tenant,
+                }
+            )
+        if winner is not None:
+            return winner["code"], winner["payload"], winner["url"]
+        if not hedged:
+            return None  # caller's classic retry takes over
+        # Both legs failed; the hedge consumed the retry. Answer with
+        # the primary's verdict (the hedge was speculative).
+        last = next((r for r in got if r["leg"] == "primary"), got[-1])
+        return last["code"], last["payload"], last["url"]
 
     @staticmethod
     def _is_draining(payload: bytes) -> bool:
@@ -969,6 +1502,17 @@ class Router:
         with self._lock:
             out = {
                 "failovers": self._failovers,
+                # Auditable hedging ledger: probes and tests reconcile
+                # the JSONL hedge/retry_budget events against these
+                # counts to prove the rate cap and budgets were honored.
+                "hedging": {
+                    "forwards_total": self._forwards_total,
+                    "hedges_launched": self._hedges_launched,
+                    "rate_cap": self.config.hedge_rate_cap,
+                    "outcomes": dict(self._hedge_outcomes),
+                    "cancels": self._hedge_cancels,
+                    "budget_exhausted": self._budget_exhausted,
+                },
                 "backends": [
                     {
                         "url": st.url,
@@ -985,6 +1529,16 @@ class Router:
                         "live": st.live,
                         "buckets": [list(b) for b in st.buckets],
                         "forwards": st.forwards,
+                        "latency_ms_p50": (
+                            round(percentile(st.lat_ms, 50), 3)
+                            if st.lat_ms
+                            else None
+                        ),
+                        "latency_ms_p95": (
+                            round(percentile(st.lat_ms, 95), 3)
+                            if st.lat_ms
+                            else None
+                        ),
                         "last_poll_age_s": (
                             round(now - st.last_poll, 3)
                             if st.last_poll
@@ -1088,6 +1642,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         front = self.server.front
         parts = urlsplit(self.path)
         try:
+            if parts.path.startswith("/v1/cancel/"):
+                self._cancel_fanout(front, parts.path)
+                return
             if parts.path != "/v1/solve":
                 self._send_json(404, {"error": f"no such route {parts.path}"})
                 return
@@ -1116,6 +1673,37 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._send(code, payload, "application/json")
         except (BrokenPipeError, ConnectionResetError):
             pass
+
+    def _cancel_fanout(self, front, cancel_path: str) -> None:
+        """Forward ``POST /v1/cancel/{jid}`` to the job's backend: the
+        remembered async backend first, then (job ids are journal-nonce
+        scoped, so the first non-404 answer is authoritative) every
+        other known backend."""
+        rid = cancel_path.rsplit("/", 1)[1]
+        url = front.router.backend_for_async(rid)
+        urls = front.router.all_backend_urls()
+        candidates = (
+            [url] + [u for u in urls if u != url]
+            if url is not None
+            else urls
+        )
+        code, payload = 404, json.dumps(
+            {"id": rid, "cancelled": False, "state": "unknown"}
+        ).encode("utf-8")
+        for u in candidates:
+            try:
+                c, pl, _ = front.router._forward_once(
+                    u, cancel_path, b"", "application/json", "POST"
+                )
+            except (urllib.error.URLError, socket.timeout, OSError):
+                code, payload = 502, json.dumps(
+                    {"error": f"backend {u} unreachable"}
+                ).encode("utf-8")
+                continue
+            if c != 404:
+                code, payload = c, pl
+                break
+        self._send(code, payload, "application/json")
 
     def do_GET(self) -> None:  # noqa: N802
         front = self.server.front

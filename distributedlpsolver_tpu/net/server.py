@@ -13,6 +13,14 @@ Endpoints:
 - ``GET /v1/solve/{id}`` — async poll: 200 done, 202 pending, 404
   unknown/expired (the store is a bounded LRU — collected results
   evict oldest-first past ``async_results_cap``).
+- ``POST /v1/cancel/{jid}`` — cancel queued-but-not-dispatched work
+  (the router's hedge-loser path): 200 cancelled, 409 dispatched or
+  already finished (lanes are never torn mid-program), 404 unknown.
+- ``X-DLPS-Deadline-Ms`` on ``POST /v1/solve`` is the propagated
+  remaining budget (router-stamped, decremented per hop/retry/hedge):
+  it upper-bounds the body's own ``deadline_ms``, and expired-on-arrival
+  work is admission-rejected immediately with a structured 504 verdict
+  instead of queueing to die.
 - ``GET /metrics`` — Prometheus text off the obs registry.
 - ``GET /healthz`` — 200/503 from three signals: per-device health
   probes (parallel/runtime.py — the supervisor's own probe, so an
@@ -97,6 +105,11 @@ class NetConfig:
     drain_linger_s: float = 2.0
     # http_request JSONL event stream (stamped schema); None = off.
     log_jsonl: Optional[str] = None
+    # Honor the router-stamped X-DLPS-Deadline-Ms remaining-budget
+    # header: bound the request deadline by it and reject
+    # expired-on-arrival work up front. Off = header ignored (the
+    # body's own deadline_ms still applies).
+    deadline_propagation: bool = True
 
 
 class SolveHTTPServer:
@@ -134,6 +147,11 @@ class SolveHTTPServer:
         )
         self._m_http_ms = m.histogram(
             "net_request_ms", help="HTTP request wall time (handler span)"
+        )
+        self._m_deadline_expired = m.counter(
+            "net_deadline_expired_on_arrival_total",
+            help="solve requests whose propagated deadline budget was "
+            "already spent on arrival (rejected before queueing)",
         )
         # Async-store eviction accounting: {state="resolved"} is normal
         # bounded turnover; {state="unresolved"} must stay 0 — a nonzero
@@ -494,6 +512,23 @@ class _Handler(BaseHTTPRequestHandler):
                     },
                 )
                 return
+            if parts.path.startswith("/v1/cancel/"):
+                rid = parts.path.rsplit("/", 1)[1]
+                cancel = getattr(front.service, "cancel", None)
+                if cancel is None:
+                    code = 501
+                    self._send_json(
+                        code, {"error": "cancellation unsupported"}
+                    )
+                    return
+                ok, state = cancel(rid)
+                # 409 = admitted but no longer cancellable (dispatched
+                # work runs to completion; finished work has a verdict).
+                code = 200 if ok else (404 if state == "unknown" else 409)
+                self._send_json(
+                    code, {"id": rid, "cancelled": bool(ok), "state": state}
+                )
+                return
             if parts.path != "/v1/solve":
                 code = 404
                 self._send_json(code, {"error": f"no such route {parts.path}"})
@@ -511,6 +546,53 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(code, {"error": str(e)})
                 return
             tenant = req.tenant
+            hdr = self.headers.get(protocol.DEADLINE_HEADER)
+            if hdr is not None and front.config.deadline_propagation:
+                try:
+                    remaining_s = float(hdr) / 1e3  # graftcheck: disable=host-sync (header parse, no device value)
+                except ValueError:
+                    remaining_s = None  # malformed header: ignore it
+                if remaining_s is not None:
+                    if remaining_s <= 0.0:
+                        # Expired on arrival: a structured verdict NOW
+                        # beats queueing work that can only die. The
+                        # plane header marks this 504 as an
+                        # application verdict, so the router passes it
+                        # through instead of reading it as failover
+                        # evidence (retrying a dead budget elsewhere
+                        # is exactly the amplification to avoid).
+                        code = 504
+                        front._m_deadline_expired.inc()
+                        front._logger.event(
+                            {
+                                "event": "deadline_expired",
+                                "path": parts.path,
+                                "tenant": tenant,
+                                "remaining_ms": round(remaining_s * 1e3, 3),
+                            }
+                        )
+                        self._send_json(
+                            code,
+                            {
+                                # The structured verdict IS a timeout:
+                                # clients see the same status field a
+                                # queued-past-deadline request reports.
+                                "status": "timeout",
+                                "error": "deadline budget expired on "
+                                "arrival",
+                                "reason": "deadline_expired",
+                                "tenant": tenant,
+                            },
+                        )
+                        return
+                    # The propagated budget upper-bounds the client's
+                    # original deadline: a retry/hedge hop must consume
+                    # the REMAINING budget, never resurrect the full one.
+                    req.deadline_s = (
+                        min(req.deadline_s, remaining_s)
+                        if req.deadline_s is not None
+                        else remaining_s
+                    )
             try:
                 fut = front.service.submit(
                     req.problem,

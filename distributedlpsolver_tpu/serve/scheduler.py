@@ -235,6 +235,24 @@ class Scheduler:
             return None
         return max(0.0, t - now)
 
+    def remove(self, jid: str) -> Optional[PendingRequest]:
+        """Remove and return the queued request journaled as ``jid``, or
+        None when no such request is queued (already dispatched, already
+        finished, or never admitted here). Cancellation's queue half:
+        only QUEUED work is removable — once a request is popped into a
+        dispatch its lane runs to completion, so the cancel path refuses
+        it rather than tearing a compiled batch mid-program."""
+        if not jid:
+            return None
+        for q in self._queues.values():
+            for p in q:
+                if p.jid == jid:
+                    q.remove(p)
+                    self._depth -= 1
+                    self._m_depth.set(self._depth)
+                    return p
+        return None
+
     def drain_pending(self) -> List[PendingRequest]:
         """Remove and return every queued request (submit order within
         each queue) — the ladder-swap epoch boundary: pending requests

@@ -807,6 +807,71 @@ class SolveService:
             return ("pending", None)
         return ("unknown", None)
 
+    def cancel(self, jid: str) -> tuple:
+        """Cancel the queued-but-not-dispatched job ``jid`` — the hedge
+        loser's path (``POST /v1/cancel/{jid}``). Returns
+        ``(cancelled, state)`` where state is one of ``"cancelled"``
+        (removed from the queue and resolved through the normal finish
+        funnel: admission units released, journal stamped ``cancelled``,
+        future resolved with the CANCELLED verdict), ``"dispatched"``
+        (already riding a compiled batch — lanes are never torn
+        mid-program, the solve runs to completion), ``"finished"``
+        (verdict already durable), or ``"unknown"``."""
+        if not jid:
+            return False, "unknown"
+        with self._wake:
+            p = self.scheduler.remove(jid)
+            fut = None if p is not None else self._jobs.get(jid)
+        if p is None:
+            # Journal reads happen outside the service lock (the result
+            # store is disk-backed).
+            if fut is not None and not fut.done():
+                return False, "dispatched"
+            if self._journal is not None:
+                if self._journal.result(jid) is not None:
+                    return False, "finished"
+                if self._journal.is_pending(jid):
+                    return False, "dispatched"
+            return False, "unknown"
+        now = time.perf_counter()
+        waited_ms = (now - p.t_submit) * 1e3
+        self._finish(
+            p,
+            RequestResult(
+                request_id=p.request_id,
+                name=p.name,
+                status=Status.CANCELLED,
+                objective=float("nan"),
+                x=None,
+                iterations=0,
+                rel_gap=_INF,
+                pinf=_INF,
+                dinf=_INF,
+                bucket=None,
+                queue_ms=waited_ms,
+                compile_ms=0.0,
+                solve_ms=0.0,
+                total_ms=waited_ms,
+                padding_waste=0.0,
+                m=p.m,
+                n=p.n,
+                t_submit=p.t_submit,
+                t_done=now,
+            ),
+        )
+        self._logger.event(
+            {
+                "event": "cancel",
+                "jid": jid,
+                "id": p.request_id,
+                "name": p.name,
+                "tenant": p.tenant,
+                "state": "cancelled",
+                "queue_ms": round(waited_ms, 3),
+            }
+        )
+        return True, "cancelled"
+
     # -- submission ------------------------------------------------------
 
     def submit(

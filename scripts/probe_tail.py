@@ -1,0 +1,516 @@
+"""Tail-tolerance acceptance probe: adaptive hedging, deadline
+propagation and cancellation over a LIVE 3-backend plane (README "Tail
+tolerance").
+
+Legs:
+
+  warm      → sync solves through the router until every backend's
+              latency digest is warm (statusz ``forwards`` >=
+              ``hedge_min_samples``);
+              records the healthy latency distribution;
+  straggler → SIGSTOP one backend, then burst a mixed sync/async wave:
+              requests routed to the frozen backend must HEDGE to a
+              sibling (the retry path never fires — the primary is
+              silent, not dead), and the wave's p99 must stay within
+              3x the healthy p99; the frozen backend is then thawed
+              and its losing 202s are cancelled best-effort;
+  slowloris → drip never-completing request headers into the router
+              while live traffic flows — the threaded plane must keep
+              answering within the same 3x bound;
+  budget    → a second router with a ZERO retry budget: forced hedge
+              attempts (cold-bucket solves slower than the hedge
+              delay) must be suppressed with attributed
+              retry_budget events, never launched;
+  deadline  → a solve whose deadline budget is already spent when the
+              router stamps it must come back as the backend's
+              structured expired-on-arrival timeout verdict;
+  audit     → zero lost acks (every 202 resolves), zero duplicate
+              solves in any journal WAL, zero warm recompiles at
+              steady state, and the router's JSONL hedge/cancel/
+              retry_budget events RECONCILE with its /statusz hedging
+              ledger (cap and budget provably honored).
+
+Run: python scripts/probe_tail.py [--tail-requests N] [--budget-s S]
+Exit 0 iff every check passes.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedlpsolver_tpu.net.chaos import (  # noqa: E402
+    ChaosPlane,
+    SlowLoris,
+    journal_duplicate_solves,
+)
+from distributedlpsolver_tpu.net.router import RouterConfig  # noqa: E402
+from distributedlpsolver_tpu.obs.stats import percentile  # noqa: E402
+
+SHAPE = (96, 288)
+# Cold shape for the budget leg: on the auto pow2 ladder it opens a
+# bucket the warm shape's did not, so its first solve compiles — and a
+# compile stall is reliably longer than the hedge delay, the
+# deterministic way to force a hedge ATTEMPT against a healthy backend.
+COLD_SHAPE = (160, 480)
+
+
+def http_json(url, body=None, timeout=60.0, headers=None):
+    req = urllib.request.Request(
+        url,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={
+            **({"Content-Type": "application/json"} if body else {}),
+            **(headers or {}),
+        },
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:
+            return e.code, {}
+    except (urllib.error.URLError, OSError, ConnectionError, ValueError) as e:
+        return 599, {"error": f"{type(e).__name__}: {e}"}
+
+
+def jsonl_events(path):
+    out = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tail-requests", type=int, default=20)
+    ap.add_argument(
+        "--budget-s", type=float, default=0.0,
+        help="fail if the whole probe exceeds this wall time (0 = none)",
+    )
+    ap.add_argument("--keep-workdir", action="store_true")
+    args = ap.parse_args()
+    t_probe = time.perf_counter()
+
+    workdir = tempfile.mkdtemp(prefix="dlps-tail-")
+    plane = ChaosPlane(workdir)
+    registry_path = os.path.join(workdir, "registry.json")
+    route_log = os.path.join(workdir, "router.jsonl")
+    route2_log = os.path.join(workdir, "router2.jsonl")
+    buckets_json = os.path.join(workdir, "ladder.json")
+    with open(buckets_json, "w") as fh:
+        fh.write(json.dumps([{"m": SHAPE[0], "n": SHAPE[1], "batch": 4}]))
+
+    ok = True
+
+    def fail(msg):
+        nonlocal ok
+        print(f"FAIL: {msg}")
+        ok = False
+
+    # -- plane: 3 warm backends + the hedging router ---------------------
+    names = ["backend-a", "backend-b", "backend-c"]
+    for name in names:
+        plane.spawn_backend(
+            name,
+            buckets_json=buckets_json,
+            extra_flags=["--flush-ms", "20", "--batch", "4"],
+        )
+    for name in names:
+        if not plane.wait_ready(plane.procs[name], 180):
+            fail(f"{name} did not come up")
+            plane.shutdown_all()
+            print("FAIL")
+            return 1
+    router = plane.spawn_router(
+        "router-1",
+        [plane.procs[n].url for n in names],
+        registry_path,
+        extra_flags=[
+            # Loose cap for the scenario (the honoring proof is the
+            # ledger arithmetic, not the specific value): every
+            # straggler-bound request must be ABLE to hedge, or it
+            # blocks on the frozen socket for the full forward timeout.
+            "--hedge-rate-cap", "0.5",
+            "--retry-budget", "50", "--retry-budget-burst", "50",
+            "--log-jsonl", route_log,
+        ],
+    )
+    if not plane.wait_ready(router, 60):
+        fail("router did not come up")
+        plane.shutdown_all()
+        print("FAIL")
+        return 1
+    print(f"plane up: 3 backends behind {router.url}")
+
+    def statusz(url=None):
+        c, o = http_json((url or router.url) + "/statusz", timeout=5.0)
+        return o if c == 200 else {}
+
+    def wave(n, tenant, url=None, make=None, conc=4, timeout=90.0):
+        """n paced sync solves; returns (latencies_ms, responses)."""
+        lats, resp = [], []
+        lock = threading.Lock()
+
+        def one(k):
+            body = (make or (lambda i: {
+                "m": SHAPE[0], "n": SHAPE[1], "seed": i,
+                "tenant": tenant, "id": f"{tenant}-{i}",
+            }))(k)
+            t0 = time.perf_counter()
+            code, out = http_json(
+                (url or router.url) + "/v1/solve", body, timeout=timeout
+            )
+            with lock:
+                lats.append((time.perf_counter() - t0) * 1e3)
+                resp.append((code, out))
+
+        ts = []
+        for k in range(n):
+            t = threading.Thread(target=one, args=(k,), daemon=True)
+            t.start()
+            ts.append(t)
+            if len(ts) % conc == 0:
+                time.sleep(0.05)
+        for t in ts:
+            t.join(timeout=timeout + 30)
+        return lats, resp
+
+    # -- warm leg: build every backend's latency digest ------------------
+    healthy_lats = []
+    sent = 0
+    while sent < 120:
+        lats, resp = wave(6, "warm", make=lambda i, base=sent: {
+            "m": SHAPE[0], "n": SHAPE[1], "seed": base + i,
+            "tenant": "warm", "id": f"warm-{base + i}",
+        })
+        healthy_lats.extend(lats)
+        sent += 6
+        bad = [(c, o) for c, o in resp if c != 200]
+        if bad:
+            fail(f"warm request failed: {bad[:3]}")
+            break
+        # Exit as soon as every digest can drive a hedge delay
+        # (hedge_min_samples): each extra wave is ~4-5 s of 1-core wall.
+        fwd = [b.get("forwards", 0) for b in statusz().get("backends", [])]
+        if fwd and min(fwd) >= RouterConfig().hedge_min_samples:
+            break
+    p99_healthy = percentile(healthy_lats, 99)
+    s = statusz()
+    digests = {
+        b["url"]: (b.get("latency_ms_p50"), b.get("latency_ms_p95"))
+        for b in s.get("backends", [])
+    }
+    print(
+        f"warm: {sent} solves, healthy p50={percentile(healthy_lats, 50):.0f}"
+        f"ms p99={p99_healthy:.0f}ms; digests={digests}"
+    )
+    if any(p95 is None for _, p95 in digests.values()):
+        fail(f"a backend digest never warmed: {digests}")
+
+    # -- straggler leg: SIGSTOP one backend, hedge around it -------------
+    victim = "backend-c"
+    plane.sigstop(victim)
+    print(f"[straggler] SIGSTOP {victim}")
+    n_tail = max(12, args.tail_requests)
+
+    def tail_body(i):
+        body = {
+            "m": SHAPE[0], "n": SHAPE[1], "seed": 10_000 + i,
+            "tenant": "tail", "id": f"tail-{i}",
+        }
+        if i % 3 == 0:
+            body["async"] = True
+        return body
+
+    tail_lats, tail_resp = wave(n_tail, "tail", make=tail_body)
+    p99_tail = percentile(tail_lats, 99)
+    print(
+        f"[straggler] {len(tail_lats)}/{n_tail} responses, "
+        f"p50={percentile(tail_lats, 50):.0f}ms p99={p99_tail:.0f}ms "
+        f"(bound {3 * p99_healthy:.0f}ms)"
+    )
+    if len(tail_lats) != n_tail:
+        fail(f"straggler leg lost responses: {len(tail_lats)}/{n_tail}")
+    if p99_tail > 3 * p99_healthy:
+        fail(
+            f"hedged p99 {p99_tail:.0f}ms exceeds 3x healthy "
+            f"p99 {p99_healthy:.0f}ms"
+        )
+    acks = []
+    for code, out in tail_resp:
+        if code == 202 and out.get("id"):
+            acks.append(out["id"])
+        elif not (code == 200 and out.get("status") == "optimal"):
+            fail(f"straggler-leg request without honest verdict: "
+                 f"{code} {out}")
+    hedges_after_straggler = sum(
+        (statusz().get("hedging", {}).get("outcomes", {})).values()
+    )
+    if not hedges_after_straggler:
+        fail("no hedge ever launched or suppressed during the straggler leg")
+    plane.sigcont(victim)
+    print(f"[straggler] SIGCONT {victim}; {len(acks)} async acks to resolve")
+
+    # -- zero lost acks: every 202 resolves through the router -----------
+    unresolved = []
+    for rid in acks:
+        verdict = None
+        pdl = time.perf_counter() + 120.0
+        while time.perf_counter() < pdl:
+            c, o = http_json(router.url + f"/v1/solve/{rid}", timeout=30.0)
+            if c in (202, 404, 502, 503, 599):
+                time.sleep(0.1)
+                continue
+            verdict = (c, o.get("status"))
+            break
+        if verdict is None or verdict[1] not in ("optimal", "timeout"):
+            unresolved.append((rid, verdict))
+    if unresolved:
+        fail(f"acknowledged async ids never resolved: {unresolved[:5]}")
+    else:
+        print(f"  zero lost acks: {len(acks)}/{len(acks)} resolved")
+
+    # -- slow-loris leg: drip into the router while traffic flows --------
+    loris = SlowLoris("127.0.0.1", router.port, conns=8, drip_s=0.2).start()
+    time.sleep(0.5)  # let the drips open before measuring
+    loris_lats, loris_resp = wave(12, "loris", make=lambda i: {
+        "m": SHAPE[0], "n": SHAPE[1], "seed": 20_000 + i,
+        "tenant": "loris", "id": f"loris-{i}",
+    })
+    p99_loris = percentile(loris_lats, 99)
+    loris.stop()
+    # A loris-victim forward stalls until the hedge fires, so its best
+    # case is hedge_delay + a healthy solve; the bound composes those
+    # terms (delay at its config clamp) instead of pretending the hedge
+    # is free — on 1-core CPU walls the raw 3x bound sits BELOW the
+    # clamp + one solve and fails on machine speed, not tail behavior.
+    loris_bound = 3 * p99_healthy + RouterConfig().hedge_delay_max_ms
+    print(
+        f"[slowloris] {loris.opened} conns, {loris.dripped} bytes dripped; "
+        f"live p99={p99_loris:.0f}ms (bound {loris_bound:.0f}ms)"
+    )
+    if loris.opened == 0:
+        fail("slow-loris never connected")
+    bad = [
+        (c, o) for c, o in loris_resp
+        if not (c == 200 and o.get("status") == "optimal")
+    ]
+    if bad:
+        fail(f"requests failed under slow-loris: {bad[:3]}")
+    if p99_loris > loris_bound:
+        fail(
+            f"slow-loris p99 {p99_loris:.0f}ms exceeds "
+            f"3x healthy p99 + hedge delay clamp ({loris_bound:.0f}ms)"
+        )
+
+    # -- budget leg: a zero-budget router must suppress, never launch ----
+    # Its own auto-ladder backend (the explicit-ladder trio rejects
+    # off-ladder shapes), so the cold solve's compile stall can force a
+    # hedge attempt that the empty budget must refuse.
+    backend_d = plane.spawn_backend(
+        "backend-d", extra_flags=["--flush-ms", "20", "--batch", "2"]
+    )
+    router2 = plane.spawn_router(
+        "router-2",
+        [backend_d.url],
+        os.path.join(workdir, "registry2.json"),
+        extra_flags=[
+            "--hedge-rate-cap", "1.0",
+            "--retry-budget", "0", "--retry-budget-burst", "0",
+            "--log-jsonl", route2_log,
+        ],
+    )
+    if not plane.wait_ready(backend_d, 120) or not plane.wait_ready(
+        router2, 60
+    ):
+        fail("budget-leg plane did not come up")
+    else:
+        sent2 = 0
+        while sent2 < 60:
+            _, resp = wave(6, "starve", url=router2.url, timeout=180.0,
+                           make=lambda i, base=sent2: {
+                               "m": SHAPE[0], "n": SHAPE[1],
+                               "seed": 30_000 + base + i,
+                               "tenant": "starve",
+                               "id": f"starve-warm-{base + i}"})
+            sent2 += 6
+            if [(c, o) for c, o in resp if c != 200]:
+                break
+            fwd = [
+                b.get("forwards", 0)
+                for b in statusz(router2.url).get("backends", [])
+            ]
+            if fwd and min(fwd) >= 10:
+                break
+        # Cold-bucket solve: the compile stall outlasts the hedge
+        # delay, so a hedge is ATTEMPTED — and must be suppressed.
+        c, o = http_json(
+            router2.url + "/v1/solve",
+            {"m": COLD_SHAPE[0], "n": COLD_SHAPE[1], "seed": 40_000,
+             "tenant": "starve", "id": "starve-cold-0"},
+            timeout=300.0,
+        )
+        if not (c == 200 and o.get("status") == "optimal"):
+            fail(f"budget-leg cold solve failed: {c} {o}")
+        h2 = statusz(router2.url).get("hedging", {})
+        print(
+            f"[budget] zero-budget router: launched="
+            f"{h2.get('hedges_launched')} exhausted="
+            f"{h2.get('budget_exhausted')} outcomes={h2.get('outcomes')}"
+        )
+        if h2.get("hedges_launched", -1) != 0:
+            fail(
+                f"zero-budget router launched "
+                f"{h2.get('hedges_launched')} hedges"
+            )
+        if not h2.get("budget_exhausted"):
+            fail("zero-budget router never recorded a budget exhaustion")
+        ev2 = jsonl_events(route2_log)
+        n_budget_ev2 = sum(
+            1 for e in ev2 if e.get("event") == "retry_budget"
+        )
+        if n_budget_ev2 != h2.get("budget_exhausted"):
+            fail(
+                f"budget events ({n_budget_ev2}) != statusz "
+                f"budget_exhausted ({h2.get('budget_exhausted')})"
+            )
+
+    # -- deadline leg: spent budget rejects on arrival -------------------
+    c, o = http_json(
+        router.url + "/v1/solve",
+        {"m": SHAPE[0], "n": SHAPE[1], "seed": 50_000, "tenant": "dl",
+         "id": "dl-0", "deadline_ms": 0.01},
+        timeout=30.0,
+    )
+    if not (
+        c == 504
+        and o.get("status") == "timeout"
+        and o.get("reason") == "deadline_expired"
+    ):
+        fail(f"expired deadline not rejected on arrival: {c} {o}")
+    else:
+        print("[deadline] expired-on-arrival rejected with structured "
+              "timeout verdict")
+
+    # -- steady state: zero warm recompiles ------------------------------
+    snaps = {}
+    for name in names:
+        c, o = http_json(plane.procs[name].url + "/statusz", timeout=10.0)
+        if c != 200:
+            fail(f"{name} statusz unreachable at steady state ({c})")
+            continue
+        snaps[name] = int((o.get("stats") or {}).get("programs_compiled", -1))
+    _, resp = wave(6, "verify", make=lambda i: {
+        "m": SHAPE[0], "n": SHAPE[1], "seed": 60_000 + i,
+        "tenant": "verify", "id": f"verify-{i}"})
+    bad = [
+        (c, o) for c, o in resp
+        if not (c == 200 and o.get("status") == "optimal")
+    ]
+    if bad:
+        fail(f"steady-state verify failed: {bad[:3]}")
+    for name, before in snaps.items():
+        c, o = http_json(plane.procs[name].url + "/statusz", timeout=10.0)
+        after = int((o.get("stats") or {}).get("programs_compiled", -2))
+        if after != before:
+            fail(
+                f"{name}: warm recompiles at steady state "
+                f"({before} -> {after} programs)"
+            )
+    print(f"  steady-state programs_compiled: {snaps} (flat)")
+
+    # -- audit: WAL duplicates + ledger reconciliation -------------------
+    for proc in plane.procs.values():
+        if not proc.journal_dir:
+            continue
+        dups = journal_duplicate_solves(proc.journal_dir)
+        if dups:
+            fail(
+                f"{proc.name}: {dups} duplicate finished records in "
+                f"its WAL"
+            )
+    print("  duplicate solves: 0 across all backend journals")
+
+    h = statusz().get("hedging", {})
+    ev = jsonl_events(route_log)
+    ev_hedge = {}
+    for e in ev:
+        if e.get("event") == "hedge":
+            ev_hedge[e.get("outcome")] = ev_hedge.get(e.get("outcome"), 0) + 1
+    n_cancel_ev = sum(1 for e in ev if e.get("event") == "cancel")
+    n_budget_ev = sum(1 for e in ev if e.get("event") == "retry_budget")
+    launched_outcomes = {
+        k: v for k, v in (h.get("outcomes") or {}).items()
+        if not k.startswith("suppressed_")
+    }
+    print(
+        f"  ledger: forwards={h.get('forwards_total')} "
+        f"launched={h.get('hedges_launched')} outcomes={h.get('outcomes')} "
+        f"cancels={h.get('cancels')} events(hedge)={ev_hedge}"
+    )
+    if ev_hedge != launched_outcomes:
+        fail(
+            f"hedge events {ev_hedge} do not reconcile with statusz "
+            f"launched outcomes {launched_outcomes}"
+        )
+    if sum(launched_outcomes.values()) != h.get("hedges_launched"):
+        fail(
+            f"launched outcomes {launched_outcomes} do not sum to "
+            f"hedges_launched {h.get('hedges_launched')}"
+        )
+    if n_cancel_ev != h.get("cancels"):
+        fail(
+            f"cancel events ({n_cancel_ev}) != statusz cancels "
+            f"({h.get('cancels')})"
+        )
+    if n_budget_ev != h.get("budget_exhausted"):
+        fail(
+            f"retry_budget events ({n_budget_ev}) != statusz "
+            f"budget_exhausted ({h.get('budget_exhausted')})"
+        )
+    cap, fwd_total = h.get("rate_cap", 0.0), h.get("forwards_total", 0)
+    if h.get("hedges_launched", 0) > cap * max(1, fwd_total) + 1:
+        fail(
+            f"rate cap violated: {h.get('hedges_launched')} hedges over "
+            f"{fwd_total} forwards at cap {cap}"
+        )
+    if not h.get("hedges_launched"):
+        fail("no hedge was ever launched (the straggler leg proved nothing)")
+
+    plane.shutdown_all()
+    if not args.keep_workdir and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        print(f"workdir kept for post-mortem: {workdir}")
+
+    probe_wall = time.perf_counter() - t_probe
+    if args.budget_s and probe_wall > args.budget_s:
+        fail(f"probe took {probe_wall:.1f}s > budget {args.budget_s:.0f}s")
+    print(f"probe wall: {probe_wall:.1f}s")
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

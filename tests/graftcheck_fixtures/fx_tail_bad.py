@@ -1,0 +1,27 @@
+# Seeds: jsonl-schema x2 — tail-tolerance telemetry written wrong.
+# Checked with pkg_path="net/fx.py": a hedge resolution under a type
+# the event catalogue never heard of (invisible to `cli report` and the
+# probe's ledger reconciliation), and a cancellation carrying an
+# uncatalogued verdict field.
+
+
+def hedge_record(logger, backend, primary):
+    logger.event(
+        {
+            "event": "speculative_retry",  # jsonl-event-type: not catalogued
+            "backend": backend,
+            "primary": primary,
+            "outcome": "hedge_won",
+        }
+    )
+
+
+def cancel_record(logger, backend, jid):
+    logger.event(
+        {
+            "event": "cancel",
+            "backend": backend,
+            "jid": jid,
+            "verdict_state": "cancelled",  # jsonl-fields: not catalogued
+        }
+    )

@@ -1,0 +1,73 @@
+# Compliant twin of fx_tail_bad: the tail-tolerance event family with
+# catalogued fields only — hedge resolutions and route legs as
+# net/router.py emits them, cancellations as the router's loser-cancel
+# and the backend's queue-removal emit them, retry-budget exhaustions
+# and expired-on-arrival deadline rejections as net/router.py and
+# net/server.py emit them.
+
+
+def hedge_records(logger, backend, primary, tenant):
+    logger.event(
+        {
+            "event": "hedge",
+            "backend": backend,
+            "primary": primary,
+            "delay_ms": 84.5,
+            "outcome": "hedge_won",
+            "tenant": tenant,
+        }
+    )
+    logger.event(
+        {
+            "event": "route",
+            "backend": backend,
+            "path": "/v1/solve",
+            "code": 202,
+            "ms": 12.25,
+            "retried": False,
+            "hedge": True,
+        }
+    )
+
+
+def cancel_records(logger, backend, jid, tenant):
+    logger.event(
+        {
+            "event": "cancel",
+            "backend": backend,
+            "jid": jid,
+            "tenant": tenant,
+            "code": 200,
+            "state": "cancelled",
+        }
+    )
+    logger.event(
+        {
+            "event": "cancel",
+            "jid": jid,
+            "id": 7,
+            "name": "tail-7",
+            "tenant": tenant,
+            "state": "cancelled",
+            "queue_ms": 18.75,
+        }
+    )
+
+
+def budget_and_deadline_records(logger, tenant):
+    logger.event(
+        {
+            "event": "retry_budget",
+            "tenant": tenant,
+            "kind": "hedge",
+            "reason": "exhausted",
+        }
+    )
+    logger.event(
+        {
+            "event": "deadline_expired",
+            "path": "/v1/solve",
+            "tenant": tenant,
+            "remaining_ms": 0.0,
+        }
+    )

@@ -685,14 +685,17 @@ def test_scale_in_under_load_resolves_outstanding_async_polls(tmp_path):
         plane.shutdown_all()
 
 
-# -- tier-1 smoke: the chaos-elasticity acceptance run -----------------------
+# -- slow-tier smoke: the chaos-elasticity acceptance run --------------------
 
 
+@pytest.mark.slow
 def test_probe_elastic_serve_smoke():
     """CI satellite: the chaos-elasticity acceptance probe — a load
     ramp over a live plane (router + controller-owned pool), one pool
     member SIGKILLed mid-scale, brownout engage/release, scale back in
-    via drain — runs on every tier-1 pass under a wall budget,
+    via drain — runs under a wall budget (slow tier: the ramp +
+    compile-heavy pool respawns cost ~2 min of 1-core wall; the
+    controller/ladder/breaker/drain tests above stay in tier-1),
     asserting zero lost acks, zero duplicate solves, and zero warm
     recompiles at steady state."""
     proc = subprocess.run(

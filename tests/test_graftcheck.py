@@ -197,6 +197,22 @@ class TestRuleFamilies:
         rules, _ = _rules_hit("fx_elastic_clean.py", "serve/fx.py")
         assert rules == []
 
+    def test_tail_catches_seeded(self):
+        # Tail tolerance: a hedge resolution under an uncatalogued
+        # event type and a cancellation carrying an uncatalogued field.
+        rules, findings = _rules_hit("fx_tail_bad.py", "net/fx.py")
+        assert rules == ["jsonl-fields"]
+        assert sum(f.rule == "jsonl-fields" for f in findings) == 2
+        msgs = " | ".join(f.message for f in findings)
+        assert "speculative_retry" in msgs
+        assert "verdict_state" in msgs
+
+    def test_tail_clean_twin_silent(self):
+        # hedge/route(hedge leg)/cancel/retry_budget/deadline_expired
+        # with catalogued fields only: silent.
+        rules, _ = _rules_hit("fx_tail_clean.py", "net/fx.py")
+        assert rules == []
+
     def test_spmd_family_catches_seeded(self):
         # graftcheck v2: rank-gated collective, early rank exit, rank
         # fact through a call argument, rank-filtered comprehension,

@@ -282,6 +282,16 @@ class TestInexactIPM:
         rep = be.cg_report()
         assert rep["cg_iters"] > 0
         assert rep["precond"] in ("jacobi", "block", "bordered")
+        # Tier-1 stand-in for the (slow-tier) 20k acceptance's memory
+        # guard: no device operand may be normal-matrix shaped — the
+        # matrix-free contract is scale-independent even when the full
+        # ≥20k-row run is not budget-feasible on 1-core CI.
+        m = problem.A.shape[0]
+        for name, info in be.memory_report().items():
+            shp = info["shape"]
+            assert not (
+                len(shp) >= 2 and min(shp[-2:]) >= m
+            ), (name, info)
 
     def test_unstructured_endgame_degrades_to_cpu_sparse(self):
         """The honest failure ladder: an unstructured ill-conditioned
@@ -336,11 +346,17 @@ class TestInexactIPM:
         with pytest.raises(ValueError):
             SparseIterativeBackend(precond="nope")
 
+    @pytest.mark.slow
     def test_storm_acceptance_20k_no_normal_matrix(self):
         """The huge-sparse acceptance: a storm-profile instance with
         ≥20k rows at ≤1% density solves to OPTIMAL at 1e-8 through the
         matrix-free backend, and no device operand ever approaches the
-        ADAᵀ footprint (asserted via the backend's memory report)."""
+        ADAᵀ footprint (asserted via the backend's memory report).
+
+        Slow tier: the full-scale run costs ~3 min of 1-core CPU wall
+        (compile-dominated) — tier-1 keeps the same memory-shape guard
+        on the storm_m instance below, and the 870 s tier-1 budget keeps
+        the rest of the suite; run `-m slow` to execute this one."""
         from distributedlpsolver_tpu.backends.base import get_backend
 
         p = storm_sparse_lp(320, 64, 96, 64, seed=1)
@@ -422,7 +438,16 @@ class TestRouting:
         from distributedlpsolver_tpu.models.problem import InteriorForm
 
         m, n = _HUGE_SPARSE_ROWS, 2 * _HUGE_SPARSE_ROWS
-        A = sp.random(m, n, density=2e-4, random_state=0, format="csr")
+        # Direct COO construction: sp.random samples WITHOUT replacement
+        # over the m*n index space (8e8 cells here), which costs minutes
+        # on one core; the router only reads shape/nnz, so sampling with
+        # replacement (duplicates summed by CSR conversion) is equivalent.
+        rng = np.random.RandomState(0)
+        k = int(m * n * 2e-4)
+        A = sp.coo_matrix(
+            (rng.rand(k), (rng.randint(0, m, k), rng.randint(0, n, k))),
+            shape=(m, n),
+        ).tocsr()
         inf = InteriorForm(
             c=np.ones(n), A=A, b=np.ones(m), u=np.full(n, np.inf),
             c0=0.0, orig_n=n, col_kind=np.zeros(n, dtype=np.int8),

@@ -1,0 +1,626 @@
+"""Tail-tolerant serving tests (README "Tail tolerance"): deadline
+propagation and per-attempt re-stamping (a retry/hedge consumes the
+REMAINING budget, never the original), the adaptive hedge policy
+(p95-driven delay, global rate cap, per-tenant retry budgets and their
+suppression/refund paths), the hedged-forward state machine
+(first-good-wins, 429-never-wins, loser cancellation), cancellation
+plumbing end to end (scheduler removal, admission-unit release, journal
+``cancelled`` stamp, HTTP 200/409/404 verdicts), the hedge x
+elasticity interplay over live in-process backends, and the
+probe_tail.py tier-1 smoke (SIGSTOP straggler + slow-loris legs over a
+real 3-backend plane).
+
+All CPU; servers bind ephemeral localhost ports.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributedlpsolver_tpu.ipm import Status
+from distributedlpsolver_tpu.models.generators import random_dense_lp
+from distributedlpsolver_tpu.net import AdmissionConfig, NetConfig, SolveHTTPServer
+from distributedlpsolver_tpu.net import protocol
+from distributedlpsolver_tpu.net.chaos import journal_duplicate_solves
+from distributedlpsolver_tpu.net.router import Router, RouterConfig
+from distributedlpsolver_tpu.obs.metrics import MetricsRegistry
+from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+
+pytestmark = pytest.mark.tail
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _http(url, body=None, timeout=60.0, headers=None, method=None):
+    hdrs = dict(headers or {})
+    if body is not None:
+        hdrs.setdefault("Content-Type", "application/json")
+    req = urllib.request.Request(
+        url,
+        data=None if body is None else json.dumps(body).encode(),
+        headers=hdrs,
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _fake_router(urls, **cfg_kw):
+    """A router over backends that will never be probed: poll loop not
+    started, states forced in-rotation so pick()/forward() run against
+    a monkeypatched ``_forward_once``."""
+    cfg_kw.setdefault("poll_s", 999.0)
+    r = Router(list(urls), RouterConfig(**cfg_kw), metrics=MetricsRegistry())
+    with r._lock:
+        for st in r._backends.values():
+            st.healthy = True
+            st.ready = True
+    return r
+
+
+def _wait(pred, timeout_s=5.0, every_s=0.01):
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if pred():
+            return True
+        time.sleep(every_s)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# protocol: deadline peek + re-stamp
+
+
+def test_peek_deadline_tenant_json_and_query():
+    body = json.dumps(
+        {"m": 8, "n": 24, "deadline_ms": 750.5, "tenant": "acme"}
+    ).encode()
+    assert protocol.peek_deadline_tenant(body, "application/json") == (
+        750.5,
+        "acme",
+    )
+    # Raw-MPS bodies carry the envelope in the query string.
+    dl, tenant = protocol.peek_deadline_tenant(
+        b"NAME x", "text/plain", "deadline_ms=200&tenant=t2"
+    )
+    assert dl == 200.0 and tenant == "t2"
+    # Unbounded request: no deadline, default tenant.
+    assert protocol.peek_deadline_tenant(b"{}", "application/json") == (
+        None,
+        "default",
+    )
+    # Malformed bodies propagate nothing (the backend's parse 400s).
+    assert protocol.peek_deadline_tenant(b"{nope", "application/json") == (
+        None,
+        "default",
+    )
+
+
+def test_restamp_deadline_json_query_and_passthrough():
+    body = json.dumps({"m": 8, "deadline_ms": 5000.0}).encode()
+    new_body, q = protocol.restamp_deadline(body, "application/json", "", 123.4)
+    assert q == ""
+    assert json.loads(new_body)["deadline_ms"] == 123.4
+    # Query-string deadline (raw MPS): body untouched, query rewritten.
+    nb, nq = protocol.restamp_deadline(
+        b"NAME x", "text/plain", "deadline_ms=5000&tenant=t", 50.0
+    )
+    assert nb == b"NAME x" and "deadline_ms=50.000" in nq and "tenant=t" in nq
+    # No deadline anywhere: both pass through unchanged.
+    nb, nq = protocol.restamp_deadline(b'{"m": 8}', "application/json", "", 9.0)
+    assert nb == b'{"m": 8}' and nq == ""
+    # Spent budget clamps at zero, never negative.
+    nb, _ = protocol.restamp_deadline(body, "application/json", "", -5.0)
+    assert json.loads(nb)["deadline_ms"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# router: retry re-stamps the REMAINING budget (regression)
+
+
+def test_retry_restamps_remaining_deadline():
+    """The retried attempt must carry strictly less deadline budget than
+    the first — in the header AND re-stamped into the body — not the
+    client's original (which would resurrect spent budget downstream)."""
+    r = _fake_router(["http://a:1", "http://b:2"], hedge_enabled=False)
+    calls = []
+
+    def fake(url, path, body, content_type, method, headers=None):
+        calls.append((url, dict(headers or {}), body))
+        if len(calls) == 1:
+            time.sleep(0.05)  # burn visible budget before dying
+            raise urllib.error.URLError("first backend dead")
+        return 200, b'{"status": "optimal"}', True
+
+    r._forward_once = fake
+    body = json.dumps({"m": 8, "n": 24, "deadline_ms": 5000.0}).encode()
+    code, _, url = r.forward("/v1/solve", body, "application/json")
+    assert code == 200 and len(calls) == 2
+    assert calls[0][0] != calls[1][0]  # failover landed elsewhere
+    h0 = float(calls[0][1][protocol.DEADLINE_HEADER])
+    h1 = float(calls[1][1][protocol.DEADLINE_HEADER])
+    assert h0 <= 5000.0
+    assert h1 < h0  # the retry consumed, not resurrected
+    d0 = json.loads(calls[0][2])["deadline_ms"]
+    d1 = json.loads(calls[1][2])["deadline_ms"]
+    assert d1 < d0 <= 5000.0
+    assert h1 == pytest.approx(d1, abs=0.01)  # header and body agree
+
+
+# ---------------------------------------------------------------------------
+# router: retry-budget token bucket
+
+
+def test_retry_budget_drains_refills_and_refunds():
+    r = _fake_router(
+        ["http://a:1"],
+        retry_budget_rate=50.0,
+        retry_budget_burst=2.0,
+    )
+    assert r._spend_retry_budget("t", "retry")
+    assert r._spend_retry_budget("t", "retry")
+    assert not r._spend_retry_budget("t", "hedge")  # drained
+    assert r.statusz()["hedging"]["budget_exhausted"] == 1
+    time.sleep(0.06)  # 50/s refill: ~3 tokens, clamped to burst=2
+    assert r._spend_retry_budget("t", "hedge")
+    # Tenants are isolated buckets.
+    assert r._spend_retry_budget("other", "retry")
+
+
+def test_retry_budget_refund_restores_token():
+    r = _fake_router(
+        ["http://a:1"], retry_budget_rate=0.0, retry_budget_burst=1.0
+    )
+    assert r._spend_retry_budget("t", "hedge")
+    assert not r._spend_retry_budget("t", "hedge")  # empty, rate frozen
+    r._refund_retry_token("t")
+    assert r._spend_retry_budget("t", "hedge")
+
+
+# ---------------------------------------------------------------------------
+# router: hedge pick suppression paths
+
+
+def test_hedge_pick_suppressed_by_rate_cap():
+    r = _fake_router(["http://a:1", "http://b:2"], hedge_rate_cap=0.0)
+    assert r._hedge_pick(None, ("http://a:1",), "t") == (None, False)
+    assert r.statusz()["hedging"]["outcomes"] == {"suppressed_cap": 1}
+    assert r.statusz()["hedging"]["hedges_launched"] == 0
+
+
+def test_hedge_pick_suppressed_by_exhausted_budget():
+    r = _fake_router(
+        ["http://a:1", "http://b:2"],
+        hedge_rate_cap=1.0,
+        retry_budget_rate=0.0,
+        retry_budget_burst=0.0,
+    )
+    assert r._hedge_pick(None, ("http://a:1",), "t") == (None, False)
+    st = r.statusz()["hedging"]
+    assert st["outcomes"] == {"suppressed_budget": 1}
+    assert st["budget_exhausted"] == 1
+
+
+def test_hedge_pick_no_second_backend_refunds_token():
+    r = _fake_router(
+        ["http://a:1", "http://b:2"],
+        hedge_rate_cap=1.0,
+        retry_budget_rate=0.0,
+        retry_budget_burst=1.0,
+    )
+    # Every sibling excluded: suppressed, and the spent token refunded.
+    assert r._hedge_pick(None, ("http://a:1", "http://b:2"), "t") == (
+        None,
+        False,
+    )
+    assert r.statusz()["hedging"]["outcomes"] == {"suppressed_no_backend": 1}
+    assert r._spend_retry_budget("t", "hedge")  # token survived (refund)
+
+
+def test_hedge_pick_funded_picks_sibling():
+    r = _fake_router(["http://a:1", "http://b:2"], hedge_rate_cap=1.0)
+    url, is_trial = r._hedge_pick(None, ("http://a:1",), "t")
+    assert url == "http://b:2" and not is_trial
+    assert r.statusz()["hedging"]["hedges_launched"] == 1
+
+
+# ---------------------------------------------------------------------------
+# router: adaptive hedge delay
+
+
+def test_hedge_delay_needs_warm_digest_and_clamps():
+    r = _fake_router(["http://a:1", "http://b:2"], hedge_min_samples=8)
+    assert r._hedge_delay_s("http://a:1") is None  # under-sampled
+    for _ in range(8):
+        r._observe_latency("http://a:1", 1.0)
+    d = r._hedge_delay_s("http://a:1")
+    # p95=1ms clamps up to the 50ms floor; jitter spans 0.75x..1.25x.
+    assert 0.75 * 0.050 <= d <= 1.25 * 0.050
+    for _ in range(64):
+        r._observe_latency("http://b:2", 60_000.0)
+    d2 = r._hedge_delay_s("http://b:2")
+    assert 0.75 * 2.0 <= d2 <= 2.0  # ceiling clamp
+
+
+def test_hedge_delay_disabled_and_latency_window_bounded():
+    r = _fake_router(["http://a:1"], hedge_enabled=False, latency_window=16)
+    for _ in range(40):
+        r._observe_latency("http://a:1", 5.0)
+    assert r._hedge_delay_s("http://a:1") is None
+    with r._lock:
+        assert len(r._backends["http://a:1"].lat_ms) == 16
+
+
+# ---------------------------------------------------------------------------
+# router: the hedged-forward state machine (fake backends)
+
+
+def _hedged(r, body, delay_s=0.05, tenant="t"):
+    return r._forward_hedged(
+        "http://a:1",
+        False,
+        "/v1/solve",
+        body,
+        "application/json",
+        "POST",
+        None,
+        "/v1/solve",
+        None,
+        tenant,
+        time.perf_counter(),
+        delay_s,
+    )
+
+
+def test_hedge_first_good_wins_and_cancels_loser(tmp_path):
+    log = tmp_path / "router.jsonl"
+    r = _fake_router(
+        ["http://a:1", "http://b:2"],
+        hedge_rate_cap=1.0,
+        log_jsonl=str(log),
+    )
+    cancels = []
+
+    def fake(url, path, body, content_type, method, headers=None):
+        if path.startswith("/v1/cancel/"):
+            cancels.append((url, path))
+            return 200, b'{"cancelled": true, "state": "cancelled"}', True
+        if url == "http://a:1":  # straggling primary, eventually ACKs
+            time.sleep(0.5)
+            return 202, b'{"id": "ja"}', True
+        return 202, b'{"id": "jb"}', True
+
+    r._forward_once = fake
+    done = _hedged(r, b'{"m": 8, "n": 24, "async": true}')
+    assert done == (202, b'{"id": "jb"}', "http://b:2")  # hedge won
+    # The loser resolves on its own thread: its queued ACK is cancelled.
+    assert _wait(lambda: cancels == [("http://a:1", "/v1/cancel/ja")])
+    st = r.statusz()["hedging"]
+    assert st["hedges_launched"] == 1
+    assert st["outcomes"] == {"hedge_won": 1}
+    assert _wait(lambda: r.statusz()["hedging"]["cancels"] == 1)
+    events = [json.loads(ln) for ln in log.read_text().splitlines()]
+    hedge = [e for e in events if e.get("event") == "hedge"]
+    cancel = [e for e in events if e.get("event") == "cancel"]
+    assert hedge and hedge[0]["outcome"] == "hedge_won"
+    assert hedge[0]["backend"] == "http://b:2"
+    assert hedge[0]["primary"] == "http://a:1"
+    assert cancel and cancel[0]["jid"] == "ja"
+    assert cancel[0]["state"] == "cancelled"
+
+
+def test_hedge_429_never_wins_primary_carries():
+    """A hedge leg's stamped 429 is admission saying no — answering the
+    client with it while the primary may still succeed would turn a
+    speculative probe into a shed."""
+    r = _fake_router(["http://a:1", "http://b:2"], hedge_rate_cap=1.0)
+
+    def fake(url, path, body, content_type, method, headers=None):
+        if url == "http://a:1":
+            time.sleep(0.25)
+            return 200, b'{"status": "optimal"}', True
+        return 429, b'{"reason": "quota"}', True
+
+    r._forward_once = fake
+    done = _hedged(r, b'{"m": 8, "n": 24}')
+    assert done == (200, b'{"status": "optimal"}', "http://a:1")
+    assert _wait(
+        lambda: r.statusz()["hedging"]["outcomes"] == {"primary_won": 1}
+    )
+
+
+def test_hedge_both_failed_consumes_retry():
+    """Both legs dead: the hedge WAS the retry — forward() must not run
+    a third attempt, and the primary's verdict answers the client."""
+    r = _fake_router(["http://a:1", "http://b:2"], hedge_rate_cap=1.0)
+
+    def fake(url, path, body, content_type, method, headers=None):
+        if url == "http://a:1":
+            time.sleep(0.15)
+        raise urllib.error.URLError("dead")
+
+    r._forward_once = fake
+    done = _hedged(r, b'{"m": 8, "n": 24}')
+    assert done is not None and done[0] == 502
+    assert done[2] == "http://a:1"  # the primary's verdict, not the hedge's
+    assert r.statusz()["hedging"]["outcomes"] == {"both_failed": 1}
+
+
+def test_hedge_suppressed_primary_failure_falls_back_to_retry():
+    """No hedge launched (cap) and the primary dies: _forward_hedged
+    hands None back so forward()'s classic retry-once takes over."""
+    r = _fake_router(["http://a:1", "http://b:2"], hedge_rate_cap=0.0)
+
+    def fake(url, path, body, content_type, method, headers=None):
+        time.sleep(0.1)
+        raise urllib.error.URLError("dead")
+
+    r._forward_once = fake
+    assert _hedged(r, b'{"m": 8, "n": 24}') is None
+    assert r.statusz()["hedging"]["outcomes"] == {"suppressed_cap": 1}
+
+
+# ---------------------------------------------------------------------------
+# backend front-end: propagated-deadline admission
+
+
+def _mk_backend(reg=None, **svc_kw):
+    reg = reg or MetricsRegistry()
+    svc_kw = {"batch": 4, "flush_s": 0.02, "max_queue_depth": 64, **svc_kw}
+    svc = SolveService(ServiceConfig(**svc_kw), metrics=reg)
+    front = SolveHTTPServer(
+        svc, NetConfig(healthz_cache_s=0.02), metrics=reg
+    ).start()
+    return svc, front
+
+
+def test_expired_on_arrival_rejected_with_timeout_verdict():
+    svc, front = _mk_backend()
+    try:
+        code, out = _http(
+            front.url + "/v1/solve",
+            {"m": 8, "n": 24, "seed": 3},
+            headers={protocol.DEADLINE_HEADER: "0.000"},
+        )
+        assert code == 504
+        assert out["status"] == "timeout"
+        assert out["reason"] == "deadline_expired"
+        # Rejected BEFORE admission: nothing queued, nothing solved.
+        assert svc.progress()[1] == 0
+        text = urllib.request.urlopen(
+            front.url + "/metrics", timeout=10
+        ).read().decode()
+        assert "net_deadline_expired_on_arrival_total" in text
+        # A malformed header is ignored, not a 400 — the body's own
+        # deadline (none here) governs.
+        code, out = _http(
+            front.url + "/v1/solve",
+            {"m": 8, "n": 24, "seed": 4},
+            headers={protocol.DEADLINE_HEADER: "not-a-number"},
+        )
+        assert code == 200 and out["status"] == "optimal"
+    finally:
+        front.shutdown()
+        svc.shutdown()
+
+
+def test_propagated_header_clamps_body_deadline():
+    """The hop header upper-bounds the client's original deadline: a
+    generous body deadline_ms cannot resurrect budget a prior hop
+    already spent."""
+    svc, front = _mk_backend()
+    try:
+        code, out = _http(
+            front.url + "/v1/solve",
+            {"m": 8, "n": 24, "seed": 5, "deadline_ms": 60_000.0},
+            headers={protocol.DEADLINE_HEADER: "0.5"},
+        )
+        # 0.5ms of real budget: the scheduler sheds it as a TIMEOUT
+        # verdict (the body's 60s never applies).
+        assert code == 504 and out["status"] == "timeout"
+    finally:
+        front.shutdown()
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# cancellation plumbing: service + HTTP
+
+
+def test_cancel_queued_releases_units_and_stamps_journal(tmp_path):
+    svc = SolveService(
+        ServiceConfig(
+            batch=4,
+            flush_s=60.0,
+            journal_dir=str(tmp_path / "j"),
+            admission=AdmissionConfig(),
+        ),
+        auto_start=False,  # worker never dispatches: the job stays queued
+    )
+    fut = svc.submit(random_dense_lp(8, 24, seed=1), tenant="t")
+    jid = fut.jid
+    assert jid
+    assert svc.admission._tenants["t"].in_system == 1
+    ok, state = svc.cancel(jid)
+    assert (ok, state) == (True, "cancelled")
+    assert svc.admission._tenants["t"].in_system == 0  # units released
+    res = fut.result(timeout=5)
+    assert res.status is Status.CANCELLED
+    rec = svc._journal.result(jid)
+    assert rec is not None and rec["status"] == "cancelled"
+    code, payload = protocol.payload_from_record(rec)
+    assert code == 499 and payload["status"] == "cancelled"
+    # Idempotence + the non-cancellable states.
+    assert svc.cancel(jid) == (False, "finished")
+    assert svc.cancel("never-minted") == (False, "unknown")
+    assert svc.cancel("") == (False, "unknown")
+
+
+def test_http_cancel_endpoint_states(tmp_path):
+    svc, front = _mk_backend(
+        journal_dir=str(tmp_path / "j"), flush_s=60.0
+    )
+    try:
+        code, out = _http(
+            front.url + "/v1/solve",
+            {"m": 8, "n": 24, "seed": 2, "async": True},
+        )
+        assert code == 202
+        jid = out["id"]
+        code, out = _http(
+            front.url + f"/v1/cancel/{jid}", method="POST", body={}
+        )
+        assert code == 200
+        assert out == {"id": jid, "cancelled": True, "state": "cancelled"}
+        # The async poll surface reports the 499 verdict.
+        code, out = _http(front.url + f"/v1/solve/{jid}")
+        assert code == 499 and out["status"] == "cancelled"
+        # Re-cancel: the verdict is durable -> 409, not 200.
+        code, out = _http(
+            front.url + f"/v1/cancel/{jid}", method="POST", body={}
+        )
+        assert code == 409 and out["state"] == "finished"
+        code, out = _http(
+            front.url + "/v1/cancel/never-minted", method="POST", body={}
+        )
+        assert code == 404 and out["state"] == "unknown"
+    finally:
+        front.shutdown()
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# hedge x elasticity interplay: live backends, straggling primary
+
+
+def test_hedge_over_live_backends_cancels_loser_and_releases_units(tmp_path):
+    """A straggling primary (its submit stalls past the hedge delay)
+    hedges to the sibling; the hedge's ACK wins, the loser's queued
+    copy is cancelled at the primary — admission units released,
+    journal stamped cancelled, zero duplicate solves across the plane."""
+    svc_a = SolveService(
+        ServiceConfig(
+            batch=4,
+            flush_s=30.0,  # queued long enough for the cancel to land
+            journal_dir=str(tmp_path / "ja"),
+            admission=AdmissionConfig(),
+        ),
+        metrics=MetricsRegistry(),
+    )
+    svc_b = SolveService(
+        ServiceConfig(
+            batch=4, flush_s=0.05, journal_dir=str(tmp_path / "jb")
+        ),
+        metrics=MetricsRegistry(),
+    )
+    front_a = SolveHTTPServer(
+        svc_a, NetConfig(healthz_cache_s=0.02), metrics=MetricsRegistry()
+    ).start()
+    front_b = SolveHTTPServer(
+        svc_b, NetConfig(healthz_cache_s=0.02), metrics=MetricsRegistry()
+    ).start()
+    log = tmp_path / "router.jsonl"
+    router = Router(
+        [front_a.url, front_b.url],
+        RouterConfig(
+            poll_s=0.05,
+            hedge_rate_cap=1.0,
+            retry_budget_burst=20.0,
+            log_jsonl=str(log),
+        ),
+        metrics=MetricsRegistry(),
+    ).start()
+    real_submit = svc_a.submit
+
+    def straggling_submit(*a, **kw):
+        time.sleep(0.6)  # well past the ~50ms hedge floor
+        return real_submit(*a, **kw)
+
+    svc_a.submit = straggling_submit
+    try:
+        assert _wait(lambda: router.healthy_count() == 2, timeout_s=10.0)
+        # Warm A's latency digest so its hedge delay exists, and bias
+        # the load score so A is the pick.
+        for _ in range(8):
+            router._observe_latency(front_a.url, 2.0)
+        with router._lock:
+            router._backends[front_b.url].live = 3
+        body = json.dumps(
+            {"m": 8, "n": 24, "seed": 9, "async": True, "tenant": "t"}
+        ).encode()
+        code, payload, url = router.forward(
+            "/v1/solve", body, "application/json"
+        )
+        assert code == 202 and url == front_b.url  # the hedge's ACK won
+        jid_b = json.loads(payload)["id"]
+        st = router.statusz()["hedging"]
+        assert st["hedges_launched"] == 1
+        assert st["outcomes"] == {"hedge_won": 1}
+        # The loser's copy at A: cancelled, units released, journaled.
+        assert _wait(
+            lambda: router.statusz()["hedging"]["cancels"] == 1,
+            timeout_s=10.0,
+        )
+        assert _wait(
+            lambda: svc_a.admission._tenants["t"].in_system == 0,
+            timeout_s=10.0,
+        )
+        events = [json.loads(ln) for ln in log.read_text().splitlines()]
+        cancel = [e for e in events if e.get("event") == "cancel"]
+        assert cancel and cancel[0]["state"] == "cancelled"
+        assert cancel[0]["backend"] == front_a.url
+        rec_a = svc_a._journal.result(cancel[0]["jid"])
+        assert rec_a is not None and rec_a["status"] == "cancelled"
+        # The winner solves exactly once; the plane holds zero
+        # duplicate solves.
+        deadline = time.perf_counter() + 60
+        code = 202
+        while code == 202 and time.perf_counter() < deadline:
+            code, out = _http(front_b.url + f"/v1/solve/{jid_b}")
+            if code == 202:
+                time.sleep(0.05)
+        assert code == 200 and out["status"] == "optimal"
+        assert journal_duplicate_solves(str(tmp_path / "ja")) == 0
+        assert journal_duplicate_solves(str(tmp_path / "jb")) == 0
+    finally:
+        svc_a.submit = real_submit
+        router.shutdown()
+        front_a.shutdown()
+        front_b.shutdown()
+        svc_a.shutdown()
+        svc_b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: the full multi-process tail acceptance run
+
+
+def test_probe_tail_smoke():
+    """CI satellite: the tail-tolerance acceptance probe — a live
+    3-backend plane under a SIGSTOP straggler and a slow-loris leg,
+    asserting hedged p99 within 3x healthy, zero lost acks, zero
+    duplicate solves, cap/budget reconciliation against the JSONL
+    ledger, and a flat steady-state compile count — runs on every
+    tier-1 pass under a wall budget."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "probe_tail.py"),
+         "--tail-requests", "12", "--budget-s", "240"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    tail = "\n".join(proc.stdout.splitlines()[-30:])
+    assert proc.returncode == 0, (
+        f"probe_tail failed (rc={proc.returncode}):\n{tail}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    assert "PASS" in proc.stdout
