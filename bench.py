@@ -1134,6 +1134,199 @@ def _bench_sparse(args) -> list:
                 ),
             )
         )
+
+    # 4. Distributed row family (row-sharded matrix-free tier): the
+    # SAME storm-profile instance on 1 device vs every N-way row mesh
+    # this host can form. Per-device max live operand bytes is THE
+    # column (the ≈1/N law the tier exists for); psum_per_iter makes
+    # the communication cost explicit — one n-vector all-reduce per CG
+    # iteration, regardless of N.
+    import jax as _jax
+
+    from distributedlpsolver_tpu.backends.sparse_iterative import (
+        SparseIterativeBackend,
+    )
+    from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+    Kd = 8 if args.quick else 64
+    pd_spec = dict(
+        scenarios=Kd, block_m=32, block_n=48, first_stage_n=24, seed=3
+    )
+
+    def _pd():
+        return storm_sparse_lp(
+            Kd, block_m=32, block_n=48, first_stage_n=24, seed=3
+        )
+
+    md, nd = _pd().A.shape
+    dbase = {"family": "sparse-distributed", "instance": _pd().name,
+             "m": md, "n": nd}
+    ndev = len(_jax.devices())
+    for width in [1] + [w for w in (2, 4, 8) if w <= ndev]:
+        if width == 1:
+            be = SparseIterativeBackend()
+        else:
+            mesh = mesh_lib.make_mesh(
+                (width,),
+                axis_names=("batch",),
+                devices=_jax.devices()[:width],
+            )
+            be = SparseIterativeBackend(mesh=mesh)
+        try:
+            r = _solve_timed(_pd(), be, tol=1e-8, max_iter=200)
+            rep = be.cg_report()
+            add(
+                dict(
+                    dbase,
+                    engine="sparse-iterative",
+                    devices=width,
+                    shards=int(rep["shards"]),
+                    psum_per_iter=int(rep["psum_per_iter"]),
+                    tol=1e-8,
+                    status=r.status.value,
+                    iters=int(r.iterations),
+                    cg_iters=int(rep["cg_iters"]),
+                    precond=rep["precond"],
+                    time_s=round(r.solve_time, 4),
+                    max_operand_mb=round(be.max_operand_nbytes() / 1e6, 3),
+                    max_operand_per_device_mb=round(
+                        be.max_operand_nbytes(per_device=True) / 1e6, 3
+                    ),
+                )
+            )
+        except Exception as e:
+            add(
+                dict(
+                    dbase,
+                    engine="sparse-iterative",
+                    devices=width,
+                    status="failed",
+                    error=f"{type(e).__name__}: {str(e)[:200]}",
+                )
+            )
+
+    # 4b. 2-process world through the launcher (the multi-host seam):
+    # same instance, row shards spanning a process boundary. Best-effort
+    # — the CPU harness transport is lossy by design; a failed world is
+    # recorded, not fatal.
+    try:
+        import tempfile
+
+        from distributedlpsolver_tpu.distributed.launcher import run_world
+
+        with tempfile.TemporaryDirectory(prefix="bench-sprows-") as wd:
+            res = run_world(
+                "sparse_rows",
+                dict(pd_spec, tol=1e-8),
+                world_size=2,
+                workdir=wd,
+                local_devices=2,
+                timeout=600,
+            )
+        out0 = res[0]
+        add(
+            dict(
+                dbase,
+                engine="sparse-iterative",
+                devices="2proc x 2dev",
+                shards=int(out0["shards"]),
+                psum_per_iter=int(out0["psum_per_iter"]),
+                tol=1e-8,
+                status=out0["status"],
+                iters=int(out0["iterations"]),
+                cg_iters=int(out0["cg_iters"]),
+                precond=out0["precond"],
+                max_operand_per_device_mb=round(
+                    out0["max_operand_per_device"] / 1e6, 3
+                ),
+                ranks_agree=len(
+                    {o["objective"] for o in res.values()}
+                ) == 1,
+            )
+        )
+    except Exception as e:
+        add(
+            dict(
+                dbase,
+                engine="sparse-iterative",
+                devices="2proc x 2dev",
+                status="failed",
+                error=f"{type(e).__name__}: {str(e)[:200]}",
+            )
+        )
+
+    # 5. ILDL-vs-Jacobi on the unstructured endgame (the instance that
+    # used to degrade to cpu-sparse): jacobi's honest failure next to
+    # auto's mid-solve escalation to incomplete-LDLᵀ, cg_iters side by
+    # side so the preconditioner win is attributable over rounds.
+    from distributedlpsolver_tpu.models.generators import netlib_sparse_lp
+
+    pu = netlib_sparse_lp(120, 220, seed=10)
+    ubase = {
+        "family": "sparse-ildl",
+        "instance": pu.name,
+        "m": int(pu.A.shape[0]),
+        "n": int(pu.A.shape[1]),
+    }
+    ildl_pair = {}
+    for label, kw in (("jacobi", {"precond": "jacobi"}), ("auto", {})):
+        be = SparseIterativeBackend(**kw)
+        try:
+            r = _solve_timed(
+                netlib_sparse_lp(120, 220, seed=10), be, tol=1e-8,
+                _retries=0,
+            )
+            rep = be.cg_report()
+            row = dict(
+                ubase,
+                engine=f"sparse-iterative({label})",
+                tol=1e-8,
+                status=r.status.value,
+                iters=int(r.iterations),
+                cg_iters=int(rep["cg_iters"]),
+                precond=rep["precond"],
+                time_s=round(r.solve_time, 4),
+            )
+            ildl_pair[label] = row
+            add(row)
+        except Exception as e:
+            row = dict(
+                ubase,
+                engine=f"sparse-iterative({label})",
+                status="failed",
+                error=f"{type(e).__name__}: {str(e)[:200]}",
+            )
+            ildl_pair[label] = row
+            add(row)
+    j, a = ildl_pair.get("jacobi", {}), ildl_pair.get("auto", {})
+
+    def _cg_rate(row):
+        if row.get("cg_iters") and row.get("iters"):
+            return round(row["cg_iters"] / row["iters"], 1)
+        return None
+
+    jr, ar = _cg_rate(j), _cg_rate(a)
+    add(
+        dict(
+            ubase,
+            engine="ildl-vs-jacobi",
+            jacobi_status=j.get("status"),
+            jacobi_cg_iters=j.get("cg_iters"),
+            jacobi_cg_per_ipm_iter=jr,
+            ildl_status=a.get("status"),
+            ildl_cg_iters=a.get("cg_iters"),
+            ildl_cg_per_ipm_iter=ar,
+            ildl_engaged=a.get("precond") == "ildl",
+            # The win: ildl finishes where jacobi faulted, at a strictly
+            # lower CG cost per IPM iteration (totals are not comparable
+            # — jacobi died early, ildl ran the full endgame).
+            ildl_wins=bool(
+                a.get("status") == "optimal"
+                and (j.get("status") != "optimal" or (jr or 0) > (ar or 0))
+                and (jr is None or ar is None or ar < jr)
+            ),
+        )
+    )
     return rows
 
 

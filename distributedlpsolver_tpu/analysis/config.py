@@ -246,6 +246,16 @@ JSONL_FIELDS = {
     "engine",
     "cg_iters",
     "precond",
+    # row-sharded matrix-free tier: cg_report/bench rows carry the row
+    # shard count and the per-CG-iteration psum count (1 n-vector
+    # all-reduce when sharded, 0 single-device); ``precond`` gains the
+    # "ildl" value (incomplete-LDLᵀ escalation). block_angular phase
+    # records/A-B harness rows stamp the per-phase program class
+    # (oneshot vs K-grouped f64 — backends.block_angular.
+    # phase_program_class)
+    "shards",
+    "psum_per_iter",
+    "program_class",
     # stochastic scenario tier: scenario-request records carry the
     # scenario count, the padded scenario-count bucket
     # (models/scenario.scenario_k_bucket), and the decomposition's
@@ -438,6 +448,9 @@ COMMITTED_PLACERS = {
     "col_sharding",
     "vec_sharding",
     "make_array_from_callback",
+    # ops/sparse.py: builds the row-sharded hybrid-ELL operator with
+    # every leaf placed against the global mesh (shard axis leading).
+    "shard_rows",
 }
 
 # Calls that take a ``mesh=`` keyword and compile/execute against it —

@@ -33,6 +33,7 @@ those problems to the dense/sharded backends).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -210,6 +211,41 @@ _EW_F64_BLOCK_ENTRIES = 1 << 24
 _F64_SPLIT_BUDGET = 2e9
 
 
+# ROUND5_NOTES lever 4: the storm ≥100k-row class dies on an f64
+# program-class kernel fault (the worker crashes outright on the big-K
+# batched f64 phases), not on HBM — while chunk ≤128 program shapes
+# stay in the healthy class. The f64 factorize/solve kernels therefore
+# run the K axis in SEQUENTIAL groups of ≤ _K_GROUP blocks: every
+# batched cholesky/einsum instance the compiler sees is a ≤128-block
+# program, and the group results concatenate/accumulate outside the
+# kernels. Read ONCE at import — a per-call toggle would be invisible
+# to the jit cache (traces key on shapes, not module globals), which is
+# why the run_storm100k.py A/B harness isolates each arm in its own
+# subprocess. f32 phases keep their one-shot shapes (measured healthy).
+_K_GROUP = int(os.environ.get("DLPS_BLOCK_K_GROUP", "128"))
+
+
+def _k_groups(K: int, group: Optional[int] = None) -> list:
+    """Static [(start, size), …] covering the K axis in ≤group-size
+    runs; the degenerate [(0, K)] (one-shot) when grouping is off
+    (group ≤ 0) or K already fits one group."""
+    g = _K_GROUP if group is None else group
+    if g <= 0 or K <= g:
+        return [(0, K)]
+    return [(s, min(g, K - s)) for s in range(0, K, g)]
+
+
+def phase_program_class(K: int, dtype) -> str:
+    """Program-class stamp of one phase's batched-K kernels — the
+    per-phase label the run_storm100k.py A/B harness records. f64
+    phases with K past the group cap run K-grouped (lever 4);
+    everything else is one-shot."""
+    name = jnp.dtype(dtype).name
+    if name == "float64" and len(_k_groups(K)) > 1:
+        return f"{name}-kgroup{_K_GROUP}"
+    return f"{name}-oneshot"
+
+
 def _ew_block(t: "BlockTensors") -> bool:
     return (
         t.B_all.dtype == jnp.float64
@@ -338,52 +374,69 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False,
         Gk = jnp.einsum("kln,kmn->klm", Lw, Bw)  # = L·D·Bᵀ (sq·sq = dB)
         return Lk, _link_factor(S), Gk
 
+    # K-grouped f64 phases (ROUND5_NOTES lever 4): the full-precision
+    # direct kernels are the program class that faults at storm-100k K;
+    # groups of ≤ _K_GROUP keep every batched instance healthy. The
+    # single-group case traces EXACTLY the pre-grouping program (the
+    # one-shot identity), so small-K solves are byte-identical.
+    kgroup = (not gram_s) and t.B_all.dtype == jnp.dtype("float64")
+
     def factorize(d):
         if gram_s:
             return factorize_gram(d)
         dB = pad(d)[t.col_idx]  # (K, nb); padded cols get d=0
-        Bd = t.B_all * dB[:, None, :]
-        Mkk = jnp.einsum("kmn,kpn->kmp", Bd, t.B_all)
         # Padded (sentinel) rows are all-zero in B_all → zero rows/cols in
         # M_kk, which would sink the batched Cholesky. A unit diagonal
         # decouples them: their rhs entries are zero, so their solution
         # components stay exactly zero.
-        pad_diag = (t.row_idx == m).astype(Mkk.dtype)  # (K, mb)
-        Mkk = Mkk + jnp.zeros_like(Mkk).at[
-            :, jnp.arange(mb), jnp.arange(mb)
-        ].set(pad_diag)
-        Gk = jnp.einsum("kln,kmn->klm", t.L_all * dB[:, None, :], t.B_all)
-        if use_mxu:
-            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
+        pad_diag = (t.row_idx == m).astype(t.B_all.dtype)  # (K, mb)
+        groups = _k_groups(K) if kgroup else [(0, K)]
+        fac_parts, Gk_parts = [], []
+        S = jnp.zeros((link, link), dtype=t.B_all.dtype)
+        for s, g in groups:
+            Bg = t.B_all[s : s + g]
+            Lg = t.L_all[s : s + g]
+            dg = dB[s : s + g]
+            Bd = Bg * dg[:, None, :]
+            Mkk = jnp.einsum("kmn,kpn->kmp", Bd, Bg)
+            Mkk = Mkk + jnp.zeros_like(Mkk).at[
+                :, jnp.arange(mb), jnp.arange(mb)
+            ].set(pad_diag[s : s + g])
+            Gk = jnp.einsum("kln,kmn->klm", Lg * dg[:, None, :], Bg)
+            if use_mxu:
+                from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
 
-            Lki = jax.vmap(chol_inv_mxu)(_rel_diag_reg(Mkk, reg))
-            # H_k = M_kk⁻¹ G_kᵀ via two batched GEMMs with Lk⁻¹
-            Hk = jnp.einsum(
-                "kpm,kpl->kml", Lki, jnp.einsum("kmp,klp->kml", Lki, Gk)
-            )
-        else:
-            Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg))
-            # H_k = M_kk⁻¹ G_kᵀ (batched two-triangular-solve), (K, mb, link)
-            Hk = jax.scipy.linalg.cho_solve((Lk, True), jnp.swapaxes(Gk, 1, 2))
-        # Contract K INSIDE the einsum: the two-step form
-        # einsum("kln,kpn->klp").sum(0) materializes a (K, link, link)
-        # intermediate — 10.5 GB in f64 at the pds-20 class (K=64,
-        # link=1600), the exact compile-time HBM OOM observed on one
-        # chip. Contracting k,n together lowers to a single
-        # (link, K·nb)×(K·nb, link) GEMM with tile-sized temps. Under a
-        # K-sharded mesh GSPMD still emits per-device partial sums + one
-        # all-reduce, same as the .sum(0) form.
-        MLL = jnp.einsum("kln,kpn->lp", t.L_all * dB[:, None, :], t.L_all)
+                Lki = jax.vmap(chol_inv_mxu)(_rel_diag_reg(Mkk, reg))
+                # H_k = M_kk⁻¹ G_kᵀ via two batched GEMMs with Lk⁻¹
+                Hk = jnp.einsum(
+                    "kpm,kpl->kml", Lki, jnp.einsum("kmp,klp->kml", Lki, Gk)
+                )
+                fac_parts.append(Lki)
+            else:
+                Lk = jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg))
+                # H_k = M_kk⁻¹ G_kᵀ (batched two-triangular-solve)
+                Hk = jax.scipy.linalg.cho_solve(
+                    (Lk, True), jnp.swapaxes(Gk, 1, 2)
+                )
+                fac_parts.append(Lk)
+            # Contract K INSIDE the einsum: the two-step form
+            # einsum("kln,kpn->klp").sum(0) materializes a (K, link, link)
+            # intermediate — 10.5 GB in f64 at the pds-20 class (K=64,
+            # link=1600), the exact compile-time HBM OOM observed on one
+            # chip. Contracting k,n together lowers to a single
+            # (link, K·nb)×(K·nb, link) GEMM with tile-sized temps. Under a
+            # K-sharded mesh GSPMD still emits per-device partial sums + one
+            # all-reduce, same as the .sum(0) form. The Σ_k is the
+            # reference's MPI_Allreduce of Schur blocks (BASELINE.json:5).
+            S = S + jnp.einsum("kln,kpn->lp", Lg * dg[:, None, :], Lg)
+            S = S - jnp.einsum("klm,kmp->lp", Gk, Hk)
+            Gk_parts.append(Gk)
         if n0:
             d0 = d[t.border_idx]
-            MLL = MLL + (t.A0 * d0[None, :]) @ t.A0.T
-        # Schur complement of the linking system: the Σ_k here is the
-        # reference's MPI_Allreduce of Schur blocks (BASELINE.json:5) —
-        # an XLA all-reduce when the K axis is mesh-sharded.
-        S = MLL - jnp.einsum("klm,kmp->lp", Gk, Hk)
-        if use_mxu:
-            return Lki, _link_factor(S), Gk
-        return Lk, _link_factor(S), Gk
+            S = S + (t.A0 * d0[None, :]) @ t.A0.T
+        fac = fac_parts[0] if len(fac_parts) == 1 else jnp.concatenate(fac_parts)
+        Gk = Gk_parts[0] if len(Gk_parts) == 1 else jnp.concatenate(Gk_parts)
+        return fac, _link_factor(S), Gk
 
     def solve(factors, r):
         Lk, Ls, Gk = factors
@@ -391,22 +444,32 @@ def _block_ops(t: BlockTensors, lay: BlockLayout, reg, dtype, gram_s=False,
         rL = r[t.link_idx]
         if use_mxu:
             # factors hold EXPLICIT inverses: every solve is GEMVs.
-            blk = lambda v: jnp.einsum(
-                "kpm,kp->km", Lk, jnp.einsum("kmp,kp->km", Lk, v)
+            blk = lambda L, v: jnp.einsum(
+                "kpm,kp->km", L, jnp.einsum("kmp,kp->km", L, v)
             )
         else:
-            blk = lambda v: jax.scipy.linalg.cho_solve(
-                (Lk, True), v[..., None]
+            blk = lambda L, v: jax.scipy.linalg.cho_solve(
+                (L, True), v[..., None]
             )[..., 0]
         if ls_inv:
             lnk = lambda v: Ls.T @ (Ls @ v)
         else:
             lnk = lambda v: jax.scipy.linalg.cho_solve((Ls, True), v)
-        tmp = blk(rb)
-        rS = rL - jnp.einsum("klm,km->l", Gk, tmp)
+        groups = _k_groups(K) if kgroup else [(0, K)]
+        tmps = [blk(Lk[s : s + g], rb[s : s + g]) for s, g in groups]
+        rS = rL - sum(
+            jnp.einsum("klm,km->l", Gk[s : s + g], tmp)
+            for (s, g), tmp in zip(groups, tmps)
+        )
         yL = lnk(rS)
-        rb2 = rb - jnp.einsum("klm,l->km", Gk, yL)
-        yb = blk(rb2)
+        yb_parts = [
+            blk(
+                Lk[s : s + g],
+                rb[s : s + g] - jnp.einsum("klm,l->km", Gk[s : s + g], yL),
+            )
+            for s, g in groups
+        ]
+        yb = yb_parts[0] if len(yb_parts) == 1 else jnp.concatenate(yb_parts)
         out = jnp.zeros(m + 1, dtype=r.dtype).at[t.row_idx].add(yb)
         return out.at[t.link_idx].add(yL)[:m]
 
@@ -493,72 +556,95 @@ def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg,
     def factorize(d):
         dB = jnp.concatenate([d, jnp.zeros(1, d.dtype)])[t.col_idx]
         nfull = nb // chunk
-
-        def contrib(Bc, Lc, dc):
-            Bd = Bc * dc[:, None, :]
-            Ld = Lc * dc[:, None, :]
-            return (
-                jnp.einsum("kmc,kpc->kmp", Bd, Bc),
-                jnp.einsum("klc,kmc->klm", Ld, Bc),
-                jnp.einsum("klc,kpc->lp", Ld, Lc),
-            )
-
-        def body(jb, acc):
-            Mkk, Gk, MLL = acc
-            j0 = jb * chunk
-            dMkk, dGk, dMLL = contrib(
-                jax.lax.dynamic_slice_in_dim(t.B_all, j0, chunk, 2),
-                jax.lax.dynamic_slice_in_dim(t.L_all, j0, chunk, 2),
-                jax.lax.dynamic_slice_in_dim(dB, j0, chunk, 1),
-            )
-            return Mkk + dMkk, Gk + dGk, MLL + dMLL
-
         dt = t.B_all.dtype
-        Mkk, Gk, MLL = jax.lax.fori_loop(
-            0, nfull, body,
-            (
-                jnp.zeros((K, mb, mb), dt),
-                jnp.zeros((K, link, mb), dt),
-                jnp.zeros((link, link), dt),
-            ),
-        )
-        # Ragged tail as one static slice (accumulation forbids the
-        # clamped-slice trick — a re-read tail would double-count — and
-        # padding copies of the full tensors would cost ~1.5 GB inside
-        # the very path built to bound HBM).
-        if nb - nfull * chunk:
-            j0 = nfull * chunk
-            dMkk, dGk, dMLL = contrib(
-                t.B_all[:, :, j0:], t.L_all[:, :, j0:], dB[:, j0:]
+        pad_diag = (t.row_idx == m).astype(dt)
+        # K-grouped outer loop (ROUND5_NOTES lever 4, same rationale as
+        # _block_ops): every n-chunked emulated-f64 dot and every
+        # batched factor kernel sees ≤ _K_GROUP blocks — the f64c
+        # finisher is exactly the phase the storm-100k class faults in.
+        # One group (K ≤ _K_GROUP) traces the pre-grouping program.
+        groups = _k_groups(K)
+        Lki_parts, Gk_parts = [], []
+        MLL = jnp.zeros((link, link), dt)
+        S = jnp.zeros((link, link), dt)
+        for s, g in groups:
+            Bfull = t.B_all[s : s + g]
+            Lfull = t.L_all[s : s + g]
+            dfull = dB[s : s + g]
+
+            def contrib(Bc, Lc, dc):
+                Bd = Bc * dc[:, None, :]
+                Ld = Lc * dc[:, None, :]
+                return (
+                    jnp.einsum("kmc,kpc->kmp", Bd, Bc),
+                    jnp.einsum("klc,kmc->klm", Ld, Bc),
+                    jnp.einsum("klc,kpc->lp", Ld, Lc),
+                )
+
+            def body(jb, acc):
+                Mkk, Gk, MLLg = acc
+                j0 = jb * chunk
+                dMkk, dGk, dMLL = contrib(
+                    jax.lax.dynamic_slice_in_dim(Bfull, j0, chunk, 2),
+                    jax.lax.dynamic_slice_in_dim(Lfull, j0, chunk, 2),
+                    jax.lax.dynamic_slice_in_dim(dfull, j0, chunk, 1),
+                )
+                return Mkk + dMkk, Gk + dGk, MLLg + dMLL
+
+            Mkk, Gk, MLLg = jax.lax.fori_loop(
+                0, nfull, body,
+                (
+                    jnp.zeros((g, mb, mb), dt),
+                    jnp.zeros((g, link, mb), dt),
+                    jnp.zeros((link, link), dt),
+                ),
             )
-            Mkk, Gk, MLL = Mkk + dMkk, Gk + dGk, MLL + dMLL
+            # Ragged tail as one static slice (accumulation forbids the
+            # clamped-slice trick — a re-read tail would double-count —
+            # and padding copies of the full tensors would cost ~1.5 GB
+            # inside the very path built to bound HBM).
+            if nb - nfull * chunk:
+                j0 = nfull * chunk
+                dMkk, dGk, dMLL = contrib(
+                    Bfull[:, :, j0:], Lfull[:, :, j0:], dfull[:, j0:]
+                )
+                Mkk, Gk, MLLg = Mkk + dMkk, Gk + dGk, MLLg + dMLL
+            MLL = MLL + MLLg
+            Mkk = Mkk + jnp.zeros_like(Mkk).at[
+                :, jnp.arange(mb), jnp.arange(mb)
+            ].set(pad_diag[s : s + g])
+            # Explicit inverse factors: the link-many-rhs TRSM these
+            # replace is exactly the lowering that blows temps; GEMVs
+            # against Lk⁻¹ are clean batched dots. On TPU the
+            # factor+inverse itself runs through the GEMM-dominated
+            # panel kernel (ops/chol_mxu.py) — XLA's emulated-f64
+            # cholesky/solve_triangular lower to scalarized recurrences
+            # ~10× slower (measured, probe_chol_mxu).
+            if use_mxu:
+                from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
+
+                Lki = jax.vmap(chol_inv_mxu)(_rel_diag_reg(Mkk, reg))
+            else:
+                eye_b = jnp.broadcast_to(jnp.eye(mb, dtype=dt), (g, mb, mb))
+                Lki = jax.scipy.linalg.solve_triangular(
+                    jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg)), eye_b,
+                    lower=True,
+                )
+            # H_k = M_kk⁻¹ G_kᵀ via two batched GEMMs with Lk⁻¹
+            tmp = jnp.einsum("kmp,klp->kml", Lki, Gk)  # Lk⁻¹ Gkᵀ
+            Hk = jnp.einsum("kpm,kpl->kml", Lki, tmp)  # Lk⁻ᵀ (…)
+            S = S - jnp.einsum("klm,kmp->lp", Gk, Hk)
+            Lki_parts.append(Lki)
+            Gk_parts.append(Gk)
         if n0:
             d0 = d[t.border_idx]
             MLL = MLL + (t.A0 * d0[None, :]) @ t.A0.T
-        pad_diag = (t.row_idx == m).astype(Mkk.dtype)
-        Mkk = Mkk + jnp.zeros_like(Mkk).at[
-            :, jnp.arange(mb), jnp.arange(mb)
-        ].set(pad_diag)
-        # Explicit inverse factors: the link-many-rhs TRSM these replace
-        # is exactly the lowering that blows temps; GEMVs against Lk⁻¹
-        # are clean batched dots. On TPU the factor+inverse itself runs
-        # through the GEMM-dominated panel kernel (ops/chol_mxu.py) —
-        # XLA's emulated-f64 cholesky/solve_triangular lower to
-        # scalarized recurrences ~10× slower (measured, probe_chol_mxu).
-        if use_mxu:
-            from distributedlpsolver_tpu.ops.chol_mxu import chol_inv_mxu
-
-            Lki = jax.vmap(chol_inv_mxu)(_rel_diag_reg(Mkk, reg))
-        else:
-            eye_b = jnp.broadcast_to(jnp.eye(mb, dtype=dt), (K, mb, mb))
-            Lki = jax.scipy.linalg.solve_triangular(
-                jnp.linalg.cholesky(_rel_diag_reg(Mkk, reg)), eye_b,
-                lower=True,
-            )
-        # H_k = M_kk⁻¹ G_kᵀ via two batched GEMMs with Lk⁻¹
-        tmp = jnp.einsum("kmp,klp->kml", Lki, Gk)  # Lk⁻¹ Gkᵀ
-        Hk = jnp.einsum("kpm,kpl->kml", Lki, tmp)  # Lk⁻ᵀ (…)
-        S = MLL - jnp.einsum("klm,kmp->lp", Gk, Hk)
+        S = MLL + S
+        Lki = (
+            Lki_parts[0] if len(Lki_parts) == 1
+            else jnp.concatenate(Lki_parts)
+        )
+        Gk = Gk_parts[0] if len(Gk_parts) == 1 else jnp.concatenate(Gk_parts)
         if link_shard is not None:
             from distributedlpsolver_tpu.ops.dist_chol import (
                 chol_tri_inv_mesh,
@@ -580,14 +666,26 @@ def _block_ops_f64c(t: BlockTensors, lay: BlockLayout, reg,
         Lki, Lsi, Gk = factors
         rb = jnp.concatenate([r, jnp.zeros(1, r.dtype)])[t.row_idx]
         rL = r[t.link_idx]
-        # M_kk⁻¹ rb via two batched GEMVs with Lk⁻¹
-        tmp = jnp.einsum("kmp,kp->km", Lki, rb)
-        tmp = jnp.einsum("kpm,kp->km", Lki, tmp)
-        rS = rL - jnp.einsum("klm,km->l", Gk, tmp)
+        groups = _k_groups(K)
+
+        def blk(L, v):
+            # M_kk⁻¹ v via two batched GEMVs with Lk⁻¹
+            return jnp.einsum("kpm,kp->km", L, jnp.einsum("kmp,kp->km", L, v))
+
+        tmps = [blk(Lki[s : s + g], rb[s : s + g]) for s, g in groups]
+        rS = rL - sum(
+            jnp.einsum("klm,km->l", Gk[s : s + g], tmp)
+            for (s, g), tmp in zip(groups, tmps)
+        )
         yL = Lsi.T @ (Lsi @ rS)
-        rb2 = rb - jnp.einsum("klm,l->km", Gk, yL)
-        yb = jnp.einsum("kmp,kp->km", Lki, rb2)
-        yb = jnp.einsum("kpm,kp->km", Lki, yb)
+        yb_parts = [
+            blk(
+                Lki[s : s + g],
+                rb[s : s + g] - jnp.einsum("klm,l->km", Gk[s : s + g], yL),
+            )
+            for s, g in groups
+        ]
+        yb = yb_parts[0] if len(yb_parts) == 1 else jnp.concatenate(yb_parts)
         out = jnp.zeros(m + 1, dtype=r.dtype).at[t.row_idx].add(yb)
         return out.at[t.link_idx].add(yL)[:m]
 

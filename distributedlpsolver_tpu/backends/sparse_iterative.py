@@ -49,7 +49,33 @@ the endgame), and ``export_precond`` hands this solve's final scaling
 back for the cache. The whole step is one jitted program per (shape,
 precond structure, frozen on/off); chunked ≤128-wide batched PCG
 (ops/pcg.py) keeps any fan-out inside the healthy TPU program class
-(ROUND5_NOTES lever 4).
+(ROUND5_NOTES lever 4). The exported state is HOST-CANONICAL (numpy
+dict) so a warm entry written on one mesh width seeds a solve on any
+other — a ``reshard()`` never silently recomputes what the cache holds.
+
+Row-sharded tier (ISSUE 19, the SDSL design — PAPERS.md arXiv
+2604.23979): constructed with ``mesh=``, the operator becomes a
+:class:`~distributedlpsolver_tpu.ops.sparse.RowShardedOperator` — each
+rank owns a contiguous hybrid-ELL row block padded to one common
+program shape, the Newton solve runs CG in the flat padded row space,
+and the ONLY collective is one n-vector psum per CG iteration (the
+``rmatvec_flat`` reduction inside the normal matvec). ADAᵀ is still
+never formed, now per shard: ``memory_report()`` grows a per-device
+view and the tier-1 guard asserts the ≈1/N scaling. The precond ladder
+is unchanged — Jacobi applies shard-local (flat inverse diagonal),
+while block/bordered act in the global row ordering and ride an
+extract→apply→embed round-trip (one m-vector gather per iteration, the
+stated extra collective of structure-over-jacobi on this tier);
+``reshard()`` re-places the backend for the supervisor's elastic
+shrink rung.
+
+ILDL escalation (the unstructured-endgame gap): under ``precond="auto"``
+with no usable structure hint, a run of Newton solves that each burn
+≥ half the CG cap — or a bad step — switches the preconditioner to the
+incomplete-LDLᵀ factorization (ops/ildl.py) built on the normal-equation
+pattern, the rung that previously degraded to cpu-sparse. One attempt
+per solve; a pattern over the ILDL term budget keeps Jacobi (never
+worse than before).
 """
 
 from __future__ import annotations
@@ -69,8 +95,10 @@ from distributedlpsolver_tpu.ipm.config import SolverConfig
 from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
 from distributedlpsolver_tpu.models.problem import InteriorForm
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.ops import ildl as ildl_ops
 from distributedlpsolver_tpu.ops import pcg as pcg_ops
 from distributedlpsolver_tpu.ops import sparse as sparse_ops
+from distributedlpsolver_tpu.parallel import mesh as mesh_lib
 
 # CG cap per Newton solve: m+32 makes PCG an exact solver on probe
 # shapes (CG terminates in ≤ m steps in exact arithmetic); the absolute
@@ -124,6 +152,14 @@ _FORCE_MAX = 1e-2
 # stale factor to help.
 _FROZEN_ERR_EXIT = 1e-4
 
+# ILDL auto-escalation trigger (the "jacobi degrades" rule): this many
+# CONSECUTIVE Newton solves each spending ≥ _ILDL_CG_FRAC of the CG cap
+# — or one bad step — and an auto-routed unstructured solve swaps
+# Jacobi for the incomplete-LDLᵀ preconditioner. Escalation is tried
+# once per solve; a pattern over the ILDL term budget stays on Jacobi.
+_ILDL_CG_FRAC = 0.5
+_ILDL_STREAK = 3
+
 
 def _build_factors(op, prec, d, reg):
     """Preconditioner factors for scaling ``d``: the inverse normal
@@ -147,6 +183,8 @@ def _make_ops(op, prec, reg, cg_tol, cg_max, acc, frozen=None):
     program's extra output — the ``cg_iters`` telemetry). ``frozen``
     short-circuits the per-step factor build with warm-cache factors."""
 
+    sharded = isinstance(op, sparse_ops.RowShardedOperator)
+
     def factorize(d):
         if frozen is not None:
             return d, frozen
@@ -154,6 +192,34 @@ def _make_ops(op, prec, reg, cg_tol, cg_max, acc, frozen=None):
 
     def solve(factors, rhs):
         d, fac = factors
+
+        if sharded:
+            # Flat padded row space: embed the global rhs (pad lanes
+            # exactly 0, they stay 0 through CG — zero operator rows),
+            # run CG on the psum-reduced normal matvec, extract. One
+            # SPMD program per (bucket, mesh); the matvec's only
+            # collective per iteration is the n-vector reduction inside
+            # normal_matvec. Jacobi applies shard-local (flat inverse
+            # diagonal, pad lanes 1); the structured preconditioners
+            # act in the GLOBAL row ordering, so their apply rides an
+            # extract→apply→embed round-trip — one m-vector gather per
+            # iteration, the stated extra cost of bordered-over-jacobi
+            # on this tier.
+            def mv(v):
+                return op.normal_matvec(d, reg, v)
+
+            apply = _apply_factors(prec, fac)
+            if prec is not None:
+                papply = lambda r: op.embed(apply(op.extract(r)))
+            else:
+                papply = apply
+
+            x, it = pcg_ops.pcg(
+                mv, papply, op.embed(rhs),
+                cg_tol, cg_max, mesh=op.mesh, axis=op.axis,
+            )
+            acc.append(it)
+            return op.extract(x)
 
         def mv(v):
             return op.matvec(d * op.rmatvec(v)) + reg * v
@@ -204,15 +270,20 @@ def _sparse_start_jit(op, prec, data, reg, cg_tol, params, cg_max):
 class SparseIterativeBackend(SolverBackend):
     """Inexact (PCG) normal-equations execution of the shared IPM core."""
 
-    def __init__(self, precond: str = "auto"):
-        if precond not in ("auto", "jacobi", "block", "bordered"):
+    def __init__(self, precond: str = "auto", mesh=None):
+        if precond not in ("auto", "jacobi", "block", "bordered", "ildl"):
             raise ValueError(
-                f"precond must be auto/jacobi/block/bordered; got {precond!r}"
+                "precond must be auto/jacobi/block/bordered/ildl; "
+                f"got {precond!r}"
             )
         self._precond_req = precond
         self._prec = None
         self._frozen = None
         self._cfg: Optional[SolverConfig] = None
+        # Device mesh of the row-sharded tier (None = single-device).
+        # Exposed as ``self.mesh`` so the supervisor can probe
+        # participants and re-form a smaller mesh on device loss.
+        self.mesh = mesh
 
     # -- setup -----------------------------------------------------------
 
@@ -220,9 +291,28 @@ class SparseIterativeBackend(SolverBackend):
         self._cfg = config
         dtype = jnp.dtype(config.dtype)
         A = inf.A
-        self._op = sparse_ops.from_scipy(A, dtype=dtype)
         hint = inf.block_structure or {}
         kind = self._precond_req
+        mesh = self.mesh
+        if mesh is not None:
+            # Row-sharded tier. ILDL stays single-device (its escalation
+            # is the unstructured endgame rung; the sharded tier keeps
+            # the same precond ladder as before — bordered via the
+            # global-apply round-trip, jacobi shard-local).
+            if kind == "ildl":
+                raise ValueError(
+                    "precond='ildl' is not available on the row-sharded "
+                    "tier (mesh=...); use auto or a single device"
+                )
+            axis = sparse_ops._shard_axis(
+                mesh,
+                config.mesh_axis
+                if config.mesh_axis in mesh.axis_names
+                else None,
+            )
+            self._op = sparse_ops.shard_rows(A, mesh, dtype=dtype, axis=axis)
+        else:
+            self._op = sparse_ops.from_scipy(A, dtype=dtype)
         if kind == "auto":
             kind = "bordered" if _bordered_usable(hint) else "jacobi"
         if kind == "bordered":
@@ -231,20 +321,50 @@ class SparseIterativeBackend(SolverBackend):
         elif kind == "block":
             A_csr = A if sp.issparse(A) else sp.csr_matrix(np.asarray(A))
             self._prec = pcg_ops.BlockJacobi(A_csr, dtype=dtype)
+        elif kind == "ildl":
+            A_csr = A if sp.issparse(A) else sp.csr_matrix(np.asarray(A))
+            self._prec = ildl_ops.ILDLPrecond(A_csr, dtype=np.dtype(dtype))
         else:
             self._prec = None
         self.precond = kind
+        # ILDL escalation candidates: auto-routed Jacobi on an
+        # unstructured single-device pattern (the rung that used to fall
+        # off to cpu-sparse). Host CSR kept for the symbolic phase only
+        # — host memory, invisible to memory_report by design.
+        self._A_csr = None
+        self._ildl_tried = False
+        self._hi_cg = 0
+        if (
+            mesh is None
+            and self._precond_req == "auto"
+            and kind == "jacobi"
+            and not _bordered_usable(hint)
+            and int(A.shape[0]) <= ildl_ops._MAX_ROWS
+        ):
+            self._A_csr = A if sp.issparse(A) else sp.csr_matrix(np.asarray(A))
+        if mesh is not None:
+            rep = mesh_lib.replicated(mesh)
+
+            def place(v):
+                return mesh_lib.put_global(np.asarray(v, dtype=dtype), rep)
+
+        else:
+
+            def place(v):
+                return jnp.asarray(np.asarray(v), dtype=dtype)
+
         self._data = core.make_problem_data(
-            jnp,
-            jnp.asarray(np.asarray(inf.c), dtype=dtype),
-            jnp.asarray(np.asarray(inf.b), dtype=dtype),
-            jnp.asarray(np.asarray(inf.u), dtype=dtype),
-            dtype,
+            jnp, place(inf.c), place(inf.b), place(inf.u), dtype
         )
         self._dtype = dtype
         self._params = config.step_params()
         self._reg = float(config.reg_dual)
         self._cg_cap = min(self._op.m + 32, _CG_CAP)
+        self._n_shards = (
+            self._op.num_shards
+            if isinstance(self._op, sparse_ops.RowShardedOperator)
+            else 1
+        )
         self._cg_floor = float(config.cg_tol)
         self._last_err = 1.0
         self._frozen = None
@@ -266,13 +386,28 @@ class SparseIterativeBackend(SolverBackend):
         final scaling vector (warm cache). The factors are built ONCE
         here and reused (frozen) until the iterate's KKT error drops to
         the endgame, skipping the per-step factor build; CG corrects
-        the staleness. Shape-guarded: a mismatched vector is refused."""
+        the staleness. Shape-guarded: a mismatched vector is refused.
+
+        Accepts either the host-canonical export dict (current format,
+        ``{"d": numpy, "precond": name}``) or a bare scaling vector
+        (older cache entries) — host numpy either way, so a warm entry
+        written at one mesh width seeds any other width: the factors
+        are rebuilt HERE on this backend's own placement."""
+        if isinstance(d_prior, dict):
+            d_prior = d_prior.get("d")
+            if d_prior is None:
+                return False
         d_prior = np.asarray(d_prior, dtype=np.float64).ravel()
         if self._cfg is None or d_prior.shape != (self._op.n,):
             return False
         if not np.all(np.isfinite(d_prior)) or not np.all(d_prior > 0):
             return False
-        d = jnp.asarray(d_prior, dtype=self._dtype)
+        if self.mesh is not None:
+            d = mesh_lib.put_global(
+                d_prior.astype(self._dtype), mesh_lib.replicated(self.mesh)
+            )
+        else:
+            d = jnp.asarray(d_prior, dtype=self._dtype)
         self._frozen = _build_factors(
             self._op, self._prec, d, jnp.asarray(self._reg, self._dtype)
         )
@@ -283,11 +418,21 @@ class SparseIterativeBackend(SolverBackend):
         """This solve's final scaling vector — what a warm cache stores
         for the next same-structure request (None before any step).
         Computed lazily from the last good iterate: once per solve, not
-        once per iteration."""
+        once per iteration. HOST-CANONICAL (numpy dict): independent of
+        the mesh/sharding it was computed on, so ``reshard()`` and
+        world-reinit reuse it instead of silently recomputing."""
         if self._last_state is None:
             return None
         d = core.scaling_d(self._last_state, self._data, self._params)
-        return np.asarray(d)
+        d_host = (
+            mesh_lib.host_value(d)
+            if mesh_lib.is_multiprocess(self.mesh)
+            else np.asarray(d)
+        )
+        return {
+            "d": np.asarray(d_host, dtype=np.float64),
+            "precond": self.precond,
+        }
 
     # -- driver surface --------------------------------------------------
 
@@ -327,7 +472,10 @@ class SparseIterativeBackend(SolverBackend):
             # A frozen (stale) preconditioner is the first suspect on a
             # failed solve: drop it before the driver escalates reg.
             self._frozen = None
+            self._maybe_escalate_ildl(force=True)
         else:
+            self._maybe_escalate_ildl()
+        if not bad:
             self._last_err = float(
                 max(
                     np.asarray(stats.rel_gap),
@@ -343,12 +491,73 @@ class SparseIterativeBackend(SolverBackend):
         self._cg_iters_total += n
         self._cg_per_iter.append(n)
         self._m_cg.inc(n)
+        if n >= int(_ILDL_CG_FRAC * self._cg_cap):
+            self._hi_cg += 1
+        else:
+            self._hi_cg = 0
+
+    def _maybe_escalate_ildl(self, force: bool = False) -> None:
+        """Swap Jacobi → incomplete-LDLᵀ when the iteration counts say
+        Jacobi stopped capturing the spectrum (see _ILDL_STREAK). Only
+        armed for auto-routed unstructured single-device solves
+        (``self._A_csr``); tried at most once per solve. A pattern over
+        the ILDL term budget (its ValueError) keeps Jacobi — the
+        envelope never gets worse than the pre-ILDL backend."""
+        if self._A_csr is None or self._ildl_tried:
+            return
+        if not force and self._hi_cg < _ILDL_STREAK:
+            return
+        self._ildl_tried = True
+        try:
+            prec = ildl_ops.ILDLPrecond(
+                self._A_csr, dtype=np.dtype(self._dtype)
+            )
+        except ValueError:
+            return
+        self._prec = prec
+        self.precond = "ildl"
+        # Frozen factors are Jacobi-shaped; the new apply can't use them.
+        self._frozen = None
+        self._hi_cg = 0
+        self._m_cg = obs_metrics.get_registry().counter(
+            "sparse_cg_iters_total",
+            labels={"precond": "ildl"},
+            help="PCG iterations spent in the sparse-iterative backend",
+        )
 
     def bump_regularization(self) -> bool:
         if self._reg * self._cfg.reg_grow > 1e-2:
             return False
         self._reg = max(self._reg, 1e-12) * self._cfg.reg_grow
         return True
+
+    def reshard(self, mesh) -> "SparseIterativeBackend":
+        """Fresh un-setup backend of the same precond request on
+        ``mesh`` — the supervisor's elastic shrink rung (base.reshard
+        contract: the driver's setup re-shards the rows, from_host
+        re-places the checkpointed iterate)."""
+        return type(self)(precond=self._precond_req, mesh=mesh)
+
+    def to_host(self, state: IPMState) -> IPMState:
+        if mesh_lib.is_multiprocess(self.mesh):
+            # Global iterate vectors are replicated but not fully
+            # addressable from one process: fetch the whole state as
+            # ONE ordered collective batch (parallel.mesh contract).
+            return IPMState(
+                *(np.asarray(v) for v in mesh_lib.host_values(list(state)))
+            )
+        return IPMState(*(np.asarray(v) for v in state))
+
+    def from_host(self, state: IPMState) -> IPMState:
+        if self.mesh is None:
+            return state
+        rep = mesh_lib.replicated(self.mesh)
+        return IPMState(
+            *(
+                mesh_lib.put_global(np.asarray(v, dtype=self._dtype), rep)
+                for v in state
+            )
+        )
 
     def block_until_ready(self, obj) -> None:
         jax.block_until_ready(obj)
@@ -366,6 +575,11 @@ class SparseIterativeBackend(SolverBackend):
             # IPM iterations that ran on warm-cache-frozen preconditioner
             # factors (the PR 8 follow-on seam) this solve.
             "warm_precond_steps": self._frozen_used,
+            # Row shards of the distributed tier (1 = single-device) and
+            # collectives per CG iteration: the sharded normal matvec
+            # reduces exactly ONE n-vector (the rmatvec_flat psum).
+            "shards": self._n_shards,
+            "psum_per_iter": 1 if self._n_shards > 1 else 0,
         }
 
     def memory_report(self) -> dict:
@@ -385,5 +599,11 @@ class SparseIterativeBackend(SolverBackend):
             }
         return rep
 
-    def max_operand_nbytes(self) -> int:
-        return max(v["nbytes"] for v in self.memory_report().values())
+    def max_operand_nbytes(self, per_device: bool = False) -> int:
+        """Largest live device operand; ``per_device=True`` divides the
+        row-sharded entries by the shard count (entries without a
+        per-device view — replicated vectors — count whole)."""
+        key = "nbytes_per_device" if per_device else "nbytes"
+        return max(
+            v.get(key, v["nbytes"]) for v in self.memory_report().values()
+        )

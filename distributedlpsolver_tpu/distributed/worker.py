@@ -35,6 +35,13 @@ Tasks:
     mesh via its existing ``mesh=`` seam (PR 12 follow-on): solves a
     two-stage instance with the vmapped per-scenario blocks spanning
     processes and returns the objective for equivalence checks.
+
+``sparse_rows``
+    The matrix-free sparse-iterative backend's row shards over the
+    global mesh (ISSUE 19): hybrid-ELL row blocks per rank, CG on the
+    psum-reduced normal operator where only the n-vector reduction
+    crosses processes. Returns objective + cg_report fields for the
+    2-/4-process equivalence checks.
 """
 
 from __future__ import annotations
@@ -146,6 +153,42 @@ def bucket_probe(world: World, spec: dict) -> dict:
         "objectives_second": objectives[1],
         "warm_recompiles": int(compiled_warm),
         "bucket_cache_sizes": sizes,
+    }
+
+
+@task("sparse_rows")
+def sparse_rows(world: World, spec: dict) -> dict:
+    from distributedlpsolver_tpu.backends.sparse_iterative import (
+        SparseIterativeBackend,
+    )
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.ipm.driver import solve
+    from distributedlpsolver_tpu.models.generators import storm_sparse_lp
+
+    problem = storm_sparse_lp(
+        int(spec.get("scenarios", 6)),
+        block_m=int(spec.get("block_m", 24)),
+        block_n=int(spec.get("block_n", 36)),
+        first_stage_n=int(spec.get("first_stage_n", 24)),
+        seed=int(spec.get("seed", 3)),
+    )
+    cfg = SolverConfig(tol=float(spec.get("tol", 1e-8)), verbose=False)
+    # Hybrid-ELL row blocks shard over the GLOBAL mesh (ops/sparse.
+    # shard_rows through the backend's mesh= seam): each rank's CG
+    # iteration runs its local ELL products and the one n-vector psum
+    # of the normal matvec crosses the process boundary.
+    be = SparseIterativeBackend(mesh=world.mesh(axis="batch"))
+    result = solve(problem, backend=be, config=cfg)
+    rep = be.cg_report()
+    return {
+        "status": result.status.value,
+        "objective": result.objective,
+        "iterations": result.iterations,
+        "cg_iters": rep["cg_iters"],
+        "shards": rep["shards"],
+        "psum_per_iter": rep["psum_per_iter"],
+        "precond": rep["precond"],
+        "max_operand_per_device": be.max_operand_nbytes(per_device=True),
     }
 
 

@@ -1,0 +1,371 @@
+"""Incomplete-LDLᵀ (IC(0)-class) preconditioner on the normal-equation
+pattern — the rung between diag-Jacobi and falling off to cpu-sparse.
+
+Unstructured ill-conditioned endgames have no bordered/block hint, so
+the sparse-iterative tier preconditions with diag-Jacobi; when the
+normal matrix M = A·diag(d)·Aᵀ + reg·I develops strong off-diagonal
+coupling at small μ, jacobi-PCG grinds to its iteration cap and the
+serve ladder degrades the instance to the cpu-sparse backend. This
+module closes that gap with a zero-fill incomplete LDLᵀ factor on the
+SPARSITY PATTERN of A·Aᵀ (incomplete-factorization preconditioning for
+IPMs per arXiv 1708.04298; clean-room fixed-shape variant):
+
+* **Symbolic phase (host, once per pattern):** every column c of A is a
+  clique of rows; the per-column row pairs enumerate exactly the
+  nonzero positions of M and their product terms A_ic·A_jc. These
+  flatten into static index arrays, so the numeric phase is pure
+  ``segment_sum`` — jittable, fixed shapes, and M is never materialized
+  as a matrix (only its O(nnz(pattern)) value vector). The symbolic
+  phase also level-schedules the factorization DAG: column j of L
+  depends only on columns k < j sharing pattern with row j, so columns
+  at the same level finalize simultaneously.
+* **Numeric phase (jitted, per factor):** EXACT shifted IC(0) via a
+  ``fori_loop`` over the (static) level count — each iteration runs the
+  same two segment-sums and commits exactly the columns of that level,
+  so the loop reproduces sequential up-looking factorization without
+  data-dependent shapes. Fixed-point ("Chow–Patel") simultaneous sweeps
+  were tried first and diverge on precisely the ill-conditioned
+  endgames this rung exists for; the level schedule costs depth×O(nnz)
+  but is exact and unconditionally stable.
+* **Robustness:** the factor is computed on the symmetrically SCALED
+  matrix S·M·S (unit diagonal, S = diag(M)^{-1/2}) with a Manteuffel
+  diagonal shift α — zero-fill factorization of a general SPD matrix
+  can break down (negative D); the shift absorbs the dropped fill
+  (measured: α≈0.3 eliminates all breakdowns on the netlib-like family
+  while keeping max|L| < 1). Any residual breakdown clamps D locally to
+  the shifted diagonal — a per-row jacobi fallback that keeps D > 0.
+* **Apply (jitted):** truncated Neumann triangular solves. With
+  L = I + N (N strictly lower, entries < 1 after scaling+shift),
+  K = Σ_{t<T} (−N)ᵗ ≈ L⁻¹ and the apply is
+  ``P⁻¹ r = S·Kᵀ·D⁻¹·K·S·r`` — symmetric positive definite for ANY
+  truncation depth (K is unit-triangular, hence nonsingular), so CG's
+  convergence theory stays intact even when the truncation is rough
+  (measured: T=6 matches exact triangular solves on the target family).
+
+Everything on the device is O(nnz(pattern)); the preconditioner refuses
+patterns whose product-term count explodes (dense-ish AAᵀ or
+clique-heavy columns) by raising ValueError — callers treat that as
+"stay on jacobi", not an error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+# Refuse symbolic setup beyond these sizes: the per-column cliques give
+# Σ_c |rows(c)|² product terms, which explodes on dense-ish columns
+# (e.g. bordered first-stage columns — those instances have the
+# structured preconditioners anyway).
+_MAX_PRODUCT_TERMS = 4_000_000
+_MAX_FILL_TERMS = 8_000_000
+_MAX_ROWS = 16_384
+
+# Manteuffel diagonal shift on the scaled (unit-diagonal) matrix: the
+# factor computed is IC0(S·M·S + α·I). α=0.3 eliminates breakdowns on
+# the netlib-like endgame family while keeping max|L| < 1 (so the
+# Neumann apply converges fast); the preconditioner mismatch it
+# introduces costs a few CG iterations, far less than breakdown costs.
+DEFAULT_SHIFT = 0.3
+
+# Neumann terms per triangular solve in the apply. T=6 reproduces the
+# exact-substitution iteration counts on the target family.
+DEFAULT_TRI_SWEEPS = 6
+
+# Residual-breakdown clamp: a diagonal update at or below this resets
+# to the shifted unit diagonal — the local jacobi fallback.
+_D_FLOOR = 1e-10
+
+
+def _pattern_terms(A: sp.csr_matrix):
+    """Host symbolic phase: flatten the normal-matrix pattern of A·Aᵀ,
+    its product/fill term lists, and the factorization level schedule
+    into static index arrays (see ILDLPrecond fields)."""
+    A = sp.csr_matrix(A)
+    m, _ = A.shape
+    if m > _MAX_ROWS:
+        raise ValueError(f"ildl: {m} rows exceeds the {_MAX_ROWS} cap")
+    Ac = A.tocsc()
+
+    # --- product terms: one per (row-pair, column) clique membership ---
+    ti, tj, tv, tc = [], [], [], []
+    di, dv, dc = [], [], []
+    budget = 0
+    for c in range(Ac.shape[1]):
+        lo, hi = Ac.indptr[c], Ac.indptr[c + 1]
+        rows = Ac.indices[lo:hi].astype(np.int64)
+        vals = Ac.data[lo:hi]
+        r = len(rows)
+        budget += r * r
+        if budget > _MAX_PRODUCT_TERMS:
+            raise ValueError("ildl: product-term budget exceeded")
+        di.append(rows)
+        dv.append(vals * vals)
+        dc.append(np.full(r, c, dtype=np.int64))
+        if r < 2:
+            continue
+        ii = np.repeat(rows, r).reshape(r, r)
+        vv = np.multiply.outer(vals, vals)
+        low = ii > ii.T
+        ti.append(ii[low])
+        tj.append(ii.T[low])
+        tv.append(vv[low])
+        tc.append(np.full(int(low.sum()), c, dtype=np.int64))
+
+    d_seg = np.concatenate(di) if di else np.zeros(0, dtype=np.int64)
+    d_coef = np.concatenate(dv) if dv else np.zeros(0)
+    d_col = np.concatenate(dc) if dc else np.zeros(0, dtype=np.int64)
+
+    if ti:
+        p_i = np.concatenate(ti)
+        p_j = np.concatenate(tj)
+        p_coef = np.concatenate(tv)
+        p_col = np.concatenate(tc)
+    else:
+        p_i = np.zeros(0, dtype=np.int64)
+        p_j = np.zeros(0, dtype=np.int64)
+        p_coef = np.zeros(0)
+        p_col = np.zeros(0, dtype=np.int64)
+
+    # Unique strictly-lower pattern entries (i > j), in (i, j) order.
+    key = p_i * m + p_j
+    uniq, inv = np.unique(key, return_inverse=True)
+    l_i = (uniq // m).astype(np.int32)
+    l_j = (uniq % m).astype(np.int32)
+    nl = len(uniq)
+
+    # --- fill terms for the factorization updates ---
+    # For entry e=(i,j): pairs (a,b) of lower-entry indices with
+    # a=(i,k), b=(j,k), k<j. For diagonal i: entries a=(i,k), k<i.
+    pos = {(int(i), int(j)): e for e, (i, j) in enumerate(zip(l_i, l_j))}
+    nbr = [[] for _ in range(m)]  # nbr[i] = ks with (i,k) in L, k<i
+    for i, j in zip(l_i, l_j):
+        nbr[int(i)].append(int(j))
+    f_a, f_b, f_k, f_seg = [], [], [], []
+    g_a, g_k, g_seg = [], [], []
+    fill = 0
+    for e in range(nl):
+        i, j = int(l_i[e]), int(l_j[e])
+        ks = np.intersect1d(
+            np.asarray(nbr[i], dtype=np.int64),
+            np.asarray(nbr[j], dtype=np.int64),
+            assume_unique=False,
+        )
+        ks = ks[ks < j]
+        fill += len(ks)
+        if fill > _MAX_FILL_TERMS:
+            raise ValueError("ildl: fill-term budget exceeded")
+        for k in ks:
+            f_a.append(pos[(i, int(k))])
+            f_b.append(pos[(j, int(k))])
+            f_k.append(int(k))
+            f_seg.append(e)
+    for i in range(m):
+        for k in nbr[i]:
+            g_a.append(pos[(i, k)])
+            g_k.append(k)
+            g_seg.append(i)
+
+    # --- level schedule: column j finalizes one step after the deepest
+    # column its row touches (columns with empty rows are level 0) ---
+    lvl = np.zeros(m, dtype=np.int32)
+    for j in range(m):
+        lvl[j] = 1 + max((lvl[k] for k in nbr[j]), default=-1)
+    depth = int(lvl.max()) + 1 if m else 0
+
+    asi32 = lambda x: np.asarray(x, dtype=np.int32)
+    return {
+        "m": m,
+        "nl": nl,
+        "depth": depth,
+        "l_i": l_i,
+        "l_j": l_j,
+        "lvl": lvl,
+        "d_seg": asi32(d_seg),
+        "d_coef": d_coef,
+        "d_col": asi32(d_col),
+        "p_seg": asi32(inv),
+        "p_coef": p_coef,
+        "p_col": asi32(p_col),
+        "f_a": asi32(f_a),
+        "f_b": asi32(f_b),
+        "f_k": asi32(f_k),
+        "f_seg": asi32(f_seg),
+        "g_a": asi32(g_a),
+        "g_k": asi32(g_k),
+        "g_seg": asi32(g_seg),
+    }
+
+
+class ILDLPrecond:
+    """Incomplete-LDLᵀ preconditioner of A·diag(d)·Aᵀ + reg·I.
+
+    Same ``factor(d, reg)`` / ``apply_with(factors)`` protocol as
+    :class:`ops.pcg.BlockJacobi`; registered as a pytree so it rides
+    the jitted step programs as an ordinary operand. Factors are the
+    triple ``(Lvals, D, S)`` — strictly-lower values on the static
+    pattern, the positive diagonal, and the symmetric scaling.
+    """
+
+    def __init__(
+        self,
+        A_csr: sp.csr_matrix,
+        dtype=np.float64,
+        shift: float = DEFAULT_SHIFT,
+        tri_sweeps: int = DEFAULT_TRI_SWEEPS,
+    ):
+        t = _pattern_terms(A_csr)
+        self.m = t["m"]
+        self.nl = t["nl"]
+        self.depth = t["depth"]
+        self.shift = float(shift)
+        self.tri_sweeps = int(tri_sweeps)
+        j = jnp.asarray
+        self.l_i = j(t["l_i"])
+        self.l_j = j(t["l_j"])
+        self.lvl = j(t["lvl"])
+        self.lvl_e = j(t["lvl"][t["l_j"]])
+        self.d_seg = j(t["d_seg"])
+        self.d_coef = j(t["d_coef"].astype(dtype))
+        self.d_col = j(t["d_col"])
+        self.p_seg = j(t["p_seg"])
+        self.p_coef = j(t["p_coef"].astype(dtype))
+        self.p_col = j(t["p_col"])
+        self.f_a = j(t["f_a"])
+        self.f_b = j(t["f_b"])
+        self.f_k = j(t["f_k"])
+        self.f_seg = j(t["f_seg"])
+        self.g_a = j(t["g_a"])
+        self.g_k = j(t["g_k"])
+        self.g_seg = j(t["g_seg"])
+
+    # -- numeric factorization (jittable) --------------------------------
+
+    def factor(self, d, reg):
+        """d (n,) → ``(Lvals, D, S)``: exact level-scheduled shifted
+        IC(0) of S·(A·diag(d)·Aᵀ + reg·I)·S + α·I."""
+        seg = jax.ops.segment_sum
+        s_diag = (
+            seg(self.d_coef * d[self.d_col], self.d_seg,
+                num_segments=self.m)
+            + reg
+        )
+        s_low = seg(
+            self.p_coef * d[self.p_col], self.p_seg, num_segments=self.nl
+        )
+        S = 1.0 / jnp.sqrt(s_diag)
+        sh = s_low * S[self.l_i] * S[self.l_j]
+        dg = 1.0 + self.shift
+
+        def body(s, LD):
+            L, D = LD
+            # Diagonals of this level: their row entries are all in
+            # earlier-level columns, already final.
+            rn2 = seg(
+                L[self.g_a] * L[self.g_a] * D[self.g_k], self.g_seg,
+                num_segments=self.m,
+            )
+            Dn = dg - rn2
+            Dn = jnp.where(Dn > _D_FLOOR, Dn, dg)  # breakdown fallback
+            D = jnp.where(self.lvl == s, Dn, D)
+            # Column entries of this level: need D_j (just committed)
+            # and pairs of earlier-level entries.
+            corr = seg(
+                L[self.f_a] * L[self.f_b] * D[self.f_k], self.f_seg,
+                num_segments=self.nl,
+            )
+            Ln = (sh - corr) / D[self.l_j]
+            L = jnp.where(self.lvl_e == s, Ln, L)
+            return (L, D)
+
+        L0 = jnp.zeros((self.nl,), dtype=sh.dtype)
+        D0 = jnp.full((self.m,), dg, dtype=sh.dtype)
+        L, D = jax.lax.fori_loop(0, self.depth, body, (L0, D0))
+        return L, D, S
+
+    # -- apply (jittable) -------------------------------------------------
+
+    def _napply(self, L, x):
+        """N·x with N the strictly-lower part: out[i] += L_e · x[j]."""
+        out = jnp.zeros((self.m,), dtype=x.dtype)
+        return out.at[self.l_i].add(L * x[self.l_j])
+
+    def _ntapply(self, L, x):
+        """Nᵀ·x: out[j] += L_e · x[i]."""
+        out = jnp.zeros((self.m,), dtype=x.dtype)
+        return out.at[self.l_j].add(L * x[self.l_i])
+
+    def _neumann(self, nap, L, r):
+        """K·r = Σ_{t<T} (−N)ᵗ r — the truncated triangular solve."""
+        acc = r
+        term = r
+        for _ in range(self.tri_sweeps - 1):
+            term = -nap(L, term)
+            acc = acc + term
+        return acc
+
+    def apply_with(self, factors):
+        L, D, S = factors
+
+        def one(r):
+            z = self._neumann(self._napply, L, S * r)
+            z = z / D
+            return S * self._neumann(self._ntapply, L, z)
+
+        def apply(r):
+            if r.ndim == 2:
+                return jax.vmap(one)(r)
+            return one(r)
+
+        return apply
+
+    # -- reporting --------------------------------------------------------
+
+    def nbytes(self) -> int:
+        return sum(
+            int(a.size) * a.dtype.itemsize for a in self._tree_flatten()[0]
+        )
+
+    def memory_report(self) -> dict:
+        return {
+            "ildl_pattern": {
+                "shape": (self.nl,),
+                "nbytes": self.nbytes(),
+            }
+        }
+
+    # pytree protocol (matches BlockJacobi's — an ILDL preconditioner is
+    # an ordinary traced operand of the jitted IPM step programs).
+    def _tree_flatten(self):
+        children = (
+            self.l_i, self.l_j, self.lvl, self.lvl_e,
+            self.d_seg, self.d_coef, self.d_col,
+            self.p_seg, self.p_coef, self.p_col,
+            self.f_a, self.f_b, self.f_k, self.f_seg,
+            self.g_a, self.g_k, self.g_seg,
+        )
+        aux = (self.m, self.nl, self.depth, self.shift, self.tri_sweeps)
+        return children, aux
+
+    @classmethod
+    def _tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        obj.m, obj.nl, obj.depth, obj.shift, obj.tri_sweeps = aux
+        (
+            obj.l_i, obj.l_j, obj.lvl, obj.lvl_e,
+            obj.d_seg, obj.d_coef, obj.d_col,
+            obj.p_seg, obj.p_coef, obj.p_col,
+            obj.f_a, obj.f_b, obj.f_k, obj.f_seg,
+            obj.g_a, obj.g_k, obj.g_seg,
+        ) = children
+        return obj
+
+
+jax.tree_util.register_pytree_node(
+    ILDLPrecond,
+    lambda o: o._tree_flatten(),
+    ILDLPrecond._tree_unflatten,
+)

@@ -58,7 +58,26 @@ _STALL_WINDOW = 96
 _STALL_FACTOR = 0.999  # an iteration must beat best·this to count as progress
 
 
-def pcg(op, prec, rhs, tol, max_iter):
+def _pin(x, mesh, axis, batched=False):
+    """Constrain a CG carry vector's layout to the mesh row split —
+    a no-op off-mesh, so single-device programs are untouched. Under a
+    mesh this pins every while_loop carry to the same sharding as the
+    operator's flat vectors, keeping the whole solve ONE SPMD program
+    whose only collectives are the operator's psum and the scalar dots.
+    """
+    if mesh is None:
+        return x
+    spec = (
+        jax.sharding.PartitionSpec(None, axis)
+        if batched
+        else jax.sharding.PartitionSpec(axis)
+    )
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec)
+    )
+
+
+def pcg(op, prec, rhs, tol, max_iter, mesh=None, axis=None):
     """Preconditioned CG; returns ``(x, iters)``.
 
     ``op``/``prec`` are matrix-free callables. Terminates at relative
@@ -66,12 +85,15 @@ def pcg(op, prec, rhs, tol, max_iter):
     curvature) or a cap-limited run that failed to meaningfully reduce
     the residual returns NaN — the caller's bad-step ladder must see the
     failure, not a noise direction (same contract as core.pcg_solve).
+    ``mesh=``/``axis=`` pin the carry vectors to the row-shard layout of
+    a distributed operator (see :func:`_pin`).
     """
+    rhs = _pin(rhs, mesh, axis)
     norm0 = jnp.linalg.norm(rhs)
     thresh = tol * norm0
 
     x0 = jnp.zeros_like(rhs)
-    z0 = prec(rhs)
+    z0 = _pin(prec(rhs), mesh, axis)
     zero_i = jnp.asarray(0, jnp.int32)
     carry0 = (x0, rhs, z0, rhs @ z0, zero_i, norm0, zero_i)
 
@@ -109,7 +131,8 @@ def pcg(op, prec, rhs, tol, max_iter):
     return jnp.where(bad, jnp.asarray(jnp.nan, x.dtype), x), it
 
 
-def pcg_batched(op, prec, rhs, tol, max_iter, active=None):
+def pcg_batched(op, prec, rhs, tol, max_iter, active=None, mesh=None,
+                axis=None):
     """Batched PCG over (B, m) lanes with per-lane early exit.
 
     One ``lax.while_loop`` drives every lane; a lane leaves the active
@@ -117,7 +140,10 @@ def pcg_batched(op, prec, rhs, tol, max_iter, active=None):
     or it breaks down, and frozen lanes stop contributing work beyond
     the masked arithmetic. Returns ``(X, iters, ok)``: per-lane
     solutions (NaN where failed), iteration counts, and success flags.
+    ``mesh=``/``axis=`` pin the (B, m) carries to a row-sharded m axis
+    (lanes replicated) so the batch stays one SPMD program per chunk.
     """
+    rhs = _pin(rhs, mesh, axis, batched=True)
     B, m = rhs.shape
     dtype = rhs.dtype
     tol = jnp.broadcast_to(jnp.asarray(tol, dtype), (B,))
@@ -127,7 +153,7 @@ def pcg_batched(op, prec, rhs, tol, max_iter, active=None):
     thresh = tol * norm0
 
     X0 = jnp.zeros_like(rhs)
-    Z0 = prec(rhs)
+    Z0 = _pin(prec(rhs), mesh, axis, batched=True)
     rz0 = jnp.sum(rhs * Z0, axis=1)
     carry0 = (
         X0, rhs, Z0, rz0,
@@ -179,21 +205,31 @@ def pcg_batched(op, prec, rhs, tol, max_iter, active=None):
     return X, it, ~bad
 
 
-def solve_chunked(solve_fn, rhs, chunk: int = CHUNK_WIDTH):
+def solve_chunked(solve_fn, rhs, chunk: int = CHUNK_WIDTH, mesh=None):
     """Split a (B, m) batched solve into ≤``chunk``-lane programs and
     concatenate — wide fan-ins never grow one device program past the
     healthy width. ``solve_fn(rhs_chunk) -> (X, iters, ok)``. The last
     partial chunk is zero-padded to the chunk width (one compiled
-    program per width, not per remainder)."""
+    program per width, not per remainder). Under ``mesh=`` the pad
+    lanes are committed to the chunk's own sharding before the
+    concatenate, so every rank pads identically (no divergent
+    placement between the full and remainder chunks)."""
     B = rhs.shape[0]
     outs = []
     for lo in range(0, B, chunk):
         part = rhs[lo : lo + chunk]
         pad = chunk - part.shape[0] if B > chunk else 0
         if pad > 0:
-            part = jnp.concatenate(
-                [part, jnp.zeros((pad,) + part.shape[1:], part.dtype)]
+            zeros_np = np.zeros(
+                (pad,) + tuple(part.shape[1:]), dtype=part.dtype
             )
+            if mesh is not None:
+                pad_lanes = jax.device_put(zeros_np, part.sharding)
+            else:
+                pad_lanes = jnp.zeros(
+                    (pad,) + part.shape[1:], part.dtype
+                )
+            part = jnp.concatenate([part, pad_lanes])
         X, it, ok = solve_fn(part)
         if pad > 0:
             X, it, ok = X[:-pad], it[:-pad], ok[:-pad]

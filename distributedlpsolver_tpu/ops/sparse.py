@@ -278,6 +278,59 @@ def _quantize(k: int, q: int) -> int:
     return max(q, -(-k // q) * q)
 
 
+def _hybrid_width(A: sp.csr_matrix) -> int:
+    """Quantized ``_WIDTH_QUANTILE`` ELL width for CSR ``A`` — the shape
+    probe, split out so the row shards can agree on one common width."""
+    m = A.shape[0]
+    counts = np.diff(A.indptr)
+    kmax = int(counts.max(initial=0))
+    kq = int(np.quantile(counts, _WIDTH_QUANTILE)) if m else 0
+    k = _quantize(max(kq, 1), _PAD_QUANTUM)
+    if k >= kmax:
+        k = _quantize(max(kmax, 1), _PAD_QUANTUM)
+    return k
+
+
+def _hybrid_fill(A: sp.csr_matrix, dtype, k, t, rows_out, pad_row):
+    """Hybrid row-ELL of CSR ``A`` at FORCED shapes: an (rows_out, k)
+    ELL block (rows beyond A's are all-pad) plus a COO tail of exactly
+    ``t`` entries (``t == 0`` → no tail; pad tail entries point at
+    ``pad_row`` with value 0). The forced-shape builder lets every row
+    shard of a distributed operator run one program shape regardless of
+    which shard drew the heavy rows."""
+    m = A.shape[0]
+    counts = np.diff(A.indptr)
+    # Position of each nonzero within its row, vectorized.
+    offs = np.arange(A.nnz, dtype=np.int64) - np.repeat(
+        A.indptr[:-1].astype(np.int64), counts
+    )
+    rowidx = np.repeat(np.arange(m, dtype=np.int64), counts)
+    main = offs < k
+
+    vals = np.zeros((rows_out, k), dtype=dtype)
+    cols = np.zeros((rows_out, k), dtype=np.int32)
+    vals[rowidx[main], offs[main]] = A.data[main]
+    cols[rowidx[main], offs[main]] = A.indices[main]
+
+    if t == 0:
+        return vals, cols, None, None, None
+    spill = ~main
+    t_live = int(spill.sum())
+    tail_vals = np.zeros((t,), dtype=dtype)
+    tail_rows = np.full((t,), pad_row, dtype=np.int32)
+    tail_cols = np.zeros((t,), dtype=np.int32)
+    tail_vals[:t_live] = A.data[spill]
+    tail_rows[:t_live] = rowidx[spill]
+    tail_cols[:t_live] = A.indices[spill]
+    return vals, cols, tail_vals, tail_rows, tail_cols
+
+
+def _tail_len(A: sp.csr_matrix, k: int) -> int:
+    """Live spill-tail length of CSR ``A`` at ELL width ``k``."""
+    counts = np.diff(A.indptr)
+    return int(np.maximum(counts - k, 0).sum())
+
+
 def _hybrid_from_csr(A: sp.csr_matrix, dtype):
     """(vals, cols, tail_vals, tail_rows, tail_cols) hybrid row-ELL of a
     CSR matrix. ELL width is the quantized ``_WIDTH_QUANTILE`` of the
@@ -286,37 +339,10 @@ def _hybrid_from_csr(A: sp.csr_matrix, dtype):
     0). ELL pad entries point at column 0 with value 0 — the matvec
     gather stays in bounds and the padded products vanish."""
     m = A.shape[0]
-    counts = np.diff(A.indptr)
-    kmax = int(counts.max(initial=0))
-    kq = int(np.quantile(counts, _WIDTH_QUANTILE)) if m else 0
-    k = _quantize(max(kq, 1), _PAD_QUANTUM)
-    if k >= kmax:
-        k = _quantize(max(kmax, 1), _PAD_QUANTUM)
-
-    # Position of each nonzero within its row, vectorized.
-    offs = np.arange(A.nnz, dtype=np.int64) - np.repeat(
-        A.indptr[:-1].astype(np.int64), counts
-    )
-    rowidx = np.repeat(np.arange(m, dtype=np.int64), counts)
-    main = offs < k
-
-    vals = np.zeros((m, k), dtype=dtype)
-    cols = np.zeros((m, k), dtype=np.int32)
-    vals[rowidx[main], offs[main]] = A.data[main]
-    cols[rowidx[main], offs[main]] = A.indices[main]
-
-    spill = ~main
-    t_live = int(spill.sum())
-    if t_live == 0:
-        return vals, cols, None, None, None
-    t = _quantize(t_live, _TAIL_QUANTUM)
-    tail_vals = np.zeros((t,), dtype=dtype)
-    tail_rows = np.full((t,), m, dtype=np.int32)  # pad → synthetic row m
-    tail_cols = np.zeros((t,), dtype=np.int32)
-    tail_vals[:t_live] = A.data[spill]
-    tail_rows[:t_live] = rowidx[spill]
-    tail_cols[:t_live] = A.indices[spill]
-    return vals, cols, tail_vals, tail_rows, tail_cols
+    k = _hybrid_width(A)
+    t_live = _tail_len(A, k)
+    t = _quantize(t_live, _TAIL_QUANTUM) if t_live else 0
+    return _hybrid_fill(A, dtype, k, t, m, m)
 
 
 def from_scipy(
@@ -462,3 +488,413 @@ def _unflatten(aux, children):
 
 
 jax.tree_util.register_pytree_node(SparseOperator, _flatten, _unflatten)
+
+
+# ===========================================================================
+# Row-distributed tier: RowShardedOperator + shard_rows
+# ===========================================================================
+#
+# The SDSL design (PAPERS.md, arXiv 2604.23979): partition A's ROWS over
+# the mesh, keep every product local to its shard, and let exactly one
+# n-vector collective per normal-operator application carry the coupling:
+#
+#     v ↦ psum_r( A_r · (d ∘ A_rᵀ v) ) + reg·v
+#
+# Each shard holds a hybrid row-ELL block padded to a common row count
+# ``mb_pad`` (one program shape on every rank); ELL/tail widths are the
+# max over shards, quantized, so the stacked (R, mb_pad, k) arrays shard
+# cleanly along the leading axis via ``batch_sharding``. Column indices
+# stay GLOBAL int32 — the n-sized vectors (v, d, rmatvec output) are
+# replicated, so local gathers index them directly. The transpose hybrid
+# is per-shard with LOCAL row indices; its (R, n, kt) partial products
+# reduce over the shard axis — that ``jnp.sum(·, axis=0)`` over a
+# mesh-sharded leading axis IS the psum (XLA inserts the all-reduce),
+# and it is the only collective in the distributed normal matvec.
+# ADAᵀ is still never materialized — now per-shard.
+
+
+@dataclasses.dataclass(frozen=True)
+class RowShardedOperator:
+    """Row-distributed hybrid-ELL operator over a device mesh.
+
+    Children are stacked per-shard arrays with the shard axis leading;
+    m-sized vectors travel FLAT as (R·mb_pad,) = ``m_pad`` arrays
+    sharded along the same mesh axis (shard r owns slots
+    [r·mb_pad, (r+1)·mb_pad)), so a reshape to (R, mb_pad) is free and
+    local. ``row_map`` (replicated) sends global row i to its padded
+    flat slot; ``row_ok`` masks the pad rows. Registered as a pytree
+    with the (hashable) mesh in the treedef aux — jit keys one program
+    per (shapes, mesh) automatically.
+    """
+
+    shape: Tuple[int, int]
+    nnz: int
+    fmt: str  # "ell" | "dense"
+    num_shards: int
+    rows_per: int  # global rows per shard (last shard may own fewer)
+    mb_pad: int  # padded per-shard row count (common program shape)
+    mesh: Optional[object] = None  # jax.sharding.Mesh (hashable) | None
+    axis: Optional[str] = None
+    vals: Optional[jnp.ndarray] = None  # (R, mb_pad, k)
+    cols: Optional[jnp.ndarray] = None  # (R, mb_pad, k) int32, GLOBAL
+    tail_vals: Optional[jnp.ndarray] = None  # (R, t)
+    tail_rows: Optional[jnp.ndarray] = None  # (R, t) int32 LOCAL, pad → mb_pad
+    tail_cols: Optional[jnp.ndarray] = None  # (R, t) int32 GLOBAL
+    tvals: Optional[jnp.ndarray] = None  # (R, n, kt)
+    tcols: Optional[jnp.ndarray] = None  # (R, n, kt) int32 LOCAL row
+    ttail_vals: Optional[jnp.ndarray] = None  # (R, tt)
+    ttail_rows: Optional[jnp.ndarray] = None  # (R, tt) int32 out-row, pad → n
+    ttail_cols: Optional[jnp.ndarray] = None  # (R, tt) int32 LOCAL row
+    dense: Optional[jnp.ndarray] = None  # (R, mb_pad, n) fallback
+    row_map: Optional[jnp.ndarray] = None  # (m,) int32, replicated
+    row_ok: Optional[jnp.ndarray] = None  # (R, mb_pad) bool
+
+    @property
+    def m(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n(self) -> int:
+        return self.shape[1]
+
+    @property
+    def m_pad(self) -> int:
+        return self.num_shards * self.mb_pad
+
+    @property
+    def dtype(self):
+        return self.dense.dtype if self.fmt == "dense" else self.vals.dtype
+
+    def _constrain_flat(self, x):
+        """Pin an (m_pad,) vector's layout to the row-shard split."""
+        if self.mesh is None:
+            return x
+        sh = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(self.axis)
+        )
+        return jax.lax.with_sharding_constraint(x, sh)
+
+    # -- local products (jittable; self is a pytree operand) ------------
+
+    def matvec_local(self, v):
+        """A_r @ v per shard: (n,) replicated → (R, mb_pad) local.
+        Pure gathers + reductions; no collective."""
+        if self.fmt == "dense":
+            return jnp.einsum("rmn,n->rm", self.dense, v)
+        out = jnp.sum(self.vals * v[self.cols], axis=2)
+        if self.tail_vals is None:
+            return out
+        R = self.num_shards
+        pad = jnp.zeros((R, 1), dtype=out.dtype)
+        acc = jnp.concatenate([out, pad], axis=1)
+        acc = acc.at[jnp.arange(R)[:, None], self.tail_rows].add(
+            self.tail_vals * v[self.tail_cols]
+        )
+        return acc[:, :-1]
+
+    def rmatvec_partial(self, y_flat):
+        """Per-shard A_rᵀ y_r: (m_pad,) sharded → (R, n) partial sums.
+        Still no collective — callers reduce over axis 0."""
+        R = self.num_shards
+        y2 = y_flat.reshape(R, self.mb_pad)
+        if self.fmt == "dense":
+            return jnp.einsum("rmn,rm->rn", self.dense, y2)
+        gathered = y2[jnp.arange(R)[:, None, None], self.tcols]
+        out = jnp.sum(self.tvals * gathered, axis=2)
+        if self.ttail_vals is None:
+            return out
+        pad = jnp.zeros((R, 1), dtype=out.dtype)
+        acc = jnp.concatenate([out, pad], axis=1)
+        contrib = self.ttail_vals * y2[
+            jnp.arange(R)[:, None], self.ttail_cols
+        ]
+        acc = acc.at[jnp.arange(R)[:, None], self.ttail_rows].add(contrib)
+        return acc[:, :-1]
+
+    # -- distributed maps ------------------------------------------------
+
+    def rmatvec_flat(self, y_flat):
+        """Aᵀy for a flat padded m-vector — the ONE collective: the
+        (R, n) partials reduce over the mesh-sharded shard axis, which
+        XLA compiles to a single n-vector all-reduce (psum)."""
+        return jnp.sum(self.rmatvec_partial(y_flat), axis=0)
+
+    def normal_matvec(self, d, reg, v_flat):
+        """The distributed normal-operator seam
+        ``v ↦ psum_r(A_r(d∘A_rᵀv)) + reg·v`` on flat padded m-vectors.
+        Exactly one n-vector rides the collective per application; the
+        m-sized work never leaves its shard. Pad slots stay exactly 0
+        (zero rows, and CG feeds them zero rhs)."""
+        w = self.rmatvec_flat(v_flat)
+        u = self.matvec_local(d * w).reshape(-1)
+        return self._constrain_flat(u + reg * v_flat)
+
+    def normal_diag(self, d, reg=0.0):
+        """diag(A·diag(d)·Aᵀ) + reg as a flat (m_pad,) vector, computed
+        shard-locally (no collective); pad rows get 1.0 so Jacobi stays
+        finite there."""
+        if self.fmt == "dense":
+            sq = jnp.einsum("rmn,n->rm", self.dense * self.dense, d)
+        else:
+            sq = jnp.sum(self.vals * self.vals * d[self.cols], axis=2)
+            if self.tail_vals is not None:
+                R = self.num_shards
+                pad = jnp.zeros((R, 1), dtype=sq.dtype)
+                acc = jnp.concatenate([sq, pad], axis=1)
+                acc = acc.at[jnp.arange(R)[:, None], self.tail_rows].add(
+                    self.tail_vals * self.tail_vals * d[self.tail_cols]
+                )
+                sq = acc[:, :-1]
+        out = jnp.where(self.row_ok, sq + reg, jnp.ones((), dtype=sq.dtype))
+        return self._constrain_flat(out.reshape(-1))
+
+    def embed(self, r):
+        """(m,) global rhs → (m_pad,) flat padded vector on the mesh."""
+        z = jnp.zeros((self.m_pad,), dtype=r.dtype)
+        return self._constrain_flat(z.at[self.row_map].set(r))
+
+    def extract(self, x_flat):
+        """(m_pad,) flat padded vector → (m,) global order."""
+        return x_flat[self.row_map]
+
+    # -- whole-matrix adapters (tests / residuals; not the CG hot path) -
+
+    def matvec(self, v):
+        """A @ v, (n,) → (m,) in global row order."""
+        return self.extract(
+            self._constrain_flat(self.matvec_local(v).reshape(-1))
+        )
+
+    def rmatvec(self, y):
+        """Aᵀ @ y, (m,) global → (n,)."""
+        return self.rmatvec_flat(self.embed(y))
+
+    # -- host-side helpers ----------------------------------------------
+
+    def nbytes(self) -> int:
+        return sum(int(a.size) * a.dtype.itemsize for a in self._arrays())
+
+    def nbytes_per_device(self) -> int:
+        """Max live operand bytes on ONE device: sharded arrays divide
+        by R, replicated ones (row_map) count whole — the quantity the
+        ≈1/N memory-scaling acceptance guard asserts on."""
+        total = 0
+        for name, a in self._named_arrays():
+            if name == "row_map":
+                total += int(a.size) * a.dtype.itemsize
+            else:
+                total += int(a.size) * a.dtype.itemsize // self.num_shards
+        return total
+
+    def memory_report(self) -> dict:
+        """name → {shape, nbytes, nbytes_per_device} — the per-device
+        view of the no-dense-normal-matrix guard."""
+        out = {}
+        for name, a in self._named_arrays():
+            per = int(a.size) * a.dtype.itemsize
+            out[name] = {
+                "shape": tuple(int(s) for s in a.shape),
+                "nbytes": per,
+                "nbytes_per_device": (
+                    per if name == "row_map" else per // self.num_shards
+                ),
+            }
+        return out
+
+    def to_scipy(self) -> sp.csr_matrix:
+        """Exact CSR reconstruction in global row order (tests)."""
+        if self.fmt == "dense":
+            blocks = np.asarray(self.dense, dtype=np.float64)
+            flat = blocks.reshape(self.m_pad, self.n)
+            rows = np.asarray(self.row_map)
+            return sp.csr_matrix(flat[rows])
+        R, mb, k = self.vals.shape
+        vals = np.asarray(self.vals, dtype=np.float64).ravel()
+        cols = np.asarray(self.cols).ravel()
+        rows = np.repeat(np.arange(R * mb), k)  # flat padded row ids
+        if self.tail_vals is not None:
+            tv = np.asarray(self.tail_vals, dtype=np.float64).ravel()
+            tr = (
+                np.asarray(self.tail_rows)
+                + np.arange(R)[:, None] * mb
+            ).ravel()
+            tc = np.asarray(self.tail_cols).ravel()
+            # Pad tail entries point at local row mb → clamp to a dead
+            # flat slot; their values are 0 so the live filter drops them.
+            tr = np.minimum(tr, R * mb)
+            vals = np.concatenate([vals, tv])
+            rows = np.concatenate([rows, tr])
+            cols = np.concatenate([cols, tc])
+        # Invert row_map: flat padded slot → global row (dead slots → m).
+        inv = np.full(R * mb + 1, self.m, dtype=np.int64)
+        inv[np.asarray(self.row_map)] = np.arange(self.m)
+        grow = inv[np.minimum(rows, R * mb)]
+        live = (vals != 0.0) & (grow < self.m)
+        return sp.csr_matrix(
+            (vals[live], (grow[live], cols[live])), shape=self.shape
+        )
+
+    def _named_arrays(self):
+        for name in (
+            "vals", "cols", "tail_vals", "tail_rows", "tail_cols",
+            "tvals", "tcols", "ttail_vals", "ttail_rows", "ttail_cols",
+            "dense", "row_map", "row_ok",
+        ):
+            a = getattr(self, name)
+            if a is not None:
+                yield name, a
+
+    def _arrays(self):
+        return [a for _, a in self._named_arrays()]
+
+
+_RS_CHILD_FIELDS = (
+    "vals", "cols", "tail_vals", "tail_rows", "tail_cols",
+    "tvals", "tcols", "ttail_vals", "ttail_rows", "ttail_cols",
+    "dense", "row_map", "row_ok",
+)
+
+
+def _rs_flatten(op: RowShardedOperator):
+    children = tuple(getattr(op, f) for f in _RS_CHILD_FIELDS)
+    aux = (
+        op.shape, op.nnz, op.fmt, op.num_shards, op.rows_per, op.mb_pad,
+        op.mesh, op.axis,
+    )
+    return children, aux
+
+
+def _rs_unflatten(aux, children):
+    shape, nnz, fmt, num_shards, rows_per, mb_pad, mesh, axis = aux
+    kw = dict(zip(_RS_CHILD_FIELDS, children))
+    return RowShardedOperator(
+        shape=shape, nnz=nnz, fmt=fmt, num_shards=num_shards,
+        rows_per=rows_per, mb_pad=mb_pad, mesh=mesh, axis=axis, **kw
+    )
+
+
+jax.tree_util.register_pytree_node(
+    RowShardedOperator, _rs_flatten, _rs_unflatten
+)
+
+
+def _shard_axis(mesh, axis: Optional[str]) -> str:
+    if axis is not None:
+        return axis
+    return "batch" if "batch" in mesh.axis_names else mesh.axis_names[-1]
+
+
+def shard_rows(
+    op,
+    mesh,
+    dtype=None,
+    axis: Optional[str] = None,
+) -> RowShardedOperator:
+    """Partition a :class:`SparseOperator` (or scipy matrix) row-wise
+    over ``mesh`` into a :class:`RowShardedOperator`.
+
+    Shard r owns the contiguous global rows
+    [r·rows_per, min((r+1)·rows_per, m)) with rows_per = ⌈m/R⌉; every
+    shard's hybrid block is padded to the COMMON quantized row count
+    ``mb_pad`` and the COMMON (max-over-shards, quantized) ELL/tail
+    widths, so all ranks trace one program shape. Host-built arrays are
+    placed through ``put_global``/``batch_sharding`` (the committed
+    single-collective contract); ``row_map`` replicates.
+    """
+    from distributedlpsolver_tpu.parallel import mesh as mesh_lib
+
+    if isinstance(op, SparseOperator):
+        A = op.to_scipy()
+        fmt = op.fmt
+        if dtype is None:
+            dtype = np.dtype(op.dtype)
+    else:
+        A = sp.csr_matrix(op)
+        fmt = "ell"
+        if dtype is None:
+            dtype = np.float64
+    m, n = A.shape
+    nnz = int(A.nnz)
+    ax = _shard_axis(mesh, axis)
+    R = int(mesh.shape[ax])
+    if m < R:
+        raise ValueError(f"cannot shard {m} rows over {R} devices")
+    rows_per = -(-m // R)
+    mb_pad = _quantize(rows_per, _PAD_QUANTUM)
+
+    blocks = [A[r * rows_per : min((r + 1) * rows_per, m)] for r in range(R)]
+    tblocks = [B.T.tocsr() for B in blocks]
+
+    put = mesh_lib.put_global
+    bsh = lambda nd: mesh_lib.batch_sharding(mesh, nd, axis=ax)
+    row_map = (
+        (np.arange(m, dtype=np.int64) // rows_per) * mb_pad
+        + np.arange(m, dtype=np.int64) % rows_per
+    ).astype(np.int32)
+    row_ok = np.zeros((R, mb_pad), dtype=bool)
+    for r, B in enumerate(blocks):
+        row_ok[r, : B.shape[0]] = True
+
+    if fmt == "dense":
+        dense = np.zeros((R, mb_pad, n), dtype=dtype)
+        for r, B in enumerate(blocks):
+            dense[r, : B.shape[0]] = np.asarray(B.todense(), dtype=dtype)
+        return RowShardedOperator(
+            shape=(m, n), nnz=nnz, fmt="dense", num_shards=R,
+            rows_per=rows_per, mb_pad=mb_pad, mesh=mesh, axis=ax,
+            dense=put(dense, bsh(3)),
+            row_map=put(row_map, mesh_lib.replicated(mesh)),
+            row_ok=put(row_ok, bsh(2)),
+        )
+
+    # Common forced widths: max over shards, already quantized by the
+    # probe; tail lengths re-measured at the common ELL width.
+    k = max(_hybrid_width(B) for B in blocks)
+    kt = max(_hybrid_width(T) for T in tblocks)
+    t_live = max(_tail_len(B, k) for B in blocks)
+    tt_live = max(_tail_len(T, kt) for T in tblocks)
+    t = _quantize(t_live, _TAIL_QUANTUM) if t_live else 0
+    tt = _quantize(tt_live, _TAIL_QUANTUM) if tt_live else 0
+
+    vals = np.zeros((R, mb_pad, k), dtype=dtype)
+    cols = np.zeros((R, mb_pad, k), dtype=np.int32)
+    tvs = np.zeros((R, t), dtype=dtype) if t else None
+    trs = np.full((R, t), mb_pad, dtype=np.int32) if t else None
+    tcs = np.zeros((R, t), dtype=np.int32) if t else None
+    tvals = np.zeros((R, n, kt), dtype=dtype)
+    tcols = np.zeros((R, n, kt), dtype=np.int32)
+    ttvs = np.zeros((R, tt), dtype=dtype) if tt else None
+    ttrs = np.full((R, tt), n, dtype=np.int32) if tt else None
+    ttcs = np.zeros((R, tt), dtype=np.int32) if tt else None
+    for r in range(R):
+        v_, c_, tv_, tr_, tc_ = _hybrid_fill(
+            blocks[r], dtype, k, t, mb_pad, mb_pad
+        )
+        vals[r], cols[r] = v_, c_
+        if t:
+            tvs[r], trs[r], tcs[r] = tv_, tr_, tc_
+        v_, c_, tv_, tr_, tc_ = _hybrid_fill(
+            tblocks[r], dtype, kt, tt, n, n
+        )
+        tvals[r], tcols[r] = v_, c_
+        if tt:
+            ttvs[r], ttrs[r], ttcs[r] = tv_, tr_, tc_
+
+    maybe = lambda a, nd: None if a is None else put(a, bsh(nd))
+    return RowShardedOperator(
+        shape=(m, n), nnz=nnz, fmt="ell", num_shards=R,
+        rows_per=rows_per, mb_pad=mb_pad, mesh=mesh, axis=ax,
+        vals=put(vals, bsh(3)),
+        cols=put(cols, bsh(3)),
+        tail_vals=maybe(tvs, 2),
+        tail_rows=maybe(trs, 2),
+        tail_cols=maybe(tcs, 2),
+        tvals=put(tvals, bsh(3)),
+        tcols=put(tcols, bsh(3)),
+        ttail_vals=maybe(ttvs, 2),
+        ttail_rows=maybe(ttrs, 2),
+        ttail_cols=maybe(ttcs, 2),
+        dense=None,
+        row_map=put(row_map, mesh_lib.replicated(mesh)),
+        row_ok=put(row_ok, bsh(2)),
+    )

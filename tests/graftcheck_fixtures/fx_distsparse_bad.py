@@ -1,0 +1,31 @@
+"""Seeded distributed-sparse-tier violations (graftcheck twin test,
+pkg_path backends/fx.py). The row-sharded matrix-free idioms written
+WRONG: an ELL row-block pad buffer riding the x64 flag (dtype-explicit
+x2), an f32 preconditioner-factor narrowing outside the sanctioned
+modules (dtype-narrow), and a default-device rhs entering the
+mesh-programmed PCG (spmd-uncommitted-input) — the exact bug class
+that works on one device and silently misplaces on a pod."""
+
+import jax.numpy as jnp
+
+
+def shard_pad_buffers(r, mb_pad, k):
+    vals = jnp.zeros((r, mb_pad, k))  # dtype-explicit
+    cols = jnp.full((r, mb_pad, k), 0)  # dtype-explicit
+    return vals, cols
+
+
+def shard_local_factor(diag):
+    return (1.0 / diag).astype(jnp.float32)  # dtype-narrow
+
+
+def solve_sharded(mv, prec, b, mesh):
+    # spmd-uncommitted-input: jnp.asarray commits to the default device;
+    # the mesh-programmed pcg then reshuffles every iteration (or
+    # deadlocks a multi-process world).
+    rhs = jnp.asarray(b)
+    return pcg(mv, prec, rhs, 1e-8, 200, mesh=mesh)
+
+
+def pcg(mv, prec, rhs, tol, max_iter, mesh=None):
+    return rhs

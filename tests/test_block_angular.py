@@ -203,3 +203,63 @@ def test_f64c_finisher_solves_to_full_tol(monkeypatch):
     assert r.rel_gap <= 1e-8 and r.pinf <= 1e-8 and r.dinf <= 1e-8
     ref = highs_on_general(p)
     np.testing.assert_allclose(r.objective, ref.fun, rtol=1e-6, atol=1e-7)
+
+
+def test_k_groups_partition_and_program_class():
+    """Lever-4 plumbing: the K-group partitioner covers [0, K) exactly
+    (ragged tail included), and the per-phase program-class stamp names
+    the grouped f64 programs — and ONLY those — as a distinct class."""
+    import jax.numpy as jnp
+
+    from distributedlpsolver_tpu.backends import block_angular as B
+
+    assert B._k_groups(12, 5) == [(0, 5), (5, 5), (10, 2)]
+    assert B._k_groups(12, 0) == [(0, 12)]  # grouping disabled
+    assert B._k_groups(12, 12) == [(0, 12)]  # single group degenerates
+    for K, g in ((1563, 128), (7, 3)):
+        spans = B._k_groups(K, g)
+        assert sum(s for _, s in spans) == K
+        assert spans[0][0] == 0
+        assert all(
+            spans[i][0] + spans[i][1] == spans[i + 1][0]
+            for i in range(len(spans) - 1)
+        )
+    assert B.phase_program_class(1563, jnp.float64) == "float64-kgroup128"
+    assert B.phase_program_class(64, jnp.float64) == "float64-oneshot"
+    # f32 phases NEVER group — the fault class is the big-K f64 kernels.
+    assert B.phase_program_class(1563, jnp.float32) == "float32-oneshot"
+
+
+def test_kgroup_factorize_solve_match_oneshot(monkeypatch):
+    """K-grouped sequential chunking (lever 4) must match the one-shot
+    f64 programs to round-off on BOTH phase paths — the direct ops and
+    the n-chunked f64c finisher — including a group width that does not
+    divide K. Eager comparison: ``_K_GROUP`` is a module global read at
+    trace time, so the two settings must not share a jit cache."""
+    import jax.numpy as jnp
+
+    from distributedlpsolver_tpu.backends import block_angular as B
+    from distributedlpsolver_tpu.models.problem import to_interior_form
+
+    p = block_angular_lp(12, 10, 18, 7, seed=4, sparse=False)
+    inf = to_interior_form(p)
+    t, lay = B.build_tensors(inf, jnp.float64)
+    reg = jnp.asarray(1e-10, jnp.float64)
+    rng = np.random.default_rng(1)
+    d = jnp.asarray(rng.uniform(0.5, 2.0, lay.n))
+    r = jnp.asarray(rng.standard_normal(lay.m))
+
+    monkeypatch.setattr(B, "_K_GROUP", 0)
+    ops_ref = B._block_ops(t, lay, reg, None)
+    x_ref = np.asarray(ops_ref.solve(ops_ref.factorize(d), r))
+    ops_cref = B._block_ops_f64c(t, lay, reg, chunk=7)
+    xc_ref = np.asarray(ops_cref.solve(ops_cref.factorize(d), r))
+
+    monkeypatch.setattr(B, "_K_GROUP", 5)  # ragged: 5 + 5 + 2
+    ops_g = B._block_ops(t, lay, reg, None)
+    x_g = np.asarray(ops_g.solve(ops_g.factorize(d), r))
+    ops_cg = B._block_ops_f64c(t, lay, reg, chunk=7)
+    xc_g = np.asarray(ops_cg.solve(ops_cg.factorize(d), r))
+
+    np.testing.assert_allclose(x_g, x_ref, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(xc_g, xc_ref, rtol=1e-12, atol=1e-12)

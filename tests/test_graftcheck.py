@@ -160,6 +160,28 @@ class TestRuleFamilies:
         )
         assert rules == []
 
+    def test_distsparse_catches_seeded(self):
+        # Row-sharded matrix-free tier: unpinned ELL row-block pad
+        # buffers, an out-of-sanctuary f32 factor narrowing, and a
+        # default-device rhs entering the mesh-programmed PCG.
+        rules, findings = _rules_hit(
+            "fx_distsparse_bad.py", "backends/fx.py"
+        )
+        assert rules == [
+            "dtype-explicit",
+            "dtype-narrow",
+            "spmd-uncommitted-input",
+        ]
+        assert sum(f.rule == "dtype-explicit" for f in findings) == 2
+        assert sum(f.rule == "dtype-narrow" for f in findings) == 1
+        assert sum(f.rule == "spmd-uncommitted-input" for f in findings) == 1
+
+    def test_distsparse_clean_twin_silent(self):
+        # Pinned pad dtypes, f64 factors, put_global/shard_rows-committed
+        # entries, mesh-None-guarded single-device fallback: silent.
+        rules, _ = _rules_hit("fx_distsparse_clean.py", "backends/fx.py")
+        assert rules == []
+
     def test_journal_schema_clean_twin_silent(self):
         # journal_replay / drain / registry_write with catalogued
         # fields + a stamped WAL write: silent.

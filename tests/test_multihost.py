@@ -341,6 +341,55 @@ def test_record_preserves_slice_fields(tmp_path):
     assert entry["last_heartbeat_ts"] > 0
 
 
+@pytest.mark.slow
+def test_sparse_rows_matches_single_process(tmp_path):
+    """ISSUE 19 acceptance seam: the row-sharded matrix-free backend
+    over a 2-process world (hybrid-ELL row blocks per rank, the
+    normal-matvec n-vector psum crossing the process boundary) matches
+    the single-process sparse-iterative solve to 1e-8, with the
+    per-device operand footprint reported per rank.
+
+    Slow tier (PR 17 budget-rebalance precedent): ~60 s of 1-core wall
+    — two worker processes each compile their own SPMD programs. The
+    tier-1-asserted equivalence acceptance is the single-process mesh
+    family in test_sparse_dist.py; run `-m multihost` or `-m slow` to
+    execute the cross-process leg."""
+    from distributedlpsolver_tpu.backends.sparse_iterative import (
+        SparseIterativeBackend,
+    )
+    from distributedlpsolver_tpu.ipm.config import SolverConfig
+    from distributedlpsolver_tpu.ipm.driver import solve
+    from distributedlpsolver_tpu.models.generators import storm_sparse_lp
+
+    spec = {"scenarios": 6, "block_m": 24, "block_n": 36,
+            "first_stage_n": 24, "seed": 3, "tol": 1e-8}
+    p = storm_sparse_lp(6, block_m=24, block_n=36, first_stage_n=24, seed=3)
+    be = SparseIterativeBackend()
+    ref = solve(p, backend=be, config=SolverConfig(tol=1e-8, verbose=False))
+    assert ref.status.value == "optimal"
+
+    res = run_world(
+        "sparse_rows",
+        spec,
+        world_size=2,
+        workdir=str(tmp_path / "w2"),
+        local_devices=2,
+        timeout=240,
+    )
+    assert set(res) == {0, 1}
+    for rank, out in res.items():
+        assert out["status"] == "optimal", (rank, out)
+        assert out["shards"] == 4  # 2 procs × 2 local devices
+        assert out["psum_per_iter"] == 1
+        rel = abs(out["objective"] - ref.objective) / max(
+            1.0, abs(ref.objective)
+        )
+        assert rel <= 1e-8, (rank, out["objective"], ref.objective)
+    # One SPMD program world-wide: identical IPM and CG iteration counts.
+    assert len({out["iterations"] for out in res.values()}) == 1
+    assert len({out["cg_iters"] for out in res.values()}) == 1
+
+
 def test_block_angular_ragged_tail(tmp_path):
     """Block-angular shrink satellite: K blocks NOT divisible by the
     mesh axis re-shard onto the ragged-tail (dead-block-padded) layout

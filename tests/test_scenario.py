@@ -483,11 +483,17 @@ class TestScenarioServe:
         finally:
             svc_b.shutdown()
 
+    @pytest.mark.slow
     def test_kmixed_acceptance_zero_warm_recompiles(self):
         """Acceptance: a 200-request K-mixed stream (buckets 4 and 8)
         runs entirely on warm scenario programs — zero recompiles after
         the two bucket warms — with every verdict OPTIMAL and fair-share
-        units stamped."""
+        units stamped.
+
+        Slow tier (PR 17 budget-rebalance precedent): ~30 s of 1-core
+        wall for the 200-request soak. The zero-recompile invariant
+        itself stays tier-1 via the delta-wave warm-cache test and the
+        sparse/bucket zero-recompile families."""
         from distributedlpsolver_tpu.backends.scenario import (
             scenario_program_cache_size,
             solve_scenario,

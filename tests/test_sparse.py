@@ -299,12 +299,23 @@ class TestInexactIPM:
         Woodbury preconditioner) breaks CG down as a STRUCTURED
         numerical fault, and the supervisor degrades along the chain —
         sparse-iterative's next rung is the sparse-direct host backend,
-        which finishes to 1e-8. No wrong OPTIMAL, no silent drop."""
+        which finishes to 1e-8. No wrong OPTIMAL, no silent drop.
+
+        Pinned to precond="jacobi": under precond="auto" this exact
+        instance now ESCALATES to the incomplete-LDLᵀ preconditioner
+        and finishes on sparse-iterative itself (recorded in
+        BENCH_SPARSE.json; tier-1 exercises a smaller sibling in
+        test_ildl_escalation_rescues_unstructured_endgame) — the
+        degradation rung below remains the envelope when escalation is
+        unavailable."""
+        from distributedlpsolver_tpu.backends.sparse_iterative import (
+            SparseIterativeBackend,
+        )
         from distributedlpsolver_tpu.supervisor import supervised_solve
 
         r = supervised_solve(
             netlib_sparse_lp(120, 220, seed=10),
-            backend="sparse-iterative",
+            backend=SparseIterativeBackend(precond="jacobi"),
             tol=1e-8,
         )
         assert r.status.value == "optimal"

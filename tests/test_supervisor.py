@@ -129,10 +129,19 @@ def test_hang_watchdog_timeout_then_retry(reference_result):
     )
 
 
+@pytest.mark.slow
 def test_persistent_crash_degrades_backend(reference_result):
     """A backend that crashes every attempt climbs the ladder, then the
     supervisor degrades along backends.auto.DEGRADATION_CHAIN and the
-    fallback backend finishes the solve."""
+    fallback backend finishes the solve.
+
+    Slow tier (PR 17 budget-rebalance precedent): ~10 s of 1-core wall
+    for the full every-attempt crash ladder. Ladder exhaustion,
+    watchdog retry, and degradation itself stay tier-1 via
+    test_retries_exhausted_raises_structured_failure,
+    test_ladder_exhausted_without_degradation_raises,
+    test_hang_watchdog_timeout_then_retry, and the sparse
+    unstructured-endgame degradation test."""
     plan = [
         InjectedFault(
             FaultKind.CRASH, iteration=1, backend="tpu", times=None

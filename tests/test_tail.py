@@ -602,16 +602,22 @@ def test_hedge_over_live_backends_cancels_loser_and_releases_units(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# tier-1 smoke: the full multi-process tail acceptance run
+# slow-tier smoke: the full multi-process tail acceptance run
 
 
+@pytest.mark.slow
 def test_probe_tail_smoke():
     """CI satellite: the tail-tolerance acceptance probe — a live
     3-backend plane under a SIGSTOP straggler and a slow-loris leg,
     asserting hedged p99 within 3x healthy, zero lost acks, zero
     duplicate solves, cap/budget reconciliation against the JSONL
-    ledger, and a flat steady-state compile count — runs on every
-    tier-1 pass under a wall budget."""
+    ledger, and a flat steady-state compile count.
+
+    Slow tier (PR 17 budget-rebalance precedent): ~37 s of 1-core
+    wall for the live 3-backend plane. Every behavior the probe
+    exercises — hedge pick/delay/budget, deadline re-stamping,
+    cancellation, drain interplay — stays tier-1 via the 20 unit and
+    live-plane tests above."""
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "probe_tail.py"),
          "--tail-requests", "12", "--budget-s", "240"],
