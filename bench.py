@@ -433,6 +433,108 @@ def _bench_serve(quick: bool) -> dict:
     return row
 
 
+def _bench_obs(args) -> list:
+    """Tracing-overhead A/B: the steady-state serve shape (same stream,
+    seeds, and service config as the in-process serve row) with the
+    distributed-tracing layer OFF (null tracer, no contexts — the
+    baseline every request pays today) vs ON (a live Chrome tracer plus
+    a per-request root TraceContext threaded through submit, the full
+    span-emission path the fleet aggregator consumes). Tracing is
+    host-side bookkeeping by construction — the A/B pins the two
+    figures that claim rests on: warm-path latency overhead (p50) and
+    the warm recompile count (contexts must never reach program
+    identity)."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from distributedlpsolver_tpu.backends.batched import bucket_cache_size
+    from distributedlpsolver_tpu.models.generators import random_request_stream
+    from distributedlpsolver_tpu.obs import trace as obs_trace
+    from distributedlpsolver_tpu.obs.context import new_context
+    from distributedlpsolver_tpu.obs.stats import percentile
+    from distributedlpsolver_tpu.serve import ServiceConfig, SolveService
+
+    n = 48 if args.quick else 200
+    rows = []
+    for mode in ("off", "on"):
+        traced = mode == "on"
+        tmpdir = prev = None
+        trace_events = None
+        if traced:
+            tmpdir = _tempfile.mkdtemp(prefix="dlps-bench-obs-")
+            prev = obs_trace.set_tracer(obs_trace.Tracer(
+                os.path.join(tmpdir, "bench.trace.json"),
+                process_name="bench-obs",
+            ))
+        try:
+            with SolveService(ServiceConfig(batch=8, flush_s=0.02)) as svc:
+                futs = [
+                    svc.submit(p, trace=new_context() if traced else None)
+                    for p in random_request_stream(n, seed=21)
+                ]
+                svc.drain(timeout=1200)
+                cold_ok = sum(
+                    f.result(timeout=60).status.value == "optimal"
+                    for f in futs
+                )
+                cache0 = bucket_cache_size()
+                t0 = time.perf_counter()
+                futs = [
+                    svc.submit(p, trace=new_context() if traced else None)
+                    for p in random_request_stream(n, seed=22)
+                ]
+                svc.drain(timeout=1200)
+                rs = [f.result(timeout=60) for f in futs]
+                wall = time.perf_counter() - t0
+                warm_recompiles = bucket_cache_size() - cache0
+        finally:
+            if traced:
+                tracer = obs_trace.get_tracer()
+                obs_trace.set_tracer(prev)
+                tracer.close()
+                try:
+                    with open(tracer.path) as fh:
+                        trace_events = len(json.load(fh)["traceEvents"])
+                finally:
+                    _shutil.rmtree(tmpdir, ignore_errors=True)
+        lat = sorted(r.total_ms for r in rs)
+        row = {
+            "mode": f"tracing-{mode}",
+            "requests": n,
+            "optimal": sum(r.status.value == "optimal" for r in rs),
+            "cold_optimal": cold_ok,
+            "time_s": round(wall, 4),
+            "rps": round(n / max(wall, 1e-9), 2),
+            "latency_ms_p50": round(percentile(lat, 50), 3),
+            "latency_ms_p99": round(percentile(lat, 99), 3),
+            "warm_recompiles": int(warm_recompiles),
+        }
+        if traced:
+            row["trace_events"] = trace_events
+        rows.append(row)
+        _log(
+            f"  obs[{row['mode']}]: {n} requests at {row['rps']} rps, "
+            f"p50={row['latency_ms_p50']:.1f}ms "
+            f"p99={row['latency_ms_p99']:.1f}ms, "
+            f"warm recompiles={warm_recompiles}"
+            + (f", trace events={trace_events}" if traced else "")
+        )
+    off, on = rows
+    base = max(off["latency_ms_p50"], 1e-9)
+    on["p50_overhead_pct"] = round(
+        100.0 * (on["latency_ms_p50"] - off["latency_ms_p50"]) / base, 2
+    )
+    base99 = max(off["latency_ms_p99"], 1e-9)
+    on["p99_overhead_pct"] = round(
+        100.0 * (on["latency_ms_p99"] - off["latency_ms_p99"]) / base99, 2
+    )
+    _log(
+        f"  obs: tracing-on p50 overhead {on['p50_overhead_pct']:+.2f}% "
+        f"(p99 {on['p99_overhead_pct']:+.2f}%)"
+    )
+    return rows
+
+
 def _bench_serve_http(quick: bool, inproc_row: Optional[dict] = None) -> dict:
     """HTTP-path serving row: the same steady-state request stream as
     the in-process serve row, but submitted over the network plane
@@ -1949,6 +2051,12 @@ def main() -> int:
                     "over a live 3-backend plane with one backend "
                     "SIGSTOPped mid-wave, hedging off vs on (the "
                     "hedging ledger rides the on row) -> BENCH_TAIL.json")
+    ap.add_argument("--obs", action="store_true",
+                    help="tracing-overhead A/B rows: the steady-state "
+                    "serve shape with the distributed-tracing layer off "
+                    "vs on (per-request contexts + live Chrome tracer), "
+                    "pinning p50/p99 overhead and the zero-warm-"
+                    "recompile invariant -> BENCH_OBS.json")
     ap.add_argument("--serve-http", action="store_true",
                     help="serving rows incl. the HTTP network plane: the "
                     "in-process row plus a localhost POST /v1/solve row, "
@@ -2040,6 +2148,18 @@ def main() -> int:
         _log(f"tail rows -> {out}")
         print(json.dumps(rows[-1]))  # headline: the hedging-on row
         return 0  # tail tier is its own run; no headline solve after
+
+    if args.obs:
+        rows = _bench_obs(args)
+        for r in rows:
+            r.setdefault("platform", args.platform)
+            r.setdefault("metrics", _obs_row(args.platform))
+        out = os.path.join(_REPO, "BENCH_OBS.json")
+        with open(out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+        _log(f"obs rows -> {out}")
+        print(json.dumps(rows[-1]))  # headline: the tracing-on row
+        return 0  # obs tier is its own run; no headline solve after
 
     if args.scenario:
         rows = _bench_scenario(args)

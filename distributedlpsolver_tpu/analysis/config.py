@@ -348,6 +348,20 @@ JSONL_FIELDS = {
     "hedge",
     "state",
     "remaining_ms",
+    # Distributed tracing (obs/context.py): request/hedge/route records
+    # stamp the W3C-shaped trace identity (trace_id + the emitting hop's
+    # span_id + its parent), journal WAL records carry the wire-form
+    # header under ``trace`` so replays resume the ORIGINAL trace, batch
+    # events list every member request's trace under ``trace_ids``, and
+    # JSON histogram snapshots carry the slowest observation's trace as
+    # an ``exemplar`` — the keys the fleet aggregator (obs/agg.py)
+    # stitches cross-process Perfetto flows and exemplar tables from.
+    "trace_id",
+    "span_id",
+    "parent_span_id",
+    "trace",
+    "trace_ids",
+    "exemplar",
 }
 
 # ``X.write(json.dumps(...))`` record emission points that must stamp:

@@ -94,7 +94,9 @@ from distributedlpsolver_tpu.ipm import core
 from distributedlpsolver_tpu.ipm.config import SolverConfig
 from distributedlpsolver_tpu.ipm.state import IPMState, StepStats
 from distributedlpsolver_tpu.models.problem import InteriorForm
+from distributedlpsolver_tpu.obs import context as obs_context
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.obs import trace as obs_trace
 from distributedlpsolver_tpu.ops import ildl as ildl_ops
 from distributedlpsolver_tpu.ops import pcg as pcg_ops
 from distributedlpsolver_tpu.ops import sparse as sparse_ops
@@ -491,6 +493,23 @@ class SparseIterativeBackend(SolverBackend):
         self._cg_iters_total += n
         self._cg_per_iter.append(n)
         self._m_cg.inc(n)
+        tr = obs_trace.get_tracer()
+        if tr.enabled:
+            # One instant per CG solve, trace-linked via the owning
+            # request's thread-local context: the per-step inner
+            # iteration count (the psum-per-CG-iter quantity) lands on
+            # the request's own timeline. The cg count above is already
+            # host-side — no extra sync here.
+            cg_args = {
+                "cg_iters": n,
+                "precond": self.precond,
+                "shards": self._n_shards,
+                "psum_per_iter": 1 if self._n_shards > 1 else 0,
+            }
+            ctx = obs_context.current()
+            if ctx is not None:
+                cg_args.update(ctx.span_args())
+            tr.instant("cg.step", args=cg_args, cat="cg")
         if n >= int(_ILDL_CG_FRAC * self._cg_cap):
             self._hi_cg += 1
         else:

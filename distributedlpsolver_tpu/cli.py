@@ -12,6 +12,8 @@ surface, BASELINE.json:2). Subcommands:
     route       router tier over serve-http backends (shape/load-aware
                 routing, health-checked failover)
     autotune    refine a serve bucket ladder from telemetry JSONL
+    obs-agg     fleet telemetry aggregator: cross-process trace merge +
+                hedge-ledger/record/journal reconciliation
     check       graftcheck static-analysis suite (the tier-1 CI gate)
     backends    list registered SolverBackend names
     generate    write a generated benchmark problem to MPS
@@ -166,6 +168,79 @@ def _obs_setup(args):
                 f"{args.trace_path} (open at ui.perfetto.dev)",
                 file=sys.stderr,
             )
+
+    return finalize
+
+
+def _follower_obs_setup(world, metrics: bool, trace: bool):
+    """Observability for a nonzero slice rank: install a process-wide
+    registry/tracer and export into the world heartbeat dir under
+    per-rank names (``rank<k>.metrics.json`` refreshed on the heartbeat
+    cadence — the JSON snapshot form the fleet aggregator scans, rank
+    and identity stamped alongside; ``rank<k>.trace.json`` at exit).
+    Returns a finalizer; no-op when neither flag is set or the world
+    has no heartbeat dir."""
+    import os
+    import threading
+
+    hb_dir = world.cfg.heartbeat_dir
+    if hb_dir is None or not (metrics or trace):
+        return lambda: None
+
+    from distributedlpsolver_tpu.obs import metrics as obs_metrics
+    from distributedlpsolver_tpu.obs import trace as obs_trace
+
+    os.makedirs(hb_dir, exist_ok=True)
+    reg = tracer = None
+    stop = threading.Event()
+    snap_path = os.path.join(hb_dir, f"rank{world.rank}.metrics.json")
+
+    def write_snapshot():
+        doc = {
+            "rank": world.rank,
+            "pid": os.getpid(),
+            "generation": world.cfg.generation,
+            "slice_id": world.cfg.slice_id,
+            "metrics": reg.snapshot(),
+        }
+        tmp = f"{snap_path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, snap_path)
+
+    if metrics:
+        reg = obs_metrics.MetricsRegistry()
+        obs_metrics.set_registry(reg)
+        period = max(float(world.cfg.heartbeat_period_s), 0.25)
+
+        def snap_loop():
+            while not stop.wait(period):
+                try:
+                    write_snapshot()
+                except OSError:
+                    pass  # snapshot export must never kill the rank
+
+        threading.Thread(
+            target=snap_loop, daemon=True, name="dlps-rank-metrics"
+        ).start()
+    if trace:
+        tracer = obs_trace.Tracer(
+            os.path.join(hb_dir, f"rank{world.rank}.trace.json"),
+            process_name=f"dlps-rank{world.rank}",
+        )
+        obs_trace.set_tracer(tracer)
+
+    def finalize():
+        stop.set()
+        if reg is not None:
+            try:
+                write_snapshot()
+            except OSError:
+                pass
+            obs_metrics.set_registry(None)
+        if tracer is not None:
+            tracer.close()
+            obs_trace.set_tracer(None)
 
     return finalize
 
@@ -372,6 +447,7 @@ def _service_config_from(args) -> "ServiceConfig":
         mesh_devices=args.mesh_devices,
         warm_start=not args.no_warm_start,
         warm_cache_entries=args.warm_cache_entries,
+        solo_backend=getattr(args, "solo_backend", "auto"),
         admission=_admission_from(args),
         journal_dir=getattr(args, "journal_dir", None),
         journal_fsync=getattr(args, "journal_fsync", "flush"),
@@ -641,7 +717,24 @@ def cmd_serve_slice(args) -> int:
     solver_cfg = canonical_bucket_config(_config_from(args))
     try:
         if world.rank != 0:
-            n = follower_loop(world, FileControlPlane(ctrl_dir), solver_cfg)
+            # Follower observability (README "Distributed tracing"):
+            # every rank spawns from the SAME argv, so --metrics-path /
+            # --trace-path name rank-0's artifacts; followers derive
+            # per-rank paths in the world heartbeat dir instead —
+            # rank<k>.metrics.json snapshots (JSON form, exemplars
+            # included — what `cli obs-agg` scans) refreshed on the
+            # heartbeat cadence, rank<k>.trace.json at exit.
+            finalize_follower = _follower_obs_setup(
+                world,
+                metrics=bool(getattr(args, "metrics_path", None)),
+                trace=bool(getattr(args, "trace_path", None)),
+            )
+            try:
+                n = follower_loop(
+                    world, FileControlPlane(ctrl_dir), solver_cfg
+                )
+            finally:
+                finalize_follower()
             print(
                 f"slice follower rank {world.rank}: executed {n} "
                 f"dispatches; exiting",
@@ -929,6 +1022,60 @@ def cmd_report(args) -> int:
     return 0
 
 
+def cmd_obs_agg(args) -> int:
+    """Fleet telemetry aggregator (README "Distributed tracing & fleet
+    telemetry"): discover the serving fleet (backend registry + world
+    heartbeat dirs + explicit URLs), pull every process's /statusz and
+    /metrics, merge per-process trace files into ONE Perfetto trace
+    connected by trace_id, surface histogram exemplars, and print the
+    reconciliation table lining up the router hedge ledger, the
+    backends' request records, and the journals' lifecycle counts."""
+    import os
+
+    from distributedlpsolver_tpu.obs import agg as obs_agg
+
+    traces = []
+    for spec in args.trace or []:
+        # Either label=path or a bare path (label = basename).
+        label, sep, path = spec.partition("=")
+        if not sep:
+            label, path = os.path.basename(spec), spec
+        traces.append((label, path))
+    fleet, merged = obs_agg.fleet_view(
+        registry_path=args.registry,
+        heartbeat_dirs=args.heartbeat_dir or [],
+        routers=args.router or [],
+        backends=args.backend or [],
+        traces=traces,
+        metrics_json=args.metrics_json or [],
+        timeout_s=args.timeout_s,
+    )
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        fleet_path = os.path.join(args.out, "fleet.json")
+        with open(fleet_path, "w") as fh:
+            json.dump(fleet, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"fleet view -> {fleet_path}", file=sys.stderr)
+        if merged is not None:
+            trace_path = os.path.join(args.out, "trace_merged.json")
+            with open(trace_path, "w") as fh:
+                json.dump(merged, fh)
+                fh.write("\n")
+            print(
+                f"merged trace ({len(merged['traceEvents'])} events, "
+                f"{merged['otherData']['traces_connected']} trace(s) "
+                f"connected) -> {trace_path} (open at ui.perfetto.dev)",
+                file=sys.stderr,
+            )
+    if args.json:
+        print(json.dumps(fleet))
+    else:
+        print(obs_agg.render_text(fleet), end="")
+    rec = fleet.get("reconciliation") or {}
+    return 0 if rec.get("consistent", True) else 1
+
+
 def cmd_check(args) -> int:
     """graftcheck: run the repo's static-analysis suite (jit/recompile
     hygiene, dtype discipline, lock + static deadlock discipline, SPMD
@@ -1063,6 +1210,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             "--buckets", default=None,
             help="explicit bucket ladder JSON (the `autotune` output) "
             "instead of auto power-of-two buckets",
+        )
+        p.add_argument(
+            "--solo-backend", default="auto",
+            help="solver backend for the per-request solo path "
+            "(general-form / retried requests); 'auto' picks by "
+            "problem structure (see `backends`)",
         )
         p.add_argument(
             "--no-warm-start", action="store_true",
@@ -1420,6 +1573,57 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="emit the full report as one JSON object",
     )
     ap_r.set_defaults(fn=cmd_report)
+
+    ap_oa = sub.add_parser(
+        "obs-agg",
+        help="fleet telemetry aggregator: pull /statusz + /metrics "
+        "across routers/backends/ranks, merge per-process traces into "
+        "one Perfetto file connected by trace_id, and reconcile the "
+        "hedge ledger against backend records and journal counts "
+        "(README 'Distributed tracing & fleet telemetry')",
+    )
+    ap_oa.add_argument(
+        "--registry", default=None,
+        help="shared backend-registry JSON — backends are discovered "
+        "from it (slice_id/world_size/ejected ride along)",
+    )
+    ap_oa.add_argument(
+        "--router", action="append", default=None, metavar="URL",
+        help="router URL to pull the hedge ledger from (repeatable)",
+    )
+    ap_oa.add_argument(
+        "--backend", action="append", default=None, metavar="URL",
+        help="extra backend URL beyond the registry (repeatable)",
+    )
+    ap_oa.add_argument(
+        "--heartbeat-dir", action="append", default=None, metavar="DIR",
+        help="world heartbeat dir to scan for rank*.hb liveness and "
+        "rank*.metrics.json snapshots (repeatable)",
+    )
+    ap_oa.add_argument(
+        "--trace", action="append", default=None, metavar="[LABEL=]PATH",
+        help="per-process Chrome-trace JSON to merge (repeatable; "
+        "label defaults to the file name)",
+    )
+    ap_oa.add_argument(
+        "--metrics-json", action="append", default=None, metavar="PATH",
+        help="JSON metrics snapshot to mine for histogram exemplars "
+        "(repeatable)",
+    )
+    ap_oa.add_argument(
+        "--out", default=None, metavar="DIR",
+        help="write fleet.json + trace_merged.json here",
+    )
+    ap_oa.add_argument(
+        "--timeout-s", type=float, default=2.0,
+        help="per-pull HTTP timeout (unreachable processes degrade to "
+        "error rows, never crash the aggregation)",
+    )
+    ap_oa.add_argument(
+        "--json", action="store_true",
+        help="print the fleet view as one JSON object",
+    )
+    ap_oa.set_defaults(fn=cmd_obs_agg)
 
     ap_c = sub.add_parser(
         "check",

@@ -199,10 +199,17 @@ class SliceRunner:
         warm_host=None,
         warm_mask=None,
         max_iter: Optional[int] = None,
+        trace=None,
     ):
         """Publish one bucket dispatch and execute it on the global
         mesh. ``batch_host`` is the padded host BatchedLP, ``warm_host``
-        the host warm-lane IPMState (or None for cold/PDHG)."""
+        the host warm-lane IPMState (or None for cold/PDHG). ``trace``
+        is the batch members' trace headers (wire form, list of str):
+        rank 0 publishes it in the journal meta so followers join the
+        traces as rank-stamped child spans — meta rides the JSON
+        sidecar, never the program statics (tol/engine/max_iter are the
+        only meta fields execute_dispatch feeds the jit cache), so the
+        zero-warm-recompile invariant holds with tracing on."""
         meta = {
             "kind": KIND_BUCKET,
             "m": int(spec.m),
@@ -213,6 +220,8 @@ class SliceRunner:
             "max_iter": int(max_iter) if max_iter else 0,
             "name": getattr(batch_host, "name", "slice-bucket"),
         }
+        if trace:
+            meta["trace"] = list(trace)
         arrays = {
             "c": np.asarray(batch_host.c, dtype=np.float64),
             "A": np.asarray(batch_host.A, dtype=np.float64),
@@ -251,6 +260,9 @@ def follower_loop(
     order until a stop record (clean shutdown), the idle timeout, or
     rank-0 death (the world heartbeat monitor exits the process).
     Returns the number of dispatches executed."""
+    from distributedlpsolver_tpu.obs import context as obs_context
+    from distributedlpsolver_tpu.obs import trace as obs_trace
+
     cfg = canonical_bucket_config(solver_config)
     mesh = world.mesh(axis="batch")
     seq = -1
@@ -262,5 +274,33 @@ def follower_loop(
         seq, meta, arrays = nxt
         if meta.get("kind") == KIND_STOP:
             return executed
+        t0 = time.perf_counter()
         execute_dispatch(mesh, cfg, meta, arrays)
         executed += 1
+        tr = obs_trace.get_tracer()
+        if tr.enabled:
+            # Join the published traces as this rank's child spans: one
+            # follower-side span per dispatch, carrying every member
+            # trace_id plus the first context's full child identity.
+            ctxs = [
+                c
+                for c in (
+                    obs_context.parse(h)
+                    for h in (meta.get("trace") or [])
+                )
+                if c is not None
+            ]
+            span_args = {
+                "rank": world.rank,
+                "dispatch": seq,
+                "engine": meta.get("engine"),
+            }
+            if ctxs:
+                span_args.update(ctxs[0].span_args())
+                span_args["trace_ids"] = [c.trace_id for c in ctxs]
+            tr.complete(
+                f"slice.execute #{seq}",
+                time.perf_counter() - t0,
+                cat="slice",
+                args=span_args,
+            )

@@ -35,6 +35,7 @@ from distributedlpsolver_tpu.models.problem import (
     LPProblem,
     to_interior_form,
 )
+from distributedlpsolver_tpu.obs import context as obs_context
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
 from distributedlpsolver_tpu.obs import trace as obs_trace
 from distributedlpsolver_tpu.utils import checkpoint as ckpt
@@ -282,6 +283,15 @@ def solve(
         "ipm_refactorizations_total",
         help="bad-step regularization-bump refactorization attempts",
     )
+    # Solver-depth tracing, resolved once like the instruments: the
+    # owning request's context (set thread-locally by the serve solo
+    # path) plus the tracer. Disabled tracer → one bool test per iter.
+    _tracer = obs_trace.get_tracer()
+    _trace_args = (
+        obs_context.current().span_args()
+        if _tracer.enabled and obs_context.current() is not None
+        else None
+    )
     t_solve0 = time.perf_counter()
     profile_stack = contextlib.ExitStack()
     try:
@@ -312,6 +322,16 @@ def solve(
             t_it = time.perf_counter() - t_it0
             _m_iters.inc()
             _m_step.observe(t_it)
+            if _tracer.enabled:
+                # One phase span per IPM iteration, trace-linked: a tail
+                # request's slow endgame shows up as widening iter spans
+                # under its own trace_id instead of a guess.
+                it_args = {"iter": it, "refactor": refactor}
+                if _trace_args is not None:
+                    it_args.update(_trace_args)
+                _tracer.complete(
+                    f"ipm.iter {it}", t_it, cat="ipm", args=it_args
+                )
             last = _to_floats(stats)
             rec = IterRecord(iter=it, t_iter=t_it, **last)
             history.append(rec)
@@ -507,14 +527,42 @@ def _finalize(
     ).observe(n_iters)
     # One X span per solve on the calling thread's trace lane (reported
     # after the fact: the span covers the just-finished solve loop).
-    obs_trace.get_tracer().complete(
-        f"ipm.solve {inf.name}", solve_time, cat="ipm",
-        args={
-            "backend": getattr(be, "name", str(backend)),
-            "status": status.value,
-            "iterations": n_iters,
-        },
+    _tracer = obs_trace.get_tracer()
+    solve_args = {
+        "backend": getattr(be, "name", str(backend)),
+        "status": status.value,
+        "iterations": n_iters,
+    }
+    _ctx = obs_context.current() if _tracer.enabled else None
+    if _ctx is not None:
+        solve_args.update(_ctx.span_args())
+    _tracer.complete(
+        f"ipm.solve {inf.name}", solve_time, cat="ipm", args=solve_args
     )
+    if _tracer.enabled:
+        # CG attribution for matrix-free backends: one span carrying
+        # the solve's inner-iteration economics (cg_iters, precond,
+        # shards, psum_per_iter) linked to the owning request's trace —
+        # "blame endgame CG" becomes a lookup, not a guess.
+        cg_report = getattr(be, "cg_report", None)
+        if cg_report is not None:
+            try:
+                rep = cg_report()
+            except Exception:  # telemetry must never sink a solve
+                rep = None
+            if rep and rep.get("cg_iters"):
+                cg_args = {
+                    "cg_iters": rep.get("cg_iters"),
+                    "precond": rep.get("precond"),
+                    "shards": rep.get("shards"),
+                    "psum_per_iter": rep.get("psum_per_iter"),
+                }
+                if _ctx is not None:
+                    cg_args.update(_ctx.span_args())
+                _tracer.complete(
+                    f"cg.solve {inf.name}", solve_time, cat="cg",
+                    args=cg_args,
+                )
     host = be.to_host(state)
     if scaling is not None:
         host = scaling.unscale_state(host)

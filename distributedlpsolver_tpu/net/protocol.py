@@ -54,6 +54,16 @@ PLANE_BACKEND = "backend"
 # structured verdict instead of queueing it to die.
 DEADLINE_HEADER = "X-DLPS-Deadline-Ms"
 
+# Trace-context header (W3C traceparent shape:
+# ``00-<trace_id:32hex>-<span_id:16hex>-<flags:2hex>``; see
+# obs/context.py). The router mints a context at ingress when the
+# client didn't send one and re-stamps a FRESH child span per retry and
+# per hedge leg — legs are siblings under the ingress span — so the
+# backend a leg lands on continues exactly that leg's branch. Malformed
+# values are ignored (a new trace starts); the context is host-side
+# metadata only and never reaches program inputs.
+TRACE_HEADER = "X-DLPS-Trace"
+
 
 class ProtocolError(ValueError):
     """Malformed request body/fields — the HTTP 400 path."""

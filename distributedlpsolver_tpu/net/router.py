@@ -73,7 +73,9 @@ from urllib.parse import urlsplit
 
 from distributedlpsolver_tpu.net import protocol
 from distributedlpsolver_tpu.net.server import PlaneHTTPServer
+from distributedlpsolver_tpu.obs import context as obs_context
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
+from distributedlpsolver_tpu.obs import trace as obs_trace
 from distributedlpsolver_tpu.obs.stats import percentile
 from distributedlpsolver_tpu.utils.logging import IterLogger
 
@@ -987,18 +989,24 @@ class Router:
         method: str,
         deadline_ms: Optional[float],
         t_start: float,
+        trace: Optional[obs_context.TraceContext] = None,
     ) -> Tuple[str, bytes, Optional[Dict[str, str]]]:
         """(path, body, extra headers) for one forward attempt with the
         REMAINING deadline budget stamped: header always, and the
         body's/query's own deadline_ms re-stamped so a retry or hedge
         consumes what is left of the budget rather than resurrecting
-        the original."""
+        the original. ``trace`` is the ATTEMPT's context (a fresh child
+        span per retry/hedge leg — siblings under the ingress span) and
+        rides the trace header independently of deadline propagation."""
+        headers: Dict[str, str] = {}
+        if trace is not None:
+            headers[protocol.TRACE_HEADER] = trace.to_header()
         if (
             deadline_ms is None
             or not self.config.deadline_propagation
             or method != "POST"
         ):
-            return path, body, None
+            return path, body, headers or None
         elapsed_ms = (time.perf_counter() - t_start) * 1e3
         remaining = max(0.0, deadline_ms - elapsed_ms)
         parts = urlsplit(path)
@@ -1006,9 +1014,8 @@ class Router:
             body, content_type, parts.query, remaining
         )
         new_path = parts.path + (f"?{new_query}" if new_query else "")
-        return new_path, new_body, {
-            protocol.DEADLINE_HEADER: f"{remaining:.3f}"
-        }
+        headers[protocol.DEADLINE_HEADER] = f"{remaining:.3f}"
+        return new_path, new_body, headers
 
     def _attempt_result(
         self,
@@ -1059,21 +1066,38 @@ class Router:
         ms: float,
         retried: bool,
         hedge: bool,
+        trace: Optional[obs_context.TraceContext] = None,
     ) -> None:
-        self._logger.event(
-            {
-                "event": "route",
-                "backend": url,
-                "path": route_path,
-                "code": code,
-                "m": hint[0] if hint else None,
-                "n": hint[1] if hint else None,
-                "tol": hint[2] if hint else None,
-                "ms": round(ms, 3),
-                "retried": retried,
-                "hedge": hedge,
-            }
-        )
+        rec = {
+            "event": "route",
+            "backend": url,
+            "path": route_path,
+            "code": code,
+            "m": hint[0] if hint else None,
+            "n": hint[1] if hint else None,
+            "tol": hint[2] if hint else None,
+            "ms": round(ms, 3),
+            "retried": retried,
+            "hedge": hedge,
+        }
+        if trace is not None:
+            # The attempt's own span: its parent is the ingress span, so
+            # hedge siblings land side by side under one request.
+            rec.update(trace.span_args())
+            tr = obs_trace.get_tracer()
+            if tr.enabled:
+                tr.complete(
+                    "route.hedge" if hedge else "route.attempt",
+                    ms / 1e3,
+                    cat="route",
+                    args={
+                        **trace.span_args(),
+                        "backend": url,
+                        "code": code,
+                        "retried": retried,
+                    },
+                )
+        self._logger.event(rec)
 
     # -- routing ---------------------------------------------------------
 
@@ -1211,7 +1235,12 @@ class Router:
             return e.code, e.read(), self._from_backend(e.headers)
 
     def forward(
-        self, path: str, body: bytes, content_type: str, method: str = "POST"
+        self,
+        path: str,
+        body: bytes,
+        content_type: str,
+        method: str = "POST",
+        trace: Optional[obs_context.TraceContext] = None,
     ) -> Tuple[int, bytes, Optional[str]]:
         """Route + forward one request with retry-once failover and,
         for solves, adaptive hedging. Returns (code, body, backend) —
@@ -1249,6 +1278,11 @@ class Router:
             )
             with self._lock:
                 self._forwards_total += 1
+            if trace is None:
+                # Ingress mint: a solve entering the plane without a
+                # context starts its own trace here (pure host-side
+                # string work — stays out of program inputs).
+                trace = obs_context.new_context()
         t_start = time.perf_counter()
         code, payload, url = 503, b"", None
         tried: Tuple[str, ...] = ()
@@ -1265,7 +1299,7 @@ class Router:
                 done = self._forward_hedged(
                     url, is_trial, path, body, content_type, method,
                     hint, route_path, deadline_ms, tenant, t_start,
-                    delay_s,
+                    delay_s, trace,
                 )
                 if done is not None:
                     return done
@@ -1277,14 +1311,17 @@ class Router:
                     self._failovers += 1
                 self._m_failovers.inc()
                 continue
+            attempt_ctx = trace.child() if trace is not None else None
             spath, sbody, sheaders = self._stamped_request(
-                path, body, content_type, method, deadline_ms, t_start
+                path, body, content_type, method, deadline_ms, t_start,
+                trace=attempt_ctx,
             )
             code, payload, from_backend, dead, ms = self._attempt_result(
                 url, spath, sbody, content_type, method, sheaders
             )
             self._log_route(
-                url, route_path, code, hint, ms, attempt > 0, False
+                url, route_path, code, hint, ms, attempt > 0, False,
+                trace=attempt_ctx,
             )
             cls = self._classify(code, payload, from_backend, dead)
             if cls == "dead":
@@ -1339,6 +1376,7 @@ class Router:
         tenant: str,
         t_start: float,
         delay_s: float,
+        trace: Optional[obs_context.TraceContext] = None,
     ) -> Optional[Tuple[int, bytes, Optional[str]]]:
         """The hedge-eligible leg of forward(): run the already-picked
         primary on a worker thread; if it stays silent past ``delay_s``,
@@ -1358,8 +1396,13 @@ class Router:
         state_lock = threading.Lock()
 
         def run_leg(url: str, is_trial: bool, leg: str) -> None:
+            # Each leg is a SIBLING span: a fresh child of the ingress
+            # context, minted per attempt — primary and hedge share a
+            # parent, never a span_id.
+            leg_ctx = trace.child() if trace is not None else None
             spath, sbody, sheaders = self._stamped_request(
-                path, body, content_type, method, deadline_ms, t_start
+                path, body, content_type, method, deadline_ms, t_start,
+                trace=leg_ctx,
             )
             code, payload, from_backend, dead, ms = self._attempt_result(
                 url, spath, sbody, content_type, method, sheaders
@@ -1375,7 +1418,8 @@ class Router:
                 if from_backend:
                     self._observe_latency(url, ms)
             self._log_route(
-                url, route_path, code, hint, ms, False, leg == "hedge"
+                url, route_path, code, hint, ms, False, leg == "hedge",
+                trace=leg_ctx,
             )
             # A hedge leg's 429 never wins: admission/brownout said no,
             # and answering the client 429 while the primary may still
@@ -1447,16 +1491,18 @@ class Router:
                 )
             )
             self._count_hedge(outcome)
-            self._logger.event(
-                {
-                    "event": "hedge",
-                    "backend": hedge_url,
-                    "primary": primary,
-                    "delay_ms": round(delay_s * 1e3, 3),
-                    "outcome": outcome,
-                    "tenant": tenant,
-                }
-            )
+            hedge_rec = {
+                "event": "hedge",
+                "backend": hedge_url,
+                "primary": primary,
+                "delay_ms": round(delay_s * 1e3, 3),
+                "outcome": outcome,
+                "tenant": tenant,
+            }
+            if trace is not None:
+                hedge_rec["trace_id"] = trace.trace_id
+                hedge_rec["span_id"] = trace.span_id
+            self._logger.event(hedge_rec)
         if winner is not None:
             return winner["code"], winner["payload"], winner["url"]
         if not hedged:
@@ -1653,9 +1699,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
             content_type = self.headers.get(
                 "Content-Type", "application/json"
             )
+            # Router ingress: continue the client's trace (we become a
+            # child of its span) or start a fresh one. Header parse
+            # only — no device values.
+            # graftcheck: disable=host-sync (header parse, no device value)
+            ctx = obs_context.parse(
+                self.headers.get(protocol.TRACE_HEADER)
+            ) or obs_context.new_context()
+            t_in = time.perf_counter()
             code, payload, backend = front.router.forward(
-                self.path, body, content_type, method="POST"
+                self.path, body, content_type, method="POST", trace=ctx
             )
+            tr = obs_trace.get_tracer()
+            if tr.enabled:
+                tr.complete(
+                    "route.ingress",
+                    time.perf_counter() - t_in,
+                    cat="route",
+                    args={
+                        **ctx.span_args(),
+                        "code": code,
+                        "backend": backend,
+                    },
+                )
             if backend is None:
                 self._send_json(
                     503, {"error": "no healthy backend in rotation"}

@@ -51,6 +51,7 @@ from urllib.parse import urlsplit
 
 from distributedlpsolver_tpu.net import protocol
 from distributedlpsolver_tpu.net.admission import TenantLabeler
+from distributedlpsolver_tpu.obs import context as obs_context
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
 from distributedlpsolver_tpu.serve.scheduler import ServiceOverloaded
 from distributedlpsolver_tpu.utils.logging import IterLogger
@@ -234,7 +235,7 @@ class SolveHTTPServer:
 
     def _exit_request(
         self, t0: float, method: str, path: str, code: int,
-        tenant: str, request_id,
+        tenant: str, request_id, trace=None,
     ) -> None:
         ms = (time.perf_counter() - t0) * 1e3
         label = self._tenant_labels.label(tenant)
@@ -252,18 +253,24 @@ class SolveHTTPServer:
                 )
                 self._m_by_code[(code, label)] = ctr
         ctr.inc()
-        self._m_http_ms.observe(ms)
-        self._logger.event(
-            {
-                "event": "http_request",
-                "method": method,
-                "path": path,
-                "code": code,
-                "tenant": tenant,
-                "id": request_id,
-                "ms": round(ms, 3),
-            }
+        # The latency histogram keeps its slowest observation's trace_id
+        # as an exemplar: the aggregator surfaces "this bucket's worst
+        # request was trace X" without scanning every record.
+        self._m_http_ms.observe(
+            ms, exemplar=(trace.trace_id if trace is not None else None)
         )
+        rec = {
+            "event": "http_request",
+            "method": method,
+            "path": path,
+            "code": code,
+            "tenant": tenant,
+            "id": request_id,
+            "ms": round(ms, 3),
+        }
+        if trace is not None:
+            rec.update(trace.span_args())
+        self._logger.event(rec)
 
     def _m_evict(self, state: str):  # holds: _lock
         ctr = self._m_evictions.get(state)
@@ -496,6 +503,7 @@ class _Handler(BaseHTTPRequestHandler):
         parts = urlsplit(self.path)
         t0 = front._enter_request()
         code, tenant, rid = 500, "default", None
+        trace_ctx: Optional[obs_context.TraceContext] = None
         try:
             if parts.path in ("/quitquitquit", "/drainz"):
                 # Admin drain: acknowledge, then finish in-flight work
@@ -546,6 +554,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(code, {"error": str(e)})
                 return
             tenant = req.tenant
+            # Trace join: the router stamped this leg's span in the
+            # trace header; the backend's pipeline becomes its child so
+            # hedge siblings stay distinguishable fleet-wide. Malformed
+            # or absent → None (the solve is untraced, never failed).
+            # graftcheck: disable=host-sync (header parse, no device value)
+            trace_ctx = obs_context.parse(
+                self.headers.get(protocol.TRACE_HEADER)
+            )
             hdr = self.headers.get(protocol.DEADLINE_HEADER)
             if hdr is not None and front.config.deadline_propagation:
                 try:
@@ -601,6 +617,7 @@ class _Handler(BaseHTTPRequestHandler):
                     name=req.name,
                     tenant=req.tenant,
                     priority=req.priority,
+                    trace=trace_ctx,
                 )
             except ServiceOverloaded as e:
                 # Draining is a readiness verdict, not load shedding:
@@ -653,7 +670,9 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             code = 499  # client went away mid-response; counted, not raised
         finally:
-            front._exit_request(t0, "POST", parts.path, code, tenant, rid)
+            front._exit_request(
+                t0, "POST", parts.path, code, tenant, rid, trace=trace_ctx
+            )
 
     # -- GETs ------------------------------------------------------------
 

@@ -84,6 +84,10 @@ from distributedlpsolver_tpu.obs.trace import (  # noqa: E402
     get_tracer,
     set_tracer,
 )
+from distributedlpsolver_tpu.obs.context import (  # noqa: E402
+    TraceContext,
+    new_context,
+)
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -92,10 +96,12 @@ __all__ = [
     "NULL_REGISTRY",
     "NULL_TRACER",
     "Tracer",
+    "TraceContext",
     "get_registry",
     "set_registry",
     "get_tracer",
     "set_tracer",
+    "new_context",
     "percentile",
     "summarize",
 ]

@@ -124,7 +124,9 @@ class Histogram:
     counts observations ``v <= edges[i]``; values above the last edge
     land only in the implicit ``+Inf`` bucket (``count``)."""
 
-    __slots__ = ("edges", "_counts", "_sum", "_count", "_lock")
+    __slots__ = (
+        "edges", "_counts", "_sum", "_count", "_lock", "_exemplar"
+    )
 
     def __init__(self, edges: Sequence[float]):
         if list(edges) != sorted(edges) or len(set(edges)) != len(edges):
@@ -133,9 +135,13 @@ class Histogram:
         self._counts = [0] * len(self.edges)
         self._sum = 0.0
         self._count = 0
+        # Slowest-observation exemplar: (value, trace_id-or-label). One
+        # slot, max-value wins — "which request was this histogram's
+        # worst" is the question the fleet aggregator answers with it.
+        self._exemplar: Optional[Tuple[float, str]] = None
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         v = float(value)
         with self._lock:
             self._sum += v
@@ -146,16 +152,26 @@ class Histogram:
                 if v <= e:
                     self._counts[i] += 1
                     break
+            if exemplar is not None and (
+                self._exemplar is None or v > self._exemplar[0]
+            ):
+                self._exemplar = (v, str(exemplar))
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            snap = {
                 "buckets": {
                     f"{e:g}": c for e, c in zip(self.edges, self._counts)
                 },
                 "sum": self._sum,
                 "count": self._count,
             }
+            if self._exemplar is not None:
+                snap["exemplar"] = {
+                    "value": self._exemplar[0],
+                    "trace_id": self._exemplar[1],
+                }
+            return snap
 
     @property
     def count(self) -> int:
@@ -185,7 +201,7 @@ class _NullInstrument:
     def set(self, value: float) -> None:
         pass
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         pass
 
     def snapshot(self) -> dict:

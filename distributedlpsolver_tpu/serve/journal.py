@@ -105,6 +105,17 @@ class JournaledJob:
     admitted_ts: float = 0.0
     stage: str = "admitted"  # admitted | packed | dispatched | finished
     status: Optional[str] = None
+    # Trace header (obs/context wire form) journaled OUTSIDE spec: the
+    # content fingerprint must not change because a request was traced,
+    # and a replayed job resumes its ORIGINAL trace.
+    trace: Optional[str] = None
+
+    def trace_context(self):
+        """The job's TraceContext (a child of the journaled span — the
+        replay is causally downstream of the original submit), or None."""
+        from distributedlpsolver_tpu.obs import context as obs_context
+
+        return obs_context.parse(self.trace)
 
 
 @dataclasses.dataclass
@@ -229,6 +240,7 @@ class JobJournal:
                         priority=str(rec.get("priority", "normal")),
                         deadline_ts=rec.get("deadline_ts"),
                         admitted_ts=float(rec.get("ts", 0.0)),
+                        trace=rec.get("trace"),
                     )
                     max_seq = max(max_seq, _seq_of(jid))
                 elif kind == "stage":
@@ -335,9 +347,13 @@ class JobJournal:
         tenant: str,
         priority: str,
         deadline_ts: Optional[float],
+        trace: Optional[str] = None,
     ) -> str:
         """Journal one admitted request; returns its durable job id (the
-        poll URL token that survives restarts)."""
+        poll URL token that survives restarts). ``trace`` is the
+        request's trace header (wire form) — a top-level WAL field, not
+        part of ``spec``, so tracing never perturbs the idempotency
+        fingerprint."""
         with self._lock:
             self._seq += 1
             jid = f"j{self._nonce}-{self._seq}"
@@ -349,20 +365,22 @@ class JobJournal:
                 priority=priority,
                 deadline_ts=deadline_ts,
                 admitted_ts=time.time(),
+                trace=trace,
             )
             self._pending[jid] = job
             self._m_pending.set(len(self._pending))
-            self._append_locked(
-                {
-                    "j": "admitted",
-                    "jid": jid,
-                    "fp": fp,
-                    "tenant": tenant,
-                    "priority": priority,
-                    "deadline_ts": deadline_ts,
-                    "spec": spec,
-                }
-            )
+            rec = {
+                "j": "admitted",
+                "jid": jid,
+                "fp": fp,
+                "tenant": tenant,
+                "priority": priority,
+                "deadline_ts": deadline_ts,
+                "spec": spec,
+            }
+            if trace is not None:
+                rec["trace"] = trace
+            self._append_locked(rec)
         return jid
 
     def readmit(self, job: JournaledJob) -> None:
@@ -510,21 +528,21 @@ class JobJournal:
                         + "\n"
                     )
                     for job in jobs:
+                        adm = {
+                            "j": "admitted",
+                            "jid": job.jid,
+                            "fp": job.fp,
+                            "tenant": job.tenant,
+                            "priority": job.priority,
+                            "deadline_ts": job.deadline_ts,
+                            "spec": job.spec,
+                        }
+                        if job.trace is not None:
+                            # Compaction must not drop the trace: a
+                            # post-compact replay still resumes it.
+                            adm["trace"] = job.trace
                         fh.write(
-                            json.dumps(
-                                stamp_record(
-                                    {
-                                        "j": "admitted",
-                                        "jid": job.jid,
-                                        "fp": job.fp,
-                                        "tenant": job.tenant,
-                                        "priority": job.priority,
-                                        "deadline_ts": job.deadline_ts,
-                                        "spec": job.spec,
-                                    }
-                                )
-                            )
-                            + "\n"
+                            json.dumps(stamp_record(adm)) + "\n"
                         )
                         if job.stage != "admitted":
                             fh.write(

@@ -75,6 +75,10 @@ class RequestResult:
     scenario_bucket: Optional[int] = None
     schur_ms: float = 0.0
     link_ms: float = 0.0
+    # Distributed tracing (obs/context.py): the request's TraceContext
+    # or None — stamped in the _finish funnel so the record and the
+    # future's result agree on which trace this request belonged to.
+    trace: Optional[object] = None
 
     def record(self) -> dict:
         """The JSONL record for this request (x is elided — solutions go
@@ -121,6 +125,13 @@ class RequestResult:
                 schur_ms=round(self.schur_ms, 3),
                 link_ms=round(self.link_ms, 3),
             )
+        if self.trace is not None:
+            # Traced requests only — untraced records stay byte-identical
+            # to the pre-trace schema.
+            rec["trace_id"] = self.trace.trace_id
+            rec["span_id"] = self.trace.span_id
+            if self.trace.parent_span_id:
+                rec["parent_span_id"] = self.trace.parent_span_id
         return rec
 
 

@@ -108,6 +108,11 @@ class PendingRequest:
     units: int = 1
     n_scenarios: Optional[int] = None
     scenario_bucket: Optional[int] = None
+    # Distributed tracing (obs/context.py): the TraceContext this
+    # request arrived with (the router leg's child span) or None. Pure
+    # host-side metadata — it rides spans, JSONL records, and the
+    # journal, never the solve itself.
+    trace: Optional[object] = None
 
     @property
     def m(self) -> int:

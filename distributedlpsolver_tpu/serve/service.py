@@ -69,6 +69,7 @@ from distributedlpsolver_tpu.ipm.state import (
     Status,
 )
 from distributedlpsolver_tpu.models.problem import LPProblem
+from distributedlpsolver_tpu.obs import context as obs_context
 from distributedlpsolver_tpu.obs import metrics as obs_metrics
 from distributedlpsolver_tpu.obs import trace as obs_trace
 from distributedlpsolver_tpu.serve.buckets import (
@@ -882,9 +883,15 @@ class SolveService:
         name: Optional[str] = None,
         tenant: str = "default",
         priority: str = "normal",
+        trace=None,
         _replay_job=None,
     ) -> Future:
         """Enqueue one LP; the Future resolves to a RequestResult.
+
+        ``trace`` is the request's :class:`obs.context.TraceContext`
+        (or None): it annotates the request's spans and records, is
+        journaled with the job so a replay resumes the original trace,
+        and never touches the solve itself.
 
         ``deadline`` is seconds from now: a request still queued when it
         expires is returned ``Status.TIMEOUT`` (it never poisons its
@@ -996,6 +1003,11 @@ class SolveService:
             units=units,
             n_scenarios=n_scen,
             scenario_bucket=scen_bucket,
+            trace=(
+                _replay_job.trace_context()
+                if _replay_job is not None and trace is None
+                else trace
+            ),
         )
         # Overload brownout ladder: observe saturation (logging any
         # stage transitions), then apply the current stage's rungs —
@@ -1083,20 +1095,30 @@ class SolveService:
                             None if deadline is None
                             else time.time() + deadline
                         ),
+                        # Trace rides the WAL OUTSIDE the spec: the
+                        # content fingerprint (idempotency key) must not
+                        # change because a retry re-traced the request.
+                        trace=(
+                            p.trace.to_header()
+                            if p.trace is not None
+                            else None
+                        ),
                     )
                 self._jobs[p.jid] = p.future
             # Request track opens on the submit thread; the nested queue
             # span (and later pack/solve) begin/end on whichever pipeline
             # thread handles them — same (cat, id) keeps the track
             # connected across threads.
+            req_args = {
+                "id": p.request_id, "name": p.name,
+                "m": p.m, "n": p.n,
+                "bucket": list(key[0].key()), "tol": key[1],
+                "engine": key[2],
+            }
+            if p.trace is not None:
+                req_args.update(p.trace.span_args())
             self.tracer.async_begin(
-                "request", p.request_id,
-                args={
-                    "id": p.request_id, "name": p.name,
-                    "m": p.m, "n": p.n,
-                    "bucket": list(key[0].key()), "tol": key[1],
-                    "engine": key[2],
-                },
+                "request", p.request_id, args=req_args
             )
             self.tracer.async_begin("queue", p.request_id)
             self._wake.notify_all()
@@ -1206,11 +1228,23 @@ class SolveService:
                 t0 = time.perf_counter()
                 with self._span_lock:
                     self._pack_current = t0
+                pack_args = {"live": len(job.live)}
+                if self.tracer.enabled:
+                    # Batch spans carry every member's trace_id: one
+                    # dispatch serves many traces, so the aggregator
+                    # joins on the list rather than a single id.
+                    tids = [
+                        p.trace.trace_id
+                        for p in job.live
+                        if p.trace is not None
+                    ]
+                    if tids:
+                        pack_args["trace_ids"] = tids
                 try:
                     with self.tracer.span(
                         f"pack {spec.m}x{spec.n}x{spec.batch}",
                         cat="pipeline",
-                        args={"live": len(job.live)},
+                        args=pack_args,
                     ):
                         job.packed = self._pack_bucket(job.key, job.live)
                 except (KeyboardInterrupt, SystemExit):
@@ -1565,6 +1599,15 @@ class SolveService:
                                 None if engine == "pdhg" else packed.warm_host
                             ),
                             warm_mask=packed.warm_mask,
+                            # Rank 0 publishes the members' trace headers
+                            # in the dispatch journal meta; followers
+                            # join as rank-stamped child spans. Host-side
+                            # JSON only — never a program static.
+                            trace=[
+                                p.trace.to_header()
+                                for p in live
+                                if p.trace is not None
+                            ] or None,
                         )
                     if engine == "pdhg":
                         return solve_pdhg_bucket(batch, active, cfg, mesh=mesh)
@@ -1617,11 +1660,18 @@ class SolveService:
         t_sol1 = time.perf_counter()
         for p in live:
             self.tracer.async_end("solve", p.request_id)
+        solve_args = {"dispatch": seq, "live": len(live),
+                      "attempts": len(faults) + (1 if res is not None else 0)}
+        if self.tracer.enabled:
+            tids = [
+                p.trace.trace_id for p in live if p.trace is not None
+            ]
+            if tids:
+                solve_args["trace_ids"] = tids
         self.tracer.complete(
             f"solve {spec.m}x{spec.n}x{spec.batch} #{seq}",
             t_sol1 - t_sol0, cat="pipeline",
-            args={"dispatch": seq, "live": len(live),
-                  "attempts": len(faults) + (1 if res is not None else 0)},
+            args=solve_args,
             end_us=t_sol1 * 1e6,
         )
         # Pack work (for LATER batches) that ran inside this dispatch's
@@ -1858,24 +1908,29 @@ class SolveService:
             "scenario" if p.engine == "scenario" else self.config.solo_backend
         )
         self._m_solo.inc()
-        self.tracer.async_begin(
-            "solo", p.request_id, args={"retried": retried}
-        )
+        solo_args = {"retried": retried}
+        if p.trace is not None:
+            solo_args.update(p.trace.span_args())
+        self.tracer.async_begin("solo", p.request_id, args=solo_args)
         t0 = time.perf_counter()
         try:
-            if self.config.solo_recovery:
-                r = supervised_solve(
-                    problem,
-                    backend=backend_name,
-                    config=cfg,
-                    supervisor=SupervisorConfig(backoff_base=0.01),
-                    warm_cache=self._warm_cache,
-                )
-            else:
-                r = solve(
-                    problem, backend=backend_name, config=cfg,
-                    warm_cache=self._warm_cache,
-                )
+            # Thread-local trace context around the solve: the IPM
+            # driver and iterative backends annotate their spans via
+            # obs.context.current() without any backend-protocol change.
+            with obs_context.use(p.trace):
+                if self.config.solo_recovery:
+                    r = supervised_solve(
+                        problem,
+                        backend=backend_name,
+                        config=cfg,
+                        supervisor=SupervisorConfig(backoff_base=0.01),
+                        warm_cache=self._warm_cache,
+                    )
+                else:
+                    r = solve(
+                        problem, backend=backend_name, config=cfg,
+                        warm_cache=self._warm_cache,
+                    )
             status, faults = r.status, faults + list(r.faults)
         except (KeyboardInterrupt, SystemExit):
             raise
@@ -2006,7 +2061,7 @@ class SolveService:
         # — so the record, the future's result, and the admission
         # accounting can never disagree on whose request this was.
         result = dataclasses.replace(
-            result, tenant=p.tenant, priority=p.priority
+            result, tenant=p.tenant, priority=p.priority, trace=p.trace
         )
         if self._admission is not None:
             self._admission.on_finished(p.tenant, units=p.units)
@@ -2037,11 +2092,11 @@ class SolveService:
         ctr.inc()
         self._m_queue_ms.observe(result.queue_ms)
         self._m_total_ms.observe(result.total_ms)
-        self.tracer.async_end(
-            "request", p.request_id,
-            args={"status": status,
-                  "total_ms": round(result.total_ms, 3)},
-        )
+        end_args = {"status": status,
+                    "total_ms": round(result.total_ms, 3)}
+        if p.trace is not None:
+            end_args.update(p.trace.span_args())
+        self.tracer.async_end("request", p.request_id, args=end_args)
         self._logger.event(result.record())
         # A caller may have cancelled its still-pending future (submit
         # never marks it RUNNING, so Future.cancel succeeds). Claiming it

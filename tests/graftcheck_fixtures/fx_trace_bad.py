@@ -1,0 +1,29 @@
+# Seeds: jsonl-fields x2 — distributed-tracing telemetry written wrong.
+# Checked with pkg_path="net/fx.py": a hedge resolution stamping its
+# trace identity under keys the catalogue never heard of (the fleet
+# aggregator's flow stitching keys on args.trace_id/trace_ids — these
+# records would never connect), and a request record carrying an
+# uncatalogued span-linkage field.
+
+
+def hedge_record(logger, backend, primary, ctx):
+    logger.event(
+        {
+            "event": "hedge",
+            "backend": backend,
+            "primary": primary,
+            "outcome": "hedge_won",
+            "traceparent": ctx.to_header(),  # jsonl-fields: not catalogued
+        }
+    )
+
+
+def request_record(logger, rid, ctx):
+    logger.event(
+        {
+            "event": "request",
+            "id": rid,
+            "trace_id": ctx.trace_id,
+            "span_ref": ctx.span_id,  # jsonl-fields: not catalogued
+        }
+    )

@@ -1,0 +1,49 @@
+# Compliant twin of fx_trace_bad: trace-stamped telemetry with
+# catalogued fields only — the hedge resolution and request records as
+# net/router.py and serve/records.py emit them (trace_id + the emitting
+# hop's span_id + its parent), a batch event listing its member
+# requests' traces, and a journal-style record carrying the wire-form
+# header under ``trace`` (replays resume the ORIGINAL trace).
+
+
+def hedge_record(logger, backend, primary, ctx):
+    logger.event(
+        {
+            "event": "hedge",
+            "backend": backend,
+            "primary": primary,
+            "delay_ms": 84.5,
+            "outcome": "hedge_won",
+            "trace_id": ctx.trace_id,
+            "span_id": ctx.span_id,
+        }
+    )
+
+
+def request_record(logger, rid, ctx):
+    logger.event(
+        {
+            "event": "request",
+            "id": rid,
+            "trace_id": ctx.trace_id,
+            "span_id": ctx.span_id,
+            "parent_span_id": ctx.parent_span_id,
+        }
+    )
+
+
+def batch_and_journal_records(logger, ctxs, header):
+    logger.event(
+        {
+            "event": "batch",
+            "bucket": "m256n512",
+            "trace_ids": [c.trace_id for c in ctxs],
+        }
+    )
+    logger.event(
+        {
+            "event": "journal_replay",
+            "replayed": 1,
+            "trace": header,
+        }
+    )

@@ -235,6 +235,23 @@ class TestRuleFamilies:
         rules, _ = _rules_hit("fx_tail_clean.py", "net/fx.py")
         assert rules == []
 
+    def test_trace_catches_seeded(self):
+        # Distributed tracing: a hedge record carrying the raw
+        # traceparent under an uncatalogued key and a request record
+        # with an uncatalogued span-linkage field.
+        rules, findings = _rules_hit("fx_trace_bad.py", "net/fx.py")
+        assert rules == ["jsonl-fields"]
+        assert sum(f.rule == "jsonl-fields" for f in findings) == 2
+        msgs = " | ".join(f.message for f in findings)
+        assert "traceparent" in msgs
+        assert "span_ref" in msgs
+
+    def test_trace_clean_twin_silent(self):
+        # hedge/request/batch/journal_replay records stamped with the
+        # catalogued trace identity keys only: silent.
+        rules, _ = _rules_hit("fx_trace_clean.py", "net/fx.py")
+        assert rules == []
+
     def test_spmd_family_catches_seeded(self):
         # graftcheck v2: rank-gated collective, early rank exit, rank
         # fact through a call argument, rank-filtered comprehension,

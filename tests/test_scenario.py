@@ -666,8 +666,13 @@ class TestScenarioObs:
         for bucket, row in rep["scenario"]["by_bucket"].items():
             srow = stats["scenario"]["by_bucket"][bucket]
             assert row["count"] == srow["count"]
+            # The report's percentile runs over JSONL values already
+            # rounded to 3 decimals while stats() rounds the percentile
+            # of raw floats — a value near a 0.0005 grid boundary lands
+            # one 0.001 step apart, so the tolerance must cover a full
+            # grid step with float-repr slack.
             assert row["total_ms"]["p50"] == pytest.approx(
-                srow["total_ms_p50"], abs=1e-3
+                srow["total_ms_p50"], abs=2e-3
             )
         from distributedlpsolver_tpu.obs.report import render
 

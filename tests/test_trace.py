@@ -1,0 +1,338 @@
+"""Distributed tracing + fleet aggregation: trace-context wire form,
+histogram exemplars, Perfetto merge/flow stitching, reconciliation
+accounting, and the multi-process acceptance probe."""
+
+import json
+import os
+import subprocess
+import sys
+
+from distributedlpsolver_tpu.obs import agg, context
+from distributedlpsolver_tpu.obs.metrics import Histogram
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- trace context ----------------------------------------------------------
+
+
+def test_context_roundtrip_parents_the_sender():
+    root = context.new_context()
+    assert len(root.trace_id) == 32 and len(root.span_id) == 16
+    assert root.parent_span_id == ""
+    got = context.parse(root.to_header())
+    assert got is not None
+    assert got.trace_id == root.trace_id
+    # The sender's span becomes the receiver's parent; the receiver is
+    # a FRESH span.
+    assert got.parent_span_id == root.span_id
+    assert got.span_id != root.span_id
+
+
+def test_context_children_are_siblings():
+    root = context.new_context()
+    a, b = root.child(), root.child()
+    assert a.trace_id == b.trace_id == root.trace_id
+    assert a.parent_span_id == b.parent_span_id == root.span_id
+    assert a.span_id != b.span_id  # hedge legs are distinct spans
+
+
+def test_context_parse_rejects_malformed_and_zero_ids():
+    assert context.parse(None) is None
+    assert context.parse("") is None
+    assert context.parse("not-a-traceparent") is None
+    assert context.parse("00-" + "g" * 32 + "-" + "1" * 16 + "-01") is None
+    # All-zero trace/span ids are invalid per the W3C shape.
+    assert context.parse("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+    assert context.parse("00-" + "1" * 32 + "-" + "0" * 16 + "-01") is None
+    # Tolerant of case and surrounding whitespace.
+    hdr = ("00-" + "AB" * 16 + "-" + "CD" * 8 + "-01")
+    got = context.parse("  " + hdr + "  ")
+    assert got is not None and got.trace_id == "ab" * 16
+
+
+def test_context_span_args_and_thread_local_scope():
+    c = context.new_context().child()
+    args = c.span_args()
+    assert args == {
+        "trace_id": c.trace_id,
+        "span_id": c.span_id,
+        "parent_span_id": c.parent_span_id,
+    }
+    assert context.current() is None
+    with context.use(c) as got:
+        assert got is c and context.current() is c
+        with context.use(None):
+            assert context.current() is None
+        assert context.current() is c
+    assert context.current() is None
+
+
+# -- histogram exemplars ----------------------------------------------------
+
+
+def test_histogram_exemplar_max_value_wins():
+    h = Histogram([1.0, 10.0, 100.0])
+    h.observe(5.0, exemplar="t-fast")
+    h.observe(50.0, exemplar="t-slow")
+    h.observe(7.0, exemplar="t-mid")
+    h.observe(200.0)  # slower, but carries no trace — must not evict
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["exemplar"] == {"value": 50.0, "trace_id": "t-slow"}
+
+
+def test_histogram_without_exemplar_omits_slot():
+    h = Histogram([1.0])
+    h.observe(0.5)
+    assert "exemplar" not in h.snapshot()
+
+
+# -- trace merge + flow stitching ------------------------------------------
+
+
+def _trace_file(tmp_path, name, events, process_name=None):
+    evs = list(events)
+    if process_name:
+        evs.insert(0, {
+            "ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+            "args": {"name": process_name},
+        })
+    path = os.path.join(str(tmp_path), name)
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, fh)
+    return path
+
+
+def test_merge_traces_stitches_one_trace_across_processes(tmp_path):
+    tid = "ab" * 16
+    router = _trace_file(tmp_path, "router.json", [
+        {"ph": "X", "name": "route.ingress", "pid": 1, "tid": 5,
+         "ts": 100.0, "dur": 50.0, "args": {"trace_id": tid}},
+    ], process_name="dlps-router")
+    backend = _trace_file(tmp_path, "backend.json", [
+        {"ph": "X", "name": "cg.solve", "pid": 1, "tid": 9,
+         "ts": 120.0, "dur": 10.0, "args": {"trace_id": tid}},
+        {"ph": "X", "name": "pipeline.flush", "pid": 1, "tid": 9,
+         "ts": 110.0, "dur": 30.0, "args": {"trace_ids": [tid, "x" * 32]}},
+    ], process_name="dlps-backend")
+    merged = agg.merge_traces([("r", router), ("b", backend)])
+
+    # Per-source pids: router events on pid 1, backend events on pid 2.
+    by_name = {e["name"]: e for e in merged["traceEvents"]
+               if e.get("ph") == "X"}
+    assert by_name["route.ingress"]["pid"] == 1
+    assert by_name["cg.solve"]["pid"] == 2
+    # Process-name metadata rewritten with the source label.
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert "r (dlps-router)" in names and "b (dlps-backend)" in names
+    # The shared trace_id got a flow chain s -> t -> f in ts order,
+    # crossing from the router pid to the backend pid.
+    flows = sorted((e for e in merged["traceEvents"]
+                    if e.get("cat") == "trace_flow"
+                    and e["args"]["trace_id"] == tid),
+                   key=lambda e: e["ts"])
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert flows[0]["pid"] == 1 and flows[-1]["pid"] == 2
+    assert flows[-1]["bp"] == "e"
+    assert len({e["id"] for e in flows}) == 1
+    # The single-anchor trace ("x"*32) must NOT get a chain.
+    assert merged["otherData"]["traces_connected"] == 1
+
+    summary = agg.trace_summary(merged)
+    assert summary[tid]["spans"] == 3
+    assert summary[tid]["processes"] == 2
+    assert "route.ingress" in summary[tid]["names"]
+
+
+def test_merge_traces_degrades_on_unreadable_source(tmp_path):
+    bad = os.path.join(str(tmp_path), "missing.json")
+    ok = _trace_file(tmp_path, "ok.json", [
+        {"ph": "X", "name": "s", "pid": 1, "tid": 1, "ts": 1.0, "dur": 1.0},
+    ])
+    merged = agg.merge_traces([("bad", bad), ("ok", ok)])
+    errs = merged["otherData"]["merge_errors"]
+    assert len(errs) == 1 and errs[0]["source"] == "bad"
+    assert any(e.get("name") == "s" for e in merged["traceEvents"])
+
+
+# -- reconciliation ---------------------------------------------------------
+
+
+def _fleet(router_hedging=None, backends=(), failovers=0):
+    routers = {}
+    if router_hedging is not None:
+        routers["http://r:1"] = {"statusz": {
+            "hedging": router_hedging, "failovers": failovers,
+        }}
+    return {
+        "routers": routers,
+        "backends": {
+            f"http://b:{i}": row for i, row in enumerate(backends)
+        },
+        "slices": [],
+    }
+
+
+def test_reconcile_balanced_plane_is_consistent():
+    fleet = _fleet(
+        router_hedging={
+            "forwards_total": 10, "hedges_launched": 2, "cancels": 0,
+            "outcomes": {"hedge_won": 1, "primary_won": 1,
+                         "suppressed_cap": 3},
+        },
+        backends=[
+            {"statusz": {"stats": {
+                "requests": 7, "journal": {"results": 7, "pending": 0},
+            }}},
+            {"statusz": {"stats": {
+                "requests": 5, "journal": {"results": 5, "pending": 0},
+            }}},
+        ],
+    )
+    rec = agg.reconcile(fleet)
+    by = {c["name"]: c for c in rec["checks"]}
+    # Suppressed outcomes never launched a leg: 1+1 == hedges_launched,
+    # and attempts (10+2) == backend records (7+5).
+    assert by["hedge_outcomes_accounted"]["status"] == "ok"
+    assert by["attempts_vs_backend_records"]["status"] == "ok"
+    assert by["journal_vs_backend_records"]["status"] == "ok"
+    assert rec["consistent"]
+    assert rec["totals"]["forwards_total"] == 10
+    assert rec["totals"]["outcomes"]["suppressed_cap"] == 3
+
+
+def test_reconcile_flags_lost_work_as_mismatch():
+    fleet = _fleet(
+        router_hedging={
+            "forwards_total": 10, "hedges_launched": 0, "cancels": 0,
+            "outcomes": {},
+        },
+        backends=[{"statusz": {"stats": {"requests": 8}}}],
+    )
+    rec = agg.reconcile(fleet)
+    by = {c["name"]: c for c in rec["checks"]}
+    assert by["attempts_vs_backend_records"]["status"] == "mismatch"
+    assert by["attempts_vs_backend_records"]["delta"] == 2
+    assert not rec["consistent"]
+
+
+def test_reconcile_cancels_and_failovers_soften_the_balance():
+    # A cancelled hedge leg may legitimately leave no backend record.
+    fleet = _fleet(
+        router_hedging={
+            "forwards_total": 10, "hedges_launched": 2, "cancels": 1,
+            "outcomes": {"hedge_won": 2},
+        },
+        backends=[{"statusz": {"stats": {"requests": 11}}}],
+    )
+    by = {c["name"]: c for c in agg.reconcile(fleet)["checks"]}
+    assert by["attempts_vs_backend_records"]["status"] == "ok"
+    # Failover retries make the balance indeterminate, not a mismatch.
+    fleet = _fleet(
+        router_hedging={
+            "forwards_total": 10, "hedges_launched": 0, "cancels": 0,
+            "outcomes": {},
+        },
+        backends=[{"statusz": {"stats": {"requests": 12}}}],
+        failovers=2,
+    )
+    rec = agg.reconcile(fleet)
+    by = {c["name"]: c for c in rec["checks"]}
+    assert by["attempts_vs_backend_records"]["status"] == "indeterminate"
+    assert rec["consistent"]  # indeterminate is not drift
+
+
+def test_reconcile_skips_instead_of_guessing():
+    # No routers at all: the hedge checks must say so, not fabricate 0s.
+    rec = agg.reconcile(_fleet(backends=[
+        {"statusz": {"stats": {"requests": 3}}},
+    ]))
+    by = {c["name"]: c for c in rec["checks"]}
+    assert by["hedge_outcomes_accounted"]["status"] == "skipped"
+    assert by["attempts_vs_backend_records"]["status"] == "skipped"
+    assert by["journal_vs_backend_records"]["status"] == "skipped"
+    assert rec["consistent"]
+    # An unreachable backend poisons the attempt balance: skip it.
+    rec = agg.reconcile(_fleet(
+        router_hedging={"forwards_total": 5, "hedges_launched": 0,
+                        "cancels": 0, "outcomes": {}},
+        backends=[
+            {"statusz": {"stats": {"requests": 5}}},
+            {"error": "connection refused"},
+        ],
+    ))
+    by = {c["name"]: c for c in rec["checks"]}
+    assert by["attempts_vs_backend_records"]["status"] == "skipped"
+
+
+# -- exemplar surfacing -----------------------------------------------------
+
+
+def test_exemplars_unwrap_follower_snapshots(tmp_path):
+    wrapped = os.path.join(str(tmp_path), "rank1.metrics.json")
+    with open(wrapped, "w") as fh:
+        json.dump({
+            "rank": 1, "pid": 42,
+            "metrics": {
+                "solve_ms": {"buckets": {}, "sum": 9.0, "count": 1,
+                             "exemplar": {"value": 9.0, "trace_id": "tA"}},
+            },
+        }, fh)
+    bare = os.path.join(str(tmp_path), "snap.json")
+    with open(bare, "w") as fh:
+        json.dump({
+            "queue_ms": {"buckets": {}, "sum": 30.0, "count": 2,
+                         "exemplar": {"value": 25.0, "trace_id": "tB"}},
+            "a_counter": 7.0,
+        }, fh)
+    fleet = {
+        "slices": [{"dir": str(tmp_path), "ranks": {
+            1: {"metrics": json.load(open(wrapped))},
+        }}],
+        "backends": {}, "routers": {},
+    }
+    rows = agg.exemplars(fleet, metrics_json=[bare])
+    # Sorted slowest-first across both sources.
+    assert [(r["trace_id"], r["value"]) for r in rows] == [
+        ("tB", 25.0), ("tA", 9.0),
+    ]
+    assert rows[1]["source"].endswith(":rank1")
+
+
+def test_parse_prometheus_samples_only():
+    text = (
+        "# HELP dlps_requests_total total\n"
+        "# TYPE dlps_requests_total counter\n"
+        "dlps_requests_total 42\n"
+        'dlps_latency_ms{le="10"} 7\n'
+        "garbage line with no value pair here ok maybe\n"
+    )
+    got = agg.parse_prometheus(text)
+    assert got["dlps_requests_total"] == 42.0
+    assert got['dlps_latency_ms{le="10"}'] == 7.0
+    assert len(got) == 2
+
+
+# -- tier-1 smoke: the multi-process tracing acceptance probe ---------------
+
+
+def test_probe_trace_smoke():
+    """CI satellite: a hedged request through a live router + 2 solo-path
+    backends must yield ONE trace_id connecting >= 4 spans across >= 2
+    processes in the merged Perfetto artifact, with the router's hedge
+    ledger, backend request records, and journal lifecycle counts
+    reconciling exactly (``cli obs-agg`` exit 0)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "probe_trace.py"),
+         "--budget-s", "120"],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    tail = "\n".join(proc.stdout.splitlines()[-30:])
+    assert proc.returncode == 0, (
+        f"probe_trace failed (rc={proc.returncode}):\n{tail}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    assert "PASS" in proc.stdout
